@@ -1,0 +1,37 @@
+"""raft_tpu — a TPU-native (JAX/XLA) frequency-domain dynamics framework for
+floating offshore wind turbines, providing the capabilities of NREL's RAFT
+(reference: /root/reference) re-designed TPU-first.
+
+Design notes
+------------
+The reference is a single-threaded NumPy code whose hot loops (frequencies,
+member strip nodes, load cases, sweep designs) are Python ``for`` loops
+(reference raft/raft_model.py:585, raft/raft_fowt.py:503).  Here the whole
+case-dynamics pipeline is a single jitted XLA graph: strip-theory integrals
+are einsums over a padded node axis, the drag-linearization fixed point is a
+``lax.while_loop`` with per-case convergence freezing, and the per-frequency
+6x6 complex solves are one batched ``jnp.linalg.solve`` over
+``[case, freq, 6, 6]``.  Design sweeps shard over devices with
+``jax.sharding``/``shard_map``.
+
+Unlike the reference, the external native solvers (MoorPy quasi-static
+mooring, CCBlade Fortran BEM aero, HAMS Fortran potential flow) are
+reimplemented natively in JAX (``raft_tpu.mooring``, ``raft_tpu.aero``,
+``raft_tpu.bem``), with derivatives coming from autodiff instead of hand
+coded adjoints / finite differences.
+"""
+
+import os as _os
+
+from jax import config as _jax_config
+
+# Float64 is the framework default: the reference physics is float64 NumPy and
+# several statics quantities (e.g. hydrostatic C44 ~ -5e9 from cancellation)
+# need the headroom.  Hot-path dtypes are still selectable per-Model
+# (precision='float32' keeps the TPU MXU path fast; the 6x6 solves stay c128).
+if not _os.environ.get("RAFT_TPU_NO_X64"):
+    _jax_config.update("jax_enable_x64", True)
+
+from raft_tpu.model import Model, run_raft  # noqa: E402,F401
+
+__version__ = "0.1.0"

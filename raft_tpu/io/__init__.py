@@ -1,0 +1,1 @@
+from raft_tpu.io.schema import get_from_dict, load_design, cases_as_dicts

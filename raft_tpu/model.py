@@ -1,0 +1,11 @@
+"""Model facade (placeholder — full implementation lands with the dynamics
+pipeline)."""
+
+
+class Model:  # pragma: no cover - placeholder
+    def __init__(self, design, **kwargs):
+        raise NotImplementedError("raft_tpu.Model is under construction")
+
+
+def run_raft(input_file, **kwargs):  # pragma: no cover - placeholder
+    raise NotImplementedError

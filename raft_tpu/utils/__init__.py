@@ -1,0 +1,8 @@
+from raft_tpu.utils.frames import (
+    small_rotate, get_h, rotation_matrix, translate_force_3to6,
+    transform_force, translate_matrix_3to6, translate_matrix_6to6,
+    rotate_matrix3, rotate_matrix6, vec_vec_trans,
+)
+from raft_tpu.utils.frustum import (
+    frustum_vcv_circ, frustum_vcv_rect, frustum_moi, rect_frustum_moi,
+)

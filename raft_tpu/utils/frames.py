@@ -1,0 +1,152 @@
+"""6-DOF rigid-body frame transforms as batched JAX primitives.
+
+Provides the math of the reference's helpers (reference raft/helpers.py:158-382
+— SmallRotate, getH, rotationMatrix, translateForce3to6DOF,
+translateMatrix3to6DOF, translateMatrix6to6DOF, rotateMatrix3/6) but written
+as pure functions that broadcast over arbitrary leading batch dimensions, so
+they can be used inside vmapped/jitted pipelines instead of per-node Python
+loops.
+"""
+
+import jax.numpy as jnp
+
+
+def small_rotate(r, th):
+    """First-order displacement of point(s) ``r`` under small rotations ``th``.
+
+    Equals ``cross(th, r)`` (reference raft/helpers.py:158-170).  Broadcasts;
+    supports complex rotation amplitudes.
+
+    r : [..., 3], th : [..., 3] -> [..., 3]
+    """
+    return jnp.cross(th, r)
+
+
+def get_h(r):
+    """Alternator matrix H(r) with H @ v = cross(v, r) = -cross(r, v).
+
+    Matches the reference's sign convention (reference raft/helpers.py:187-195).
+
+    r : [..., 3] -> [..., 3, 3]
+    """
+    x, y, z = r[..., 0], r[..., 1], r[..., 2]
+    zero = jnp.zeros_like(x)
+    return jnp.stack(
+        [
+            jnp.stack([zero, z, -y], axis=-1),
+            jnp.stack([-z, zero, x], axis=-1),
+            jnp.stack([y, -x, zero], axis=-1),
+        ],
+        axis=-2,
+    )
+
+
+def rotation_matrix(x3, x2, x1):
+    """Rotation matrix from intrinsic z-y-x (yaw-pitch-roll applied z,y,x order)
+    Tait-Bryan angles; column convention matches reference raft/helpers.py:197-224.
+
+    x3, x2, x1 : broadcastable scalars/arrays (roll, pitch, yaw) -> [..., 3, 3]
+    """
+    x3, x2, x1 = jnp.broadcast_arrays(
+        jnp.asarray(x3), jnp.asarray(x2), jnp.asarray(x1)
+    )
+    s1, c1 = jnp.sin(x1), jnp.cos(x1)
+    s2, c2 = jnp.sin(x2), jnp.cos(x2)
+    s3, c3 = jnp.sin(x3), jnp.cos(x3)
+    return jnp.stack(
+        [
+            jnp.stack([c1 * c2, c1 * s2 * s3 - c3 * s1, s1 * s3 + c1 * c3 * s2], axis=-1),
+            jnp.stack([c2 * s1, c1 * c3 + s1 * s2 * s3, c3 * s1 * s2 - c1 * s3], axis=-1),
+            jnp.stack([-s2, c2 * s3, c2 * c3], axis=-1),
+        ],
+        axis=-2,
+    )
+
+
+def translate_force_3to6(F, r):
+    """Force at position r -> 6-DOF force/moment about the origin
+    (reference raft/helpers.py:226-241).
+
+    F : [..., 3], r : [..., 3] -> [..., 6]
+    """
+    return jnp.concatenate(
+        jnp.broadcast_arrays(F, jnp.cross(r, F)), axis=-1
+    )
+
+
+def transform_force(f_in, offset=None, rot=None):
+    """Transform a 6-DOF force/moment between frames: optional rotation ``rot``
+    ([..., 3, 3]) then moment shift by ``offset`` (reference raft/helpers.py:244-291).
+
+    f_in : [..., 6] -> [..., 6]
+    """
+    F = f_in[..., :3]
+    M = f_in[..., 3:]
+    if rot is not None:
+        F = jnp.einsum("...ij,...j->...i", rot, F)
+        M = jnp.einsum("...ij,...j->...i", rot, M)
+    if offset is not None:
+        M = M + jnp.cross(offset, F)
+    return jnp.concatenate([F, M], axis=-1)
+
+
+def translate_matrix_3to6(Min, r):
+    """3x3 mass/damping-like matrix at point r -> 6x6 about origin via the
+    Sadeghi & Incecik parallel-axis transform (reference raft/helpers.py:295-318).
+
+    Min : [..., 3, 3], r : [..., 3] -> [..., 6, 6]
+    """
+    H = get_h(r)
+    MH = Min @ H
+    top = jnp.concatenate([Min, MH], axis=-1)
+    bottom = jnp.concatenate(
+        [jnp.swapaxes(MH, -1, -2), H @ Min @ jnp.swapaxes(H, -1, -2)], axis=-1
+    )
+    return jnp.concatenate([top, bottom], axis=-2)
+
+
+def translate_matrix_6to6(Min, r):
+    """6x6 matrix about a point at -r -> about origin (r points from the new
+    reference point to the current one; reference raft/helpers.py:321-343).
+
+    Min : [..., 6, 6], r : [..., 3] -> [..., 6, 6]
+    """
+    H = get_h(r)
+    m = Min[..., :3, :3]
+    J = Min[..., :3, 3:]
+    I = Min[..., 3:, 3:]
+    mH = m @ H
+    Jp = mH + J
+    Ip = (
+        H @ m @ jnp.swapaxes(H, -1, -2)
+        + jnp.swapaxes(J, -1, -2) @ H
+        + jnp.swapaxes(H, -1, -2) @ J
+        + I
+    )
+    top = jnp.concatenate([m, Jp], axis=-1)
+    bottom = jnp.concatenate([jnp.swapaxes(Jp, -1, -2), Ip], axis=-1)
+    return jnp.concatenate([top, bottom], axis=-2)
+
+
+def rotate_matrix3(Min, rotMat):
+    """[m'] = [R][m][R]^T (reference raft/helpers.py:371-382)."""
+    return rotMat @ Min @ jnp.swapaxes(rotMat, -1, -2)
+
+
+def rotate_matrix6(Min, rotMat):
+    """Rotate a 6x6 mass/inertia tensor (reference raft/helpers.py:347-368)."""
+    Rt = jnp.swapaxes(rotMat, -1, -2)
+    m = rotMat @ Min[..., :3, :3] @ Rt
+    J = rotMat @ Min[..., :3, 3:] @ Rt
+    I = rotMat @ Min[..., 3:, 3:] @ Rt
+    top = jnp.concatenate([m, J], axis=-1)
+    bottom = jnp.concatenate([jnp.swapaxes(J, -1, -2), I], axis=-1)
+    return jnp.concatenate([top, bottom], axis=-2)
+
+
+def vec_vec_trans(v):
+    """Outer product v v^T (reference raft/helpers.py:174-182).
+
+    v : [..., 3] -> [..., 3, 3]
+    """
+    return v[..., :, None] * v[..., None, :]

@@ -1,0 +1,161 @@
+"""Closed-form frustum volume / centroid / moment-of-inertia formulas,
+vectorized for batched use (reference raft/helpers.py:35-62 FrustumVCV,
+raft/raft_member.py:250-331 FrustumMOI / RectangularFrustumMOI).
+
+All functions broadcast elementwise over array inputs, so an entire member's
+submember stack (or all members of all sweep designs) evaluates in one call.
+Degenerate inputs (H == 0 or zero cross-section) return zeros, matching the
+reference's guard branches, but via ``where`` masking instead of ``if``.
+"""
+
+import jax.numpy as jnp
+
+
+def frustum_vcv_circ(dA, dB, H):
+    """Volume and centroid height (from the dA end) of a conical frustum.
+
+    Returns (V, hc); zero-size inputs give (0, 0).
+    Reference raft/helpers.py:35-62.
+    """
+    dA, dB, H = jnp.broadcast_arrays(
+        jnp.asarray(dA, float), jnp.asarray(dB, float), jnp.asarray(H, float)
+    )
+    A1 = (jnp.pi / 4) * dA**2
+    A2 = (jnp.pi / 4) * dB**2
+    Amid = (jnp.pi / 4) * dA * dB
+    denom = A1 + Amid + A2
+    V = denom * H / 3
+    safe = jnp.where(denom > 0, denom, 1.0)  # NaN-free in fwd AND grad passes
+    hc = jnp.where(denom > 0, (A1 + 2 * Amid + 3 * A2) / safe * H / 4, 0.0)
+    zero = (dA == 0) & (dB == 0)
+    return jnp.where(zero, 0.0, V), jnp.where(zero, 0.0, hc)
+
+
+def frustum_vcv_rect(slA, slB, H):
+    """Volume and centroid height of a rectangular (pyramidal) frustum.
+
+    slA, slB : [..., 2] side-length pairs.  Returns (V, hc).
+    Reference raft/helpers.py:47-55 (length-2 branch).
+    """
+    slA = jnp.asarray(slA, float)
+    slB = jnp.asarray(slB, float)
+    H = jnp.asarray(H, float)
+    A1 = slA[..., 0] * slA[..., 1]
+    A2 = slB[..., 0] * slB[..., 1]
+    Amid = jnp.sqrt(A1 * A2)
+    denom = A1 + Amid + A2
+    V = denom * H / 3
+    safe = jnp.where(denom > 0, denom, 1.0)
+    hc = jnp.where(denom > 0, (A1 + 2 * Amid + 3 * A2) / safe * H / 4, 0.0)
+    zero = (jnp.sum(jnp.abs(slA), axis=-1) == 0) & (jnp.sum(jnp.abs(slB), axis=-1) == 0)
+    return jnp.where(zero, 0.0, V), jnp.where(zero, 0.0, hc)
+
+
+def frustum_moi(dA, dB, H, rho):
+    """Radial (about the dA end node) and axial moments of inertia of a solid
+    circular frustum of density rho.  Returns (I_rad_end, I_ax).
+
+    Uses the cylinder formula when dA == dB and the tapered formula otherwise,
+    selected by ``where`` (reference raft/raft_member.py:250-268).
+    """
+    dA, dB, H, rho = jnp.broadcast_arrays(
+        jnp.asarray(dA, float), jnp.asarray(dB, float),
+        jnp.asarray(H, float), jnp.asarray(rho, float),
+    )
+    r1 = dA / 2
+    r2 = dB / 2
+    # cylinder branch
+    I_rad_cyl = (1 / 12) * (rho * H * jnp.pi * r1**2) * (3 * r1**2 + 4 * H**2)
+    I_ax_cyl = 0.5 * rho * jnp.pi * H * r1**4
+    # tapered branch; (r2^5 - r1^5)/(r2 - r1) is regular but guard the division
+    dr = r2 - r1
+    ratio = (r2**5 - r1**5) / jnp.where(dr == 0, 1.0, dr)
+    I_rad_tap = (1 / 20) * rho * jnp.pi * H * ratio + (1 / 30) * rho * jnp.pi * H**3 * (
+        r1**2 + 3 * r1 * r2 + 6 * r2**2
+    )
+    I_ax_tap = (1 / 10) * rho * jnp.pi * H * ratio
+    same = dA == dB
+    I_rad = jnp.where(same, I_rad_cyl, I_rad_tap)
+    I_ax = jnp.where(same, I_ax_cyl, I_ax_tap)
+    zero = H == 0
+    return jnp.where(zero, 0.0, I_rad), jnp.where(zero, 0.0, I_ax)
+
+
+def rect_frustum_moi(slA, slB, H, rho):
+    """Moments of inertia about the end node of a (possibly tapered) cuboid.
+
+    slA, slB : [..., 2] (L, W) side pairs.  Returns (Ixx, Iyy, Izz) about the
+    bottom end node (x/y radial, z axial).
+
+    The reference (raft/raft_member.py:270-331) provides four special-case
+    branches; the general taper branch there is unreachable (it contains a
+    ``H(...)`` call typo that would raise TypeError).  Here we use the single
+    exact closed form for a linearly tapered rectangular frustum — side
+    lengths L(t), W(t) vary linearly over t in [0, 1] — via exact polynomial
+    integration of
+
+      x2  = rho*H/12 * int L(t)^3 W(t) dt     (spread about the local y axis)
+      y2  = rho*H/12 * int W(t)^3 L(t) dt     (spread about the local x axis)
+      z2  = rho*H^3  * int t^2 L(t) W(t) dt   (height spread about the end)
+
+      Ixx = y2 + z2,  Iyy = x2 + z2,  Izz = x2 + y2
+
+    which reduces to each of the reference's three working branches
+    (verified in tests/test_kernels.py against numerical integration).
+    """
+    slA = jnp.asarray(slA, float)
+    slB = jnp.asarray(slB, float)
+    H = jnp.asarray(H, float)
+    rho = jnp.asarray(rho, float)
+    La, Wa = slA[..., 0], slA[..., 1]
+    Lb, Wb = slB[..., 0], slB[..., 1]
+
+    # Side lengths vary linearly: L(t) = La + (Lb-La) t, W(t) similarly, t in [0,1].
+    dL = Lb - La
+    dW = Wb - Wa
+
+    def poly_int(coeffs):
+        # integral over t in [0,1] of sum_k coeffs[k] t^k
+        return sum(c / (k + 1) for k, c in enumerate(coeffs))
+
+    # products as polynomials in t
+    # L(t)*W(t) = La*Wa + (La*dW + Wa*dL) t + dL*dW t^2
+    lw0, lw1, lw2 = La * Wa, La * dW + Wa * dL, dL * dW
+
+    # x2 = rho * H/12 * int L(t)^3 W(t) dt   (second moment about local y from x-extent)
+    # L^3 coefficients
+    l3_0 = La**3
+    l3_1 = 3 * La**2 * dL
+    l3_2 = 3 * La * dL**2
+    l3_3 = dL**3
+    # L^3 * W coefficients
+    x2 = rho * H / 12 * poly_int([
+        l3_0 * Wa,
+        l3_0 * dW + l3_1 * Wa,
+        l3_1 * dW + l3_2 * Wa,
+        l3_2 * dW + l3_3 * Wa,
+        l3_3 * dW,
+    ])
+    w3_0 = Wa**3
+    w3_1 = 3 * Wa**2 * dW
+    w3_2 = 3 * Wa * dW**2
+    w3_3 = dW**3
+    y2 = rho * H / 12 * poly_int([
+        w3_0 * La,
+        w3_0 * dL + w3_1 * La,
+        w3_1 * dL + w3_2 * La,
+        w3_2 * dL + w3_3 * La,
+        w3_3 * dL,
+    ])
+    # z2 = rho * H^3 * int t^2 L(t) W(t) dt  (second moment about end from height)
+    z2 = rho * H**3 * poly_int([0.0, 0.0, lw0, lw1, lw2])
+
+    Ixx = y2 + z2
+    Iyy = x2 + z2
+    Izz = x2 + y2
+    zero = H == 0
+    return (
+        jnp.where(zero, 0.0, Ixx),
+        jnp.where(zero, 0.0, Iyy),
+        jnp.where(zero, 0.0, Izz),
+    )

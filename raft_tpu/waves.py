@@ -1,0 +1,140 @@
+"""Linear (Airy) wave theory kernels: dispersion, kinematics, spectra.
+
+Replaces the reference's per-frequency / per-node Python loops
+(reference raft/helpers.py:85-154 getWaveKin/waveNumber, :397-443 JONSWAP)
+with fully vectorized jnp ops over (node, frequency) so they fuse into the
+case-dynamics XLA graph.
+"""
+
+import jax
+import jax.numpy as jnp
+
+_G = 9.81
+
+
+def wave_number(w, h, g=_G, iters=30):
+    """Wave number k solving the dispersion relation w^2 = g k tanh(k h).
+
+    Newton iteration from the deep-water guess, fixed ``iters`` steps
+    (converges to machine precision in < 10; reference raft/helpers.py:139-154
+    stops at 0.1% relative which this strictly improves on).
+
+    w : [...] rad/s (positive), h : scalar depth -> k : [...]
+    """
+    w = jnp.asarray(w, float)
+    w2 = w * w
+    k0 = jnp.maximum(w2 / g, 1e-12)
+
+    def body(_, k):
+        t = jnp.tanh(jnp.clip(k * h, 1e-12, 50.0))
+        f = w2 - g * k * t
+        df = -g * (t + k * h * (1 - t * t))   # d/dk of g k tanh(kh), sign flipped
+        knew = k - f / df
+        return jnp.maximum(knew, 1e-12)
+
+    return jax.lax.fori_loop(0, iters, body, k0)
+
+
+def depth_ratios(k, z, h):
+    """Numerically stable hyperbolic depth-attenuation ratios.
+
+    Returns (sinh(k(z+h))/sinh(kh), cosh(k(z+h))/sinh(kh), cosh(k(z+h))/cosh(kh))
+    computed via exponentials so nothing overflows for large kh
+    (replaces the reference's explicit deep/shallow branching,
+    raft/helpers.py:106-120; the formulas are analytically identical to both
+    branches).
+
+    k : [nw], z : [...] (<= 0 expected) -> each ratio [..., nw]
+    """
+    k = jnp.asarray(k)
+    z = jnp.asarray(z).astype(k.dtype)[..., None]
+    h = jnp.asarray(h).astype(k.dtype)
+    ekz = jnp.exp(k * z)                       # e^{k z},      z<=0 so <= 1
+    emk = jnp.exp(-k * (z + 2.0 * h))          # e^{-k(z+2h)}, z>=-h so <= 1
+    e2h = jnp.exp(-2.0 * k * h)
+    denom_s = 1.0 - e2h
+    denom_s = jnp.where(denom_s <= 0, 1e-30, denom_s)
+    s = (ekz - emk) / denom_s
+    c = (ekz + emk) / denom_s
+    cc = (ekz + emk) / (1.0 + e2h)
+    return s, c, cc
+
+
+def wave_kinematics(zeta0, beta, w, k, h, r, rho=1025.0, g=_G, dtype=None):
+    """Complex wave kinematics amplitude spectra at point(s) r.
+
+    Vectorized over both nodes and frequencies (reference raft/helpers.py:85-134
+    loops over frequencies per node).  Nodes above the free surface get zeros,
+    matching the reference's ``if z < 0`` gate, via ``where`` masking.
+
+    Parameters
+    ----------
+    zeta0 : [nw] complex wave elevation amplitudes at the origin
+    beta  : scalar wave heading [rad]
+    w, k  : [nw] frequencies / wave numbers
+    h     : depth
+    r     : [..., 3] node positions
+    dtype : complex dtype for the outputs.  Defaults to the promotion of the
+        inputs.  Pass ``jnp.complex64`` on TPU — the hardware has no c128
+        support, so the f32 pair type is the native choice there.
+
+    Returns
+    -------
+    u    : [..., 3, nw] velocity amplitudes
+    ud   : [..., 3, nw] acceleration amplitudes
+    pDyn : [..., nw] dynamic pressure amplitudes
+    """
+    zeta0 = jnp.asarray(zeta0)
+    if dtype is None:
+        dtype = jnp.result_type(zeta0.dtype, jnp.complex64)
+    real = jnp.finfo(dtype).dtype  # matching real dtype (f32 for c64, ...)
+    zeta0 = zeta0.astype(dtype)
+    w = jnp.asarray(w).astype(real)
+    k = jnp.asarray(k).astype(real)
+    r = jnp.asarray(r).astype(real)
+    x, y, z = r[..., 0], r[..., 1], r[..., 2]
+    cb, sb = jnp.cos(jnp.asarray(beta, real)), jnp.sin(jnp.asarray(beta, real))
+    phase = k * (cb * x + sb * y)[..., None]           # [..., nw]
+    # complex exp built from real cos/sin so the complex width follows `dtype`
+    zeta = zeta0 * (jnp.cos(phase) - 1j * jnp.sin(phase)).astype(dtype)
+
+    s, c, cc = depth_ratios(k, z, h)                   # [..., nw]
+    sub = (z < 0)[..., None]                           # submergence mask
+
+    ux = w * zeta * c * cb
+    uy = w * zeta * c * sb
+    uz = 1j * w * zeta * s
+    u = jnp.stack([ux, uy, uz], axis=-2)               # [..., 3, nw]
+    u = jnp.where(sub[..., None, :], u, 0.0)
+    ud = 1j * w * u
+    pDyn = jnp.where(sub, rho * g * zeta * cc, 0.0)
+    return u, ud, pDyn
+
+
+def jonswap(ws, Hs, Tp, Gamma=1.0):
+    """One-sided JONSWAP wave PSD [m^2/(rad/s)] per IEC 61400-3
+    (reference raft/helpers.py:397-443; Gamma=1 gives Pierson-Moskowitz).
+
+    Broadcasts over all inputs (so (case, freq) grids evaluate in one call).
+    """
+    ws = jnp.asarray(ws, float)
+    f = 0.5 / jnp.pi * ws
+    fpOvrf4 = (Tp * f) ** -4.0
+    C = 1.0 - 0.287 * jnp.log(Gamma)
+    Sigma = jnp.where(f <= 1.0 / Tp, 0.07, 0.09)
+    Alpha = jnp.exp(-0.5 * ((f * Tp - 1.0) / Sigma) ** 2)
+    return (
+        0.5 / jnp.pi * C * 0.3125 * Hs * Hs * fpOvrf4 / f
+        * jnp.exp(-1.25 * fpOvrf4) * Gamma**Alpha
+    )
+
+
+def get_rms(xi, dw):
+    """RMS of a complex amplitude spectrum: sqrt(sum |xi|^2 dw) over the last
+    axis (reference raft/helpers.py:385-388)."""
+    return jnp.sqrt(jnp.sum(jnp.abs(xi) ** 2, axis=-1) * dw)
+
+
+def get_psd(xi):
+    """Power spectral density |xi|^2 (reference raft/helpers.py:391-394)."""
+    return jnp.abs(xi) ** 2

@@ -1,0 +1,18 @@
+"""Test config: force the CPU backend with 8 virtual devices so multi-chip
+sharding paths compile and execute without TPU hardware.
+
+Note: the axon TPU plugin in this image ignores the JAX_PLATFORMS env var, so
+we force the platform through jax.config before any backend initialization.
+"""
+import os
+import sys
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,278 @@
+"""Unit tests for the numeric kernels (frames, frustums, waves, spectra)
+against independent NumPy implementations of the reference formulas
+(reference raft/helpers.py, raft/raft_member.py:250-331)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.utils import (
+    frustum_moi,
+    frustum_vcv_circ,
+    frustum_vcv_rect,
+    get_h,
+    rect_frustum_moi,
+    rotation_matrix,
+    rotate_matrix6,
+    small_rotate,
+    translate_force_3to6,
+    translate_matrix_3to6,
+    translate_matrix_6to6,
+    vec_vec_trans,
+)
+from raft_tpu.waves import (
+    get_psd,
+    get_rms,
+    jonswap,
+    wave_kinematics,
+    wave_number,
+)
+
+rng = np.random.default_rng(0)
+
+
+# ---------------- frames ----------------
+
+def np_getH(r):
+    return np.array([[0, r[2], -r[1]], [-r[2], 0, r[0]], [r[1], -r[0], 0]], float)
+
+
+def test_get_h_and_small_rotate():
+    r = rng.normal(size=3)
+    v = rng.normal(size=3)
+    assert np.allclose(get_h(r), np_getH(r))
+    th = rng.normal(size=3)
+    # reference SmallRotate: rt = cross(th, r)
+    rt = np.array([
+        -th[2] * r[1] + th[1] * r[2],
+        th[2] * r[0] - th[0] * r[2],
+        -th[1] * r[0] + th[0] * r[1],
+    ])
+    assert np.allclose(small_rotate(r, th), rt)
+    # batched
+    rb = rng.normal(size=(5, 3))
+    assert np.allclose(get_h(rb)[2], np_getH(rb[2]))
+
+
+def test_translate_force_3to6():
+    F = rng.normal(size=3)
+    r = rng.normal(size=3)
+    out = translate_force_3to6(F, r)
+    assert np.allclose(out[:3], F)
+    assert np.allclose(out[3:], np.cross(r, F))
+
+
+def test_translate_matrix_3to6():
+    M = rng.normal(size=(3, 3))
+    r = rng.normal(size=3)
+    H = np_getH(r)
+    expect = np.zeros((6, 6))
+    expect[:3, :3] = M
+    expect[:3, 3:] = M @ H
+    expect[3:, :3] = (M @ H).T
+    expect[3:, 3:] = H @ M @ H.T
+    assert np.allclose(translate_matrix_3to6(M, r), expect)
+
+
+def test_translate_matrix_6to6():
+    M = rng.normal(size=(6, 6))
+    M = M + M.T  # symmetric like a mass matrix
+    r = rng.normal(size=3)
+    H = np_getH(r)
+    expect = np.zeros((6, 6))
+    expect[:3, :3] = M[:3, :3]
+    expect[:3, 3:] = M[:3, :3] @ H + M[:3, 3:]
+    expect[3:, :3] = expect[:3, 3:].T
+    expect[3:, 3:] = (
+        H @ M[:3, :3] @ H.T + M[3:, :3] @ H + H.T @ M[:3, 3:] + M[3:, 3:]
+    )
+    assert np.allclose(translate_matrix_6to6(M, r), expect)
+
+
+def test_rotation_matrix_props():
+    R = np.asarray(rotation_matrix(0.3, -0.2, 0.7))
+    assert np.allclose(R @ R.T, np.eye(3), atol=1e-12)
+    assert np.isclose(np.linalg.det(R), 1.0)
+    # pure yaw
+    Rz = np.asarray(rotation_matrix(0.0, 0.0, np.pi / 2))
+    assert np.allclose(Rz @ np.array([1, 0, 0]), [0, 1, 0], atol=1e-12)
+
+
+def test_rotate_matrix6_consistency():
+    M = rng.normal(size=(6, 6))
+    M = M + M.T
+    R = np.asarray(rotation_matrix(0.1, 0.2, 0.3))
+    out = np.asarray(rotate_matrix6(M, R))
+    assert np.allclose(out[:3, :3], R @ M[:3, :3] @ R.T)
+    assert np.allclose(out[3:, :3], out[:3, 3:].T)
+
+
+def test_vec_vec_trans():
+    v = rng.normal(size=3)
+    assert np.allclose(vec_vec_trans(v), np.outer(v, v))
+
+
+# ---------------- frustums ----------------
+
+def test_frustum_vcv_cylinder_cone():
+    # cylinder d=2, H=3
+    V, hc = frustum_vcv_circ(2.0, 2.0, 3.0)
+    assert np.isclose(V, np.pi * 1**2 * 3)
+    assert np.isclose(hc, 1.5)
+    # full cone d: 2 -> 0
+    V, hc = frustum_vcv_circ(2.0, 0.0, 3.0)
+    assert np.isclose(V, np.pi * 1**2 * 3 / 3)
+    assert np.isclose(hc, 3.0 / 4)  # centroid of cone from base
+    # degenerate
+    V, hc = frustum_vcv_circ(0.0, 0.0, 3.0)
+    assert V == 0 and hc == 0
+
+
+def test_frustum_vcv_rect():
+    V, hc = frustum_vcv_rect([2.0, 3.0], [2.0, 3.0], 4.0)
+    assert np.isclose(V, 24.0)
+    assert np.isclose(hc, 2.0)
+    # pyramid to a point
+    V, hc = frustum_vcv_rect([2.0, 2.0], [0.0, 0.0], 3.0)
+    assert np.isclose(V, 4.0)
+
+
+def test_frustum_moi_cylinder():
+    d, H, rho = 2.0, 5.0, 1000.0
+    I_rad, I_ax = frustum_moi(d, d, H, rho)
+    m = rho * np.pi * 1**2 * H
+    assert np.isclose(I_ax, 0.5 * m * 1**2)
+    # radial about end = (1/12) m (3 r^2 + 4 H^2)  [solid cylinder about end]
+    assert np.isclose(I_rad, (1 / 12) * m * (3 * 1**2 + 4 * H**2))
+
+
+def test_frustum_moi_tapered_vs_numeric():
+    dA, dB, H, rho = 3.0, 1.0, 4.0, 700.0
+    I_rad, I_ax = frustum_moi(dA, dB, H, rho)
+    # numerical integration of stacked disks
+    z = np.linspace(0, H, 200001)
+    r = (dA + (dB - dA) * z / H) / 2
+    dm = rho * np.pi * r**2
+    I_ax_num = np.trapezoid(0.5 * dm * r**2, z)
+    I_rad_num = np.trapezoid(dm * (r**2 / 4 + z**2), z)
+    assert np.isclose(I_ax, I_ax_num, rtol=1e-6)
+    assert np.isclose(I_rad, I_rad_num, rtol=1e-6)
+
+
+def test_rect_frustum_moi_cuboid():
+    L, W, H, rho = 2.0, 3.0, 4.0, 500.0
+    Ixx, Iyy, Izz = rect_frustum_moi([L, W], [L, W], H, rho)
+    M = rho * L * W * H
+    assert np.isclose(Ixx, M / 12 * (W**2 + 4 * H**2))
+    assert np.isclose(Iyy, M / 12 * (L**2 + 4 * H**2))
+    assert np.isclose(Izz, M / 12 * (L**2 + W**2))
+
+
+def test_rect_frustum_moi_tapered_vs_numeric():
+    La, Wa, Lb, Wb, H, rho = 2.0, 3.0, 1.0, 1.5, 4.0, 500.0
+    Ixx, Iyy, Izz = rect_frustum_moi([La, Wa], [Lb, Wb], H, rho)
+    z = np.linspace(0, H, 200001)
+    L = La + (Lb - La) * z / H
+    W = Wa + (Wb - Wa) * z / H
+    dm = rho * L * W
+    Izz_num = np.trapezoid(dm * (L**2 + W**2) / 12, z)
+    Ixx_num = np.trapezoid(dm * (W**2 / 12 + z**2), z)
+    Iyy_num = np.trapezoid(dm * (L**2 / 12 + z**2), z)
+    assert np.isclose(Izz, Izz_num, rtol=1e-6)
+    assert np.isclose(Ixx, Ixx_num, rtol=1e-6)
+    assert np.isclose(Iyy, Iyy_num, rtol=1e-6)
+
+
+# ---------------- waves ----------------
+
+def test_wave_number_dispersion():
+    g = 9.81
+    w = np.linspace(0.05, 4.0, 80)
+    for h in [20.0, 200.0, 3000.0]:
+        k = np.asarray(wave_number(w, h))
+        assert np.allclose(w**2, g * k * np.tanh(k * h), rtol=1e-10)
+    # deep water limit
+    k = np.asarray(wave_number(2.0, 5000.0))
+    assert np.isclose(k, 4.0 / g, rtol=1e-8)
+
+
+def np_wave_kin_reference(zeta0, beta, w, k, h, r, nw, rho=1025.0, g=9.81):
+    """Direct port of the reference loop logic for test comparison
+    (raft/helpers.py:85-134)."""
+    u = np.zeros([3, nw], dtype=complex)
+    ud = np.zeros([3, nw], dtype=complex)
+    pDyn = np.zeros(nw, dtype=complex)
+    zeta = zeta0 * np.exp(-1j * (k * (np.cos(beta) * r[0] + np.sin(beta) * r[1])))
+    z = r[2]
+    if z < 0:
+        for i in range(nw):
+            if k[i] * h > 89.4:
+                s = np.exp(k[i] * z)
+                c = np.exp(k[i] * z)
+                cc = np.exp(k[i] * z) + np.exp(-k[i] * (z + 2 * h))
+            else:
+                s = np.sinh(k[i] * (z + h)) / np.sinh(k[i] * h)
+                c = np.cosh(k[i] * (z + h)) / np.sinh(k[i] * h)
+                cc = np.cosh(k[i] * (z + h)) / np.cosh(k[i] * h)
+            u[0, i] = w[i] * zeta[i] * c * np.cos(beta)
+            u[1, i] = w[i] * zeta[i] * c * np.sin(beta)
+            u[2, i] = 1j * w[i] * zeta[i] * s
+            ud[:, i] = 1j * w[i] * u[:, i]
+            pDyn[i] = rho * g * zeta[i] * cc
+    return u, ud, pDyn
+
+
+@pytest.mark.parametrize("h", [50.0, 320.0])
+def test_wave_kinematics_matches_reference(h):
+    nw = 40
+    w = np.linspace(0.03, 2.5, nw)
+    k = np.asarray(wave_number(w, h))
+    zeta0 = np.sqrt(np.linspace(0.1, 2.0, nw)) * np.exp(1j * 0.3)
+    beta = 0.4
+    for r in [np.array([3.0, -2.0, -10.0]), np.array([0.0, 0.0, -45.0]),
+              np.array([1.0, 1.0, 2.0])]:
+        u, ud, p = wave_kinematics(zeta0, beta, w, k, h, r)
+        u_ref, ud_ref, p_ref = np_wave_kin_reference(zeta0, beta, w, k, h, r, nw)
+        assert np.allclose(np.asarray(u), u_ref, atol=1e-10)
+        assert np.allclose(np.asarray(ud), ud_ref, atol=1e-10)
+        assert np.allclose(np.asarray(p), p_ref, atol=1e-6)
+
+
+def test_wave_kinematics_batched_nodes():
+    h = 200.0
+    nw = 16
+    w = np.linspace(0.1, 2.0, nw)
+    k = np.asarray(wave_number(w, h))
+    zeta0 = np.ones(nw)
+    r = np.array([[0.0, 0.0, -5.0], [2.0, 1.0, -50.0], [0.0, 0.0, 1.0]])
+    u, ud, p = wave_kinematics(zeta0, 0.0, w, k, h, r)
+    assert u.shape == (3, 3, nw)
+    u0, _, _ = wave_kinematics(zeta0, 0.0, w, k, h, r[0])
+    assert np.allclose(u[0], u0)
+    assert np.allclose(np.asarray(u[2]), 0.0)  # above-surface node masked
+
+
+def np_jonswap_reference(ws, Hs, Tp, Gamma=1.0):
+    f = 0.5 / np.pi * ws
+    fpOvrf4 = (Tp * f) ** -4.0
+    C = 1.0 - 0.287 * np.log(Gamma)
+    Sigma = 0.07 * (f <= 1.0 / Tp) + 0.09 * (f > 1.0 / Tp)
+    Alpha = np.exp(-0.5 * ((f * Tp - 1.0) / Sigma) ** 2)
+    return (0.5 / np.pi * C * 0.3125 * Hs * Hs * fpOvrf4 / f
+            * np.exp(-1.25 * fpOvrf4) * Gamma**Alpha)
+
+
+def test_jonswap_matches_reference_and_hs():
+    dw = 0.01
+    ws = np.arange(dw, 6.0, dw)
+    for Hs, Tp, gam in [(2.0, 8.0, 1.0), (6.0, 12.0, 3.3)]:
+        S = np.asarray(jonswap(ws, Hs, Tp, gam))
+        assert np.allclose(S, np_jonswap_reference(ws, Hs, Tp, gam), rtol=1e-10)
+        Hs_back = 4 * np.sqrt(np.sum(S) * dw)
+        assert np.isclose(Hs_back, Hs, rtol=0.05)
+
+
+def test_rms_psd():
+    xi = rng.normal(size=12) + 1j * rng.normal(size=12)
+    dw = 0.05
+    assert np.isclose(get_rms(xi, dw), np.sqrt(np.sum(np.abs(xi) ** 2) * dw))
+    assert np.allclose(get_psd(xi), np.abs(xi) ** 2)
