@@ -1,0 +1,281 @@
+"""Quasi-static catenary mooring system in JAX.
+
+Native replacement for the MoorPy subset the reference consumes
+(reference raft/raft_model.py:58-77, :332-378; capability inventory in
+SURVEY.md §2.2): YAML system parsing, per-line elastic catenary solves with
+seabed contact, rigid-body equilibrium under external mean loads, and the
+linearized outputs RAFT needs — the coupled stiffness matrix ``C_moor``, net
+force ``F_moor``, line tensions, and the tension Jacobian ``J_moor``.
+
+Where MoorPy linearizes by finite differences, everything here is
+``jax.jacfwd`` through the actual solver, and the per-line catenary solves
+are ``vmap``-batched; the whole system is differentiable and vmappable over
+load cases (mean aero loads) and design parameters.
+
+Catenary formulation: the standard quasi-static elastic catenary (as in
+MoorPy/MAP; suspended + seabed-contact cases, frictionless seabed CB=0 which
+is MoorPy's default for lines parsed from YAML), solved by damped Newton in
+(log HF, VF).
+"""
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.utils.frames import rotation_matrix, translate_force_3to6
+
+
+# ---------------- host-side parsing ----------------
+
+@dataclass
+class MooringSystem:
+    """Static description of a body-coupled mooring system (arrays over lines)."""
+
+    anchors: np.ndarray   # [nL, 3] fixed anchor positions
+    rFair: np.ndarray     # [nL, 3] fairlead positions relative to the body
+    L: np.ndarray         # [nL] unstretched lengths
+    EA: np.ndarray        # [nL] axial stiffness
+    w: np.ndarray         # [nL] submerged weight per length (N/m)
+    depth: float
+    names: list
+
+    @property
+    def n_lines(self):
+        return len(self.L)
+
+    def arrays(self, dtype=jnp.float64, device="cpu"):
+        """Line property arrays for the solver functions.
+
+        By default the arrays are committed to the host CPU backend: the
+        mooring equilibrium is setup-time work wanting exact f64, and the TPU
+        backend cannot compile f64 LU solves.  Committed placement makes every
+        eager op downstream execute on CPU.  Pass ``device=None`` to leave
+        placement to the caller (e.g. inside a jitted pipeline).
+        """
+        out = (
+            jnp.asarray(self.anchors, dtype),
+            jnp.asarray(self.rFair, dtype),
+            jnp.asarray(self.L, dtype),
+            jnp.asarray(self.EA, dtype),
+            jnp.asarray(self.w, dtype),
+        )
+        if device == "cpu":
+            cpu = jax.devices("cpu")[0]
+            out = tuple(jax.device_put(a, cpu) for a in out)
+        return out
+
+
+def parse_mooring(mooring, rho_water=1025.0, g=9.81):
+    """Build a MooringSystem from the design dict's ``mooring`` section
+    (schema per reference designs/*.yaml: points/lines/line_types)."""
+    types = {lt["name"]: lt for lt in mooring["line_types"]}
+    points = {p["name"]: p for p in mooring["points"]}
+
+    anchors, rFair, Ls, EAs, ws, names = [], [], [], [], [], []
+    for ln in mooring["lines"]:
+        pA = points[ln["endA"]]
+        pB = points[ln["endB"]]
+        # identify which end is the fixed anchor and which rides the body
+        if pA["type"] == "fixed" and pB["type"] == "vessel":
+            anchor, vessel = pA, pB
+        elif pB["type"] == "fixed" and pA["type"] == "vessel":
+            anchor, vessel = pB, pA
+        else:
+            raise ValueError(
+                f"Line '{ln.get('name','?')}' must connect a fixed point to a "
+                f"vessel point (free intermediate points are not supported yet)"
+            )
+        lt = types[ln["type"]]
+        d_vol = float(lt["diameter"])  # volume-equivalent diameter
+        mden = float(lt["mass_density"])
+        anchors.append(np.array(anchor["location"], float))
+        rFair.append(np.array(vessel["location"], float))
+        Ls.append(float(ln["length"]))
+        EAs.append(float(lt["stiffness"]))
+        ws.append((mden - rho_water * np.pi / 4 * d_vol**2) * g)
+        names.append(ln.get("name", f"line{len(names)+1}"))
+
+    return MooringSystem(
+        anchors=np.array(anchors),
+        rFair=np.array(rFair),
+        L=np.array(Ls),
+        EA=np.array(EAs),
+        w=np.array(ws),
+        depth=float(mooring.get("water_depth", 0.0)),
+        names=names,
+    )
+
+
+# ---------------- elastic catenary ----------------
+
+def _profile(H, V, L, EA, w):
+    """Fairlead excursion (x, z) produced by fairlead tension components
+    (H horizontal, V vertical) for a line of length L, stiffness EA, unit
+    submerged weight w.  Frictionless seabed.
+
+    Suspended (V >= wL):
+      x = H/w [asinh(V/H) - asinh((V-wL)/H)] + HL/EA
+      z = H/w [sqrt(1+(V/H)^2) - sqrt(1+((V-wL)/H)^2)] + (VL - wL^2/2)/EA
+    Touchdown (V < wL, length LB = L - V/w on the seabed):
+      x = LB + H/w asinh(V/H) + HL/EA
+      z = H/w (sqrt(1+(V/H)^2) - 1) + V^2/(2 EA w)
+    The two meet continuously at V = wL.
+    """
+    W = w * L
+    VA = V - W
+    vh = V / H
+    vah = VA / H
+    xs = H / w * (jnp.arcsinh(vh) - jnp.arcsinh(vah)) + H * L / EA
+    zs = (
+        H / w * (jnp.sqrt(1 + vh**2) - jnp.sqrt(1 + vah**2))
+        + (V * L - 0.5 * w * L**2) / EA
+    )
+    LB = jnp.clip(L - V / w, 0.0, L)
+    xt = LB + H / w * jnp.arcsinh(vh) + H * L / EA
+    zt = H / w * (jnp.sqrt(1 + vh**2) - 1.0) + V**2 / (2 * EA * w)
+    suspended = VA >= 0
+    return jnp.where(suspended, xs, xt), jnp.where(suspended, zs, zt)
+
+
+def catenary_solve(XF, ZF, L, EA, w, iters=60):
+    """Solve one line for fairlead tension components (HF, VF) such that the
+    catenary spans horizontal distance XF and vertical distance ZF.
+
+    Damped Newton in (log HF, VF) — log keeps HF positive; 60 full Newton
+    steps converge to machine precision from the MoorPy-style initial guess
+    well before the cap.  Differentiable (fixed iteration count, so jacfwd
+    propagates cleanly through the converged fixed point).
+    """
+    # guard XF -> 0 (fairlead directly above anchor, e.g. a vertical tendon):
+    # treat as a tiny horizontal span so the solve stays finite; HF then
+    # correctly comes out ~0 and the force is purely vertical
+    XF = jnp.maximum(XF, 1e-6 * L)
+    d = jnp.sqrt(XF**2 + ZF**2)
+    slack = 3.0 * jnp.maximum((L**2 - ZF**2) / XF**2 - 1.0, 1e-8)
+    lam0 = jnp.where(L <= d, 0.25, jnp.sqrt(slack))
+    H0 = jnp.maximum(jnp.abs(0.5 * w * XF / lam0), 10.0)
+    V0 = 0.5 * w * (ZF / jnp.tanh(lam0) + L)
+    W = w * L
+
+    def resid(p):
+        H = jnp.exp(p[0])
+        V = p[1]
+        x, z = _profile(H, V, L, EA, w)
+        return jnp.stack([x - XF, z - ZF])
+
+    jac = jax.jacfwd(resid)
+
+    def body(_, p):
+        f = resid(p)
+        J = jac(p)
+        det = J[0, 0] * J[1, 1] - J[0, 1] * J[1, 0]
+        det = jnp.where(jnp.abs(det) < 1e-30, 1e-30, det)
+        du = (J[1, 1] * f[0] - J[0, 1] * f[1]) / det
+        dv = (-J[1, 0] * f[0] + J[0, 0] * f[1]) / det
+        du = jnp.clip(du, -1.5, 1.5)
+        dv = jnp.clip(dv, -0.5 * (jnp.abs(p[1]) + W), 0.5 * (jnp.abs(p[1]) + W))
+        return p - jnp.stack([du, dv])
+
+    p = jax.lax.fori_loop(0, iters, body, jnp.stack([jnp.log(H0), V0]))
+    return jnp.exp(p[0]), p[1]
+
+
+# ---------------- system-level forces ----------------
+
+def line_forces(r6, anchors, rFair, L, EA, w):
+    """6-DOF mooring reaction on the body at pose r6, plus per-line fairlead
+    force vectors and tension components.
+
+    Returns (f6[6], HF[nL], VF[nL]).
+    """
+    R = rotation_matrix(r6[3], r6[4], r6[5])
+    arm = jnp.einsum("ij,lj->li", R, rFair)          # rotated fairlead offsets
+    p = r6[:3] + arm                                  # fairlead world positions
+    dxy = p[:, :2] - anchors[:, :2]
+    XF = jnp.sqrt(jnp.sum(dxy**2, axis=1))
+    ZF = p[:, 2] - anchors[:, 2]
+    HF, VF = jax.vmap(catenary_solve)(XF, ZF, L, EA, w)
+    # vertical-line guard: direction is irrelevant when XF ~ 0 since HF ~ 0
+    u = dxy / jnp.maximum(XF, 1e-9)[:, None]
+    F3 = jnp.stack([-HF * u[:, 0], -HF * u[:, 1], -VF], axis=1)  # [nL,3]
+    f6 = jnp.sum(translate_force_3to6(F3, arm), axis=0)
+    return f6, HF, VF
+
+
+def line_tensions(r6, anchors, rFair, L, EA, w):
+    """End tensions [TA..., TB...] (anchor ends first, then fairlead ends),
+    matching MoorPy's getTensions ordering consumed at reference
+    raft/raft_model.py:273-283."""
+    _, HF, VF = line_forces(r6, anchors, rFair, L, EA, w)
+    W = w * L
+    TB = jnp.sqrt(HF**2 + VF**2)
+    TA = jnp.where(VF >= W, jnp.sqrt(HF**2 + (VF - W) ** 2), HF)
+    return jnp.concatenate([TA, TB])
+
+
+def body_hydrostatic_force(r6, m, v, rCG, rM, AWP, rho=1025.0, g=9.81):
+    """Weight + buoyancy + waterplane heave stiffness of the rigid body,
+    with buoyancy applied at the metacenter rM (MoorPy Body convention —
+    RAFT pushes m/v/rCG/AWP/rM into the body at raft/raft_fowt.py:309-313)."""
+    R = rotation_matrix(r6[3], r6[4], r6[5])
+    f6 = translate_force_3to6(
+        jnp.array([0.0, 0.0, -m * g], r6.dtype), R @ rCG
+    ) + translate_force_3to6(jnp.array([0.0, 0.0, rho * v * g], r6.dtype), R @ rM)
+    return f6.at[2].add(-rho * g * AWP * r6[2])
+
+
+def solve_equilibrium(
+    f6_ext, body_props, anchors, rFair, L, EA, w, rho=1025.0, g=9.81,
+    iters=40, r6_init=None,
+):
+    """Find the body pose r6 where mooring + hydrostatics + external mean
+    loads balance (the reference's ms.solveEquilibrium3 call,
+    raft/raft_model.py:347).  Damped Newton with the exact autodiff Jacobian.
+
+    body_props : (m, v, rCG[3], rM[3], AWP)
+    Returns r6[6].
+    """
+    m, v, rCG, rM, AWP = body_props
+
+    def total_force(r6):
+        f_lines, _, _ = line_forces(r6, anchors, rFair, L, EA, w)
+        f_body = body_hydrostatic_force(r6, m, v, rCG, rM, AWP, rho, g)
+        return f_lines + f_body + f6_ext
+
+    jac = jax.jacfwd(total_force)
+    # derive constants from an operand so eager placement follows the system
+    # arrays (committed to CPU by MooringSystem.arrays())
+    step_cap = jnp.zeros_like(L, shape=(6,)) + jnp.array(
+        [10.0, 10.0, 10.0, 0.1, 0.1, 0.1]
+    )
+
+    def body_fn(_, r6):
+        F = total_force(r6)
+        J = jac(r6)
+        dx = jnp.linalg.solve(J, -F)
+        dx = jnp.clip(dx, -step_cap, step_cap)
+        return r6 + dx
+
+    r0 = jnp.zeros_like(L, shape=(6,)) if r6_init is None else jnp.asarray(r6_init)
+    return jax.lax.fori_loop(0, iters, body_fn, r0)
+
+
+def coupled_stiffness(r6, anchors, rFair, L, EA, w):
+    """Mooring-only 6x6 stiffness C = -d f6_lines / d r6 about pose r6
+    (the reference's ms.getCoupledStiffness(lines_only=True),
+    raft/raft_model.py:117, :366) — exact forward-mode autodiff through the
+    catenary solves instead of MoorPy's finite differencing."""
+
+    def f(r):
+        f6, _, _ = line_forces(r, anchors, rFair, L, EA, w)
+        return f6
+
+    return -jax.jacfwd(f)(r6)
+
+
+def tension_jacobian(r6, anchors, rFair, L, EA, w):
+    """J_moor = d tensions / d r6  [2 nL, 6] (reference raft_model.py:366,
+    consumed for tension FFTs at :273-283)."""
+    return jax.jacfwd(lambda r: line_tensions(r, anchors, rFair, L, EA, w))(r6)
