@@ -1,0 +1,535 @@
+"""Blade-element momentum rotor aerodynamics + aero-servo coupling.
+
+Native replacement for the reference's CCBlade dependency (Fortran BEM with
+hand-coded adjoints, consumed at reference raft/raft_rotor.py:182-307) and
+for the Rotor class's aero-servo transfer functions (raft_rotor.py:327-489):
+
+ - the induction solve uses Ning's guaranteed-convergence inflow-angle
+   residual, solved by vectorized bisection over (span x azimuth), with
+   gradients recovered by differentiable Newton polishing steps on top of a
+   stop_gradient'ed bisection root (implicit-function derivatives without
+   custom_root plumbing);
+ - d{T,Q}/d{U, Omega, pitch} come from jax.jacfwd through the whole rotor
+   evaluation — replacing CCBlade's hand-written derivative chain;
+ - airfoil polars are pre-interpolated host-side exactly like the reference
+   (200-point AoA grid, PCHIP spanwise blending on relative thickness,
+   raft_rotor.py:81-166) and evaluated with linear interpolation in the
+   solve (the reference uses CCAirfoil's spline; differences are far below
+   the polar-data uncertainty);
+ - the control branch reproduces the reference's transfer-function algebra
+   (raft_rotor.py:367-432) including its quirks (ki_tau assigned from kp_tau,
+   raft_rotor.py:375; mean-load moment ordering [T,Y,Z,My,Q,Mz],
+   raft_rotor.py:350-351).
+
+Runs on the CPU backend in f64 (per-case setup work, tiny arrays); the
+outputs (scalars + [nw] arrays) feed the device dynamics graph.
+"""
+
+import numpy as np
+from scipy.interpolate import PchipInterpolator
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.io.schema import get_from_dict
+from raft_tpu.wind import kaimal_rotor_spectrum
+
+_RAD2DEG = 57.29577951308232
+_RPM2RADPS = 0.1047  # the reference's rounded conversion (raft_rotor.py:32)
+
+
+# ---------------------------------------------------------------- airfoils
+
+def build_airfoils(turbine, n_span=30, n_aoa=200):
+    """Airfoil polar tables interpolated to the analysis grid
+    (reference raft/raft_rotor.py:75-166).
+
+    Returns (aoa_grid [n_aoa+2], cl, cd, cm [n_span, n_aoa+2]).
+    """
+    af_used = [b for a, b in turbine["blade"]["airfoils"]]
+    af_position = [a for a, b in turbine["blade"]["airfoils"]]
+    n_af = len(turbine["airfoils"])
+
+    aoa = np.unique(
+        np.hstack(
+            [
+                np.linspace(-180, -30, int(n_aoa / 4.0 + 1)),
+                np.linspace(-30, 30, int(n_aoa / 2.0)),
+                np.linspace(30, 180, int(n_aoa / 4.0 + 1)),
+            ]
+        )
+    )
+
+    af_name = [turbine["airfoils"][i]["name"] for i in range(n_af)]
+    r_thick = np.array(
+        [turbine["airfoils"][i]["relative_thickness"] for i in range(n_af)]
+    )
+    cl = np.zeros((n_af, len(aoa)))
+    cd = np.zeros((n_af, len(aoa)))
+    cm = np.zeros((n_af, len(aoa)))
+    for i in range(n_af):
+        tab = np.array(turbine["airfoils"][i]["data"])
+        cl[i] = np.interp(aoa, tab[:, 0], tab[:, 1])
+        cd[i] = np.interp(aoa, tab[:, 0], tab[:, 2])
+        cm[i] = np.interp(aoa, tab[:, 0], tab[:, 3])
+        # enforce +/-180 deg consistency (raft_rotor.py:125-133)
+        for arr in (cl, cd, cm):
+            if abs(arr[i, 0] - arr[i, -1]) > 1e-5:
+                arr[i, 0] = arr[i, -1]
+
+    r_thick_used = np.zeros(len(af_used))
+    cl_used = np.zeros((len(af_used), len(aoa)))
+    cd_used = np.zeros((len(af_used), len(aoa)))
+    cm_used = np.zeros((len(af_used), len(aoa)))
+    for i, name in enumerate(af_used):
+        j = af_name.index(name)
+        r_thick_used[i] = r_thick[j]
+        cl_used[i] = cl[j]
+        cd_used[i] = cd[j]
+        cm_used[i] = cm[j]
+
+    grid = np.linspace(0.0, 1.0, n_span)
+    r_thick_interp = PchipInterpolator(af_position, r_thick_used)(grid)
+
+    r_thick_unique, idx = np.unique(r_thick_used, return_index=True)
+    flip = np.flip(r_thick_interp)
+    cl_i = np.flip(PchipInterpolator(r_thick_unique, cl_used[idx])(flip), axis=0)
+    cd_i = np.flip(PchipInterpolator(r_thick_unique, cd_used[idx])(flip), axis=0)
+    cm_i = np.flip(PchipInterpolator(r_thick_unique, cm_used[idx])(flip), axis=0)
+    return aoa, cl_i, cd_i, cm_i
+
+
+# ---------------------------------------------------------------- BEM core
+
+def _define_curvature(r, precurve, presweep, precone):
+    """Azimuthal-frame blade coordinates, local cone angle, and path length
+    (CCBlade's definecurvature; needed for curved IEA-15MW blades)."""
+    x_az = -r * jnp.sin(precone) + precurve * jnp.cos(precone)
+    z_az = r * jnp.cos(precone) + precurve * jnp.sin(precone)
+    y_az = presweep
+    # local cone angle from slopes (central differences, one-sided ends)
+    dx = jnp.gradient(x_az)
+    dz = jnp.gradient(z_az)
+    cone = jnp.arctan2(-dx, dz)
+    s = jnp.concatenate(
+        [
+            jnp.zeros(1, r.dtype),
+            jnp.cumsum(
+                jnp.sqrt(
+                    jnp.diff(r) ** 2 + jnp.diff(precurve) ** 2 + jnp.diff(presweep) ** 2
+                )
+            ),
+        ]
+    )
+    return x_az, y_az, z_az, cone, s
+
+
+def _wind_components(Uinf, Omega, azimuth, r, precurve, presweep, precone,
+                     yaw, tilt, hubHt, shearExp):
+    """Per-section velocity components in the blade-aligned frame
+    (CCBlade windcomponents)."""
+    sy, cy = jnp.sin(yaw), jnp.cos(yaw)
+    st, ct = jnp.sin(tilt), jnp.cos(tilt)
+    sa, ca = jnp.sin(azimuth), jnp.cos(azimuth)
+    sc, cc = jnp.sin(precone), jnp.cos(precone)
+
+    x_az = -r * sc + precurve * cc
+    z_az = r * cc + precurve * sc
+    y_az = presweep
+
+    height = (y_az * sa + z_az * ca) * ct - x_az * st
+    V = Uinf * (1.0 + height / hubHt) ** shearExp
+
+    Vwind_x = V * ((cy * st * ca + sy * sa) * sc + cy * ct * cc)
+    Vwind_y = V * (cy * st * sa - sy * ca)
+    Vrot_x = -Omega * y_az * sc
+    Vrot_y = Omega * z_az
+    return Vwind_x + Vrot_x, Vwind_y + Vrot_y
+
+
+def _induction(phi, cl, cd, sigma_p, F_args, usecd=True):
+    """Induction factors and the Ning residual for a given inflow angle.
+
+    F_args = (B, r, Rhub, Rtip, Vx, Vy).
+    Returns (R(phi), a, ap, F).
+    """
+    B, r, Rhub, Rtip, Vx, Vy = F_args
+    sphi = jnp.sin(phi)
+    cphi = jnp.cos(phi)
+    abs_s = jnp.maximum(jnp.abs(sphi), 1e-9)
+
+    # Prandtl tip/hub losses
+    ftip = B / 2.0 * (Rtip / r - 1.0) / abs_s
+    Ftip = 2.0 / jnp.pi * jnp.arccos(jnp.clip(jnp.exp(-ftip), 0.0, 1.0))
+    fhub = B / 2.0 * (r / Rhub - 1.0) / abs_s
+    Fhub = 2.0 / jnp.pi * jnp.arccos(jnp.clip(jnp.exp(-fhub), 0.0, 1.0))
+    F = jnp.maximum(Ftip * Fhub, 1e-6)
+
+    cn = cl * cphi + cd * sphi
+    ct = cl * sphi - cd * cphi
+    if not usecd:
+        cn = cl * cphi
+        ct = cl * sphi
+
+    k = sigma_p * cn / (4.0 * F * sphi * sphi)
+    kp = sigma_p * ct / (4.0 * F * sphi * cphi)
+
+    # axial induction: momentum / Buhl-empirical / propeller-brake regions
+    a_mom = k / (1.0 + k)
+    g1 = 2.0 * F * k - (10.0 / 9.0 - F)
+    g2 = jnp.maximum(2.0 * F * k - F * (4.0 / 3.0 - F), 1e-12)
+    g3 = 2.0 * F * k - (25.0 / 9.0 - 2.0 * F)
+    a_buhl = jnp.where(
+        jnp.abs(g3) < 1e-6,
+        1.0 - 1.0 / (2.0 * jnp.sqrt(g2)),
+        (g1 - jnp.sqrt(g2)) / jnp.where(jnp.abs(g3) < 1e-6, 1.0, g3),
+    )
+    a_wind = jnp.where(k <= 2.0 / 3.0, a_mom, a_buhl)
+    a_brake = jnp.where(k > 1.0, k / jnp.maximum(k - 1.0, 1e-9), 0.0)
+    a = jnp.where(phi > 0, a_wind, a_brake)
+
+    kp = jnp.where(jnp.abs(1.0 - kp) < 1e-9, kp + 1e-9, kp)
+    ap = kp / (1.0 - kp)
+
+    Vy_safe = jnp.where(jnp.abs(Vy) < 1e-6, jnp.sign(Vy) * 1e-6 + 1e-12, Vy)
+    # NOTE: (1 - a) must keep its sign — near phi -> 0 the momentum branch
+    # drives a through 1 and the residual's sign flip there is what the
+    # bracketing relies on (Ning's method / CCBlade does not clamp here)
+    one_minus_a = jnp.where(jnp.abs(1.0 - a) < 1e-12, 1e-12, 1.0 - a)
+    resid = sphi / one_minus_a - Vx / Vy_safe * cphi * (1.0 - kp)
+    return resid, a, ap, F
+
+
+def _solve_phi(theta, cl_tab, cd_tab, aoa_grid, sigma_p, F_args,
+               n_bisect=50, n_newton=2):
+    """Inflow angle phi solving the BEM residual for one blade section.
+
+    Bisection on Ning's primary bracket (eps, pi/2), with fallback brackets
+    (-pi/4, -eps) and (pi/2, pi-eps) selected by sign tests — then
+    differentiable Newton polishing so jacfwd recovers the implicit
+    derivative through the solve.
+    """
+
+    def resid(phi):
+        alpha = phi - theta                                 # rad
+        cl = jnp.interp(alpha * _RAD2DEG, aoa_grid, cl_tab)
+        cd = jnp.interp(alpha * _RAD2DEG, aoa_grid, cd_tab)
+        return _induction(phi, cl, cd, sigma_p, F_args)[0]
+
+    eps = 1e-6
+    r_lo = resid(eps)
+    r_hi = resid(jnp.pi / 2)
+    primary = r_lo * r_hi <= 0
+    # fallback selection (ccblade.py __runBEM bracket logic)
+    r_neg = resid(-jnp.pi / 4)
+    use_neg = (~primary) & (r_neg < 0) & (r_lo > 0)
+    lo = jnp.where(primary, eps, jnp.where(use_neg, -jnp.pi / 4, jnp.pi / 2))
+    hi = jnp.where(primary, jnp.pi / 2, jnp.where(use_neg, -eps, jnp.pi - eps))
+
+    def bis_body(_, state):
+        lo, hi = state
+        mid = 0.5 * (lo + hi)
+        rm = resid(mid)
+        rl = resid(lo)
+        same = rl * rm > 0
+        return jnp.where(same, mid, lo), jnp.where(same, hi, mid)
+
+    lo, hi = jax.lax.fori_loop(0, n_bisect, bis_body, (lo, hi))
+    phi = jax.lax.stop_gradient(0.5 * (lo + hi))
+
+    dresid = jax.grad(resid)
+    for _ in range(n_newton):
+        phi = phi - resid(phi) / dresid(phi)
+    return phi
+
+
+def rotor_evaluate(Uinf, Omega, pitch, geom, polars, env, nSector=4):
+    """Steady rotor loads (CCBlade.evaluate equivalent).
+
+    Parameters
+    ----------
+    Uinf : hub wind speed [m/s]; Omega : rotor speed [rad/s];
+    pitch : blade pitch [rad]
+    geom : dict with r, chord, theta(rad), precurve, presweep, Rhub, Rtip,
+        B, precone(rad), tilt(rad), yaw(rad), hubHt, shearExp
+    polars : (aoa_grid_deg, cl[n_span,naoa], cd, cm)
+    env : dict with rho, mu
+
+    Returns dict with T, Q, P, CP, CT, CQ and per-azimuth distributed loads.
+    """
+    aoa_grid, cl_tab, cd_tab, _ = polars
+    r = geom["r"]
+    chord = geom["chord"]
+    theta = geom["theta"] + pitch
+    B = geom["B"]
+    sigma_p = B * chord / (2.0 * jnp.pi * r)
+
+    azimuths = jnp.arange(nSector) * (2.0 * jnp.pi / nSector)
+
+    def one_azimuth(az):
+        Vx, Vy = _wind_components(
+            Uinf, Omega, az, r, geom["precurve"], geom["presweep"],
+            geom["precone"], geom["yaw"], geom["tilt"], geom["hubHt"],
+            geom["shearExp"],
+        )
+
+        def one_section(th, clt, cdt, sp, ri, ci, vx, vy):
+            F_args = (B, ri, geom["Rhub"], geom["Rtip"], vx, vy)
+            phi = _solve_phi(th, clt, cdt, aoa_grid, sp, F_args)
+            alpha = phi - th
+            cl = jnp.interp(alpha * _RAD2DEG, aoa_grid, clt)
+            cd = jnp.interp(alpha * _RAD2DEG, aoa_grid, cdt)
+            _, a, ap, F = _induction(phi, cl, cd, sp, F_args)
+            W2 = (vx * (1 - a)) ** 2 + (vy * (1 + ap)) ** 2
+            Np = (cl * jnp.cos(phi) + cd * jnp.sin(phi)) * 0.5 * env["rho"] * W2 * ci
+            Tp = (cl * jnp.sin(phi) - cd * jnp.cos(phi)) * 0.5 * env["rho"] * W2 * ci
+            return Np, Tp
+
+        Np, Tp = jax.vmap(one_section)(
+            theta, cl_tab, cd_tab, sigma_p, r, chord, Vx, Vy
+        )
+        return Np, Tp
+
+    Np_all, Tp_all = jax.vmap(one_azimuth)(azimuths)   # [nSector, n_span]
+
+    # integrate to thrust/torque with zero-load extensions at hub and tip
+    # (CCBlade thrusttorque)
+    rfull = jnp.concatenate(
+        [jnp.array([geom["Rhub"]]), r, jnp.array([geom["Rtip"]])]
+    )
+    pc = geom["precurve"]
+    ps = geom["presweep"]
+    pcfull = jnp.concatenate([pc[:1], pc, pc[-1:]])
+    psfull = jnp.concatenate([ps[:1], ps, ps[-1:]])
+    _, _, z_az, cone, s = _define_curvature(rfull, pcfull, psfull, geom["precone"])
+
+    def integrate(loads):
+        lfull = jnp.concatenate([jnp.zeros(1), loads, jnp.zeros(1)])
+        thrust = jnp.trapezoid(lfull * jnp.cos(cone), s)
+        torque = jnp.trapezoid(lfull * z_az, s)
+        return thrust, torque
+
+    T_az, Q_az = jax.vmap(lambda Np, Tp: (integrate(Np)[0], integrate(Tp)[1]))(
+        Np_all, Tp_all
+    )
+    T = B * jnp.mean(T_az)
+    Q = B * jnp.mean(Q_az)
+    P = Q * Omega
+
+    q = 0.5 * env["rho"] * Uinf**2
+    A = jnp.pi * geom["Rtip"] ** 2
+    return {
+        "T": T, "Q": Q, "P": P,
+        "CT": T / (q * A), "CQ": Q / (q * geom["Rtip"] * A),
+        "CP": P / (q * Uinf * A),
+    }
+
+
+# ---------------------------------------------------------------- Rotor
+
+class Rotor:
+    """Rotor aerodynamics + control for the frequency-domain model
+    (reference raft/raft_rotor.py:35-489)."""
+
+    def __init__(self, turbine, w):
+        self.w = np.array(w)
+        self.Zhub = float(turbine["Zhub"])
+        self.shaft_tilt = float(turbine["shaft_tilt"])     # deg
+        self.overhang = float(turbine.get("overhang", 0.0))
+        self.R_rot = float(turbine["blade"]["Rtip"])
+        self.I_drivetrain = float(turbine["I_drivetrain"])
+        self.aeroServoMod = get_from_dict(turbine, "aeroServoMod", default=1)
+
+        # operating schedule, extended with parked entries
+        # (raft_rotor.py:51-61)
+        self.Uhub = np.array(turbine["wt_ops"]["v"], float)
+        self.Omega_rpm = np.array(turbine["wt_ops"]["omega_op"], float)
+        self.pitch_deg = np.array(turbine["wt_ops"]["pitch_op"], float)
+        self.Uhub = np.r_[self.Uhub, self.Uhub.max() * 1.4, 100]
+        self.Omega_rpm = np.r_[self.Omega_rpm, 0, 0]
+        self.pitch_deg = np.r_[self.pitch_deg, 90, 90]
+
+        # geometry
+        gt = np.array(turbine["blade"]["geometry"], float)
+        self.geom = dict(
+            r=jnp.asarray(gt[:, 0]),
+            chord=jnp.asarray(gt[:, 1]),
+            theta=jnp.asarray(np.deg2rad(gt[:, 2])),
+            precurve=jnp.asarray(gt[:, 3]),
+            presweep=jnp.asarray(gt[:, 4]),
+            Rhub=float(turbine["Rhub"]),
+            Rtip=float(turbine["blade"]["Rtip"]),
+            B=int(turbine["nBlades"]),
+            precone=float(np.deg2rad(turbine["precone"])),
+            tilt=float(np.deg2rad(self.shaft_tilt)),
+            yaw=0.0,
+            hubHt=float(turbine["Zhub"]),
+            shearExp=float(turbine["shearExp"]),
+        )
+        self.env = dict(rho=float(turbine["rho_air"]), mu=float(turbine["mu_air"]))
+
+        aoa, cl, cd, cm = build_airfoils(turbine, n_span=gt.shape[0])
+        self.polars = (
+            jnp.asarray(aoa), jnp.asarray(cl), jnp.asarray(cd), jnp.asarray(cm),
+        )
+
+        self.set_control_gains(turbine)
+
+        # jit the loads+derivatives evaluation once (CPU backend via input
+        # placement; tiny arrays)
+        cpu = jax.devices("cpu")[0]
+        self._cpu = cpu
+        geom = {
+            k: (jax.device_put(v, cpu) if isinstance(v, jnp.ndarray) else v)
+            for k, v in self.geom.items()
+        }
+        polars = tuple(jax.device_put(p, cpu) for p in self.polars)
+        env = self.env
+
+        def loads_TQ(U, Om, pitch, tilt, yaw):
+            g = dict(geom)
+            g["tilt"] = tilt
+            g["yaw"] = yaw
+            out = rotor_evaluate(U, Om, pitch, g, polars, env)
+            return jnp.stack([out["T"], out["Q"], out["P"],
+                              out["CP"], out["CT"], out["CQ"]])
+
+        def loads_and_derivs(U, Om, pitch, tilt, yaw):
+            vals = loads_TQ(U, Om, pitch, tilt, yaw)
+            JT = jax.jacfwd(lambda a: loads_TQ(*a, tilt, yaw))(
+                jnp.stack([U, Om, pitch])
+            )  # [6 outputs, 3 inputs]
+            return vals, JT
+
+        self._eval = jax.jit(loads_and_derivs)
+
+    # -------------------------------------------------------------- control
+
+    def set_control_gains(self, turbine):
+        """ROSCO-convention gain schedules (reference raft_rotor.py:309-323)."""
+        pc = turbine.get("pitch_control", None)
+        if pc is None:
+            self.kp_0 = np.zeros_like(self.Uhub)
+            self.ki_0 = np.zeros_like(self.Uhub)
+            self.k_float = 0.0
+            self.kp_tau = 0.0
+            self.ki_tau = 0.0
+            self.Ng = 1.0
+            return
+        pc_angles = np.array(pc["GS_Angles"]) * _RAD2DEG
+        self.kp_0 = np.interp(self.pitch_deg, pc_angles, pc["GS_Kp"], left=0, right=0)
+        self.ki_0 = np.interp(self.pitch_deg, pc_angles, pc["GS_Ki"], left=0, right=0)
+        self.k_float = -pc["Fl_Kp"]
+        self.kp_tau = -turbine["torque_control"]["VS_KP"]
+        self.ki_tau = -turbine["torque_control"]["VS_KI"]
+        self.Ng = turbine["gear_ratio"]
+
+    # -------------------------------------------------------------- BEM
+
+    def run_bem(self, Uhub, ptfm_pitch=0.0, yaw_misalign=0.0):
+        """Steady loads and SI derivatives at the operating point for wind
+        speed Uhub (reference raft_rotor.py:213-306 runCCBlade).
+
+        Returns (loads dict, derivs dict) with derivatives already in SI
+        (d/dU [m/s], d/dOmega [rad/s], d/dpitch [rad]).
+        """
+        Omega_rpm = np.interp(Uhub, self.Uhub, self.Omega_rpm)
+        pitch_deg = np.interp(Uhub, self.Uhub, self.pitch_deg)
+        tilt = np.deg2rad(self.shaft_tilt) + ptfm_pitch
+
+        put = lambda x: jax.device_put(jnp.float64(x), self._cpu)
+        vals, J = self._eval(
+            put(Uhub), put(Omega_rpm * np.pi / 30.0),
+            put(np.deg2rad(pitch_deg)), put(tilt),
+            put(np.deg2rad(yaw_misalign)),
+        )
+        vals = np.asarray(vals)
+        J = np.asarray(J)
+
+        self.U_case = Uhub
+        self.Omega_case = Omega_rpm
+        self.pitch_case = pitch_deg
+        self.aero_torque = vals[1]
+        self.aero_power = vals[2]
+
+        loads = dict(
+            T=vals[0], Q=vals[1], P=vals[2], CP=vals[3], CT=vals[4], CQ=vals[5],
+            # side forces/moments not computed by this hub-loads model
+            Y=0.0, Z=0.0, My=0.0, Mz=0.0,
+        )
+        derivs = dict(
+            dT_dU=J[0, 0], dT_dOm=J[0, 1], dT_dPi=J[0, 2],
+            dQ_dU=J[1, 0], dQ_dOm=J[1, 1], dQ_dPi=J[1, 2],
+        )
+        return loads, derivs
+
+    # ---------------------------------------------------- aero-servo terms
+
+    def calc_aero_servo_contributions(self, case, ptfm_pitch=0.0):
+        """Mean loads + frequency-dependent aero-servo added mass a(w),
+        damping b(w), and wind excitation f(w) about the hub
+        (reference raft_rotor.py:327-489).
+
+        Returns (F_aero0[6], f_aero[nw] complex, a_aero[nw], b_aero[nw]).
+        """
+        loads, d = self.run_bem(
+            case["wind_speed"], ptfm_pitch=ptfm_pitch,
+            yaw_misalign=case.get("yaw_misalign", 0.0),
+        )
+        Uinf = case["wind_speed"]
+        w = self.w
+
+        dT_dU, dT_dOm, dT_dPi = d["dT_dU"], d["dT_dOm"], d["dT_dPi"]
+        dQ_dU, dQ_dOm, dQ_dPi = d["dQ_dU"], d["dQ_dOm"], d["dQ_dPi"]
+
+        # mean load vector — moment ordering kept as the reference has it
+        # ([T, Y, Z, My, Q, Mz], raft_rotor.py:350-351)
+        F_aero0 = np.array(
+            [loads["T"], loads["Y"], loads["Z"], loads["My"], loads["Q"],
+             loads["Mz"]]
+        )
+
+        _, _, _, S_rot = kaimal_rotor_spectrum(
+            w, Uinf, self.Zhub, self.R_rot, case["turbulence"]
+        )
+        self.V_w = np.sqrt(S_rot)
+
+        if self.aeroServoMod == 1:
+            a_aero = np.zeros_like(w)
+            b_aero = np.zeros_like(w) + dT_dU
+            f_aero = dT_dU * self.V_w
+            self.C = np.zeros_like(w, dtype=complex)
+        elif self.aeroServoMod == 2:
+            self.kp_beta = -np.interp(Uinf, self.Uhub, self.kp_0)
+            self.ki_beta = -np.interp(Uinf, self.Uhub, self.ki_0)
+            # reference quirk: ki_tau assigned from kp_tau (raft_rotor.py:375)
+            kp_tau = self.kp_tau * (self.kp_beta == 0)
+            ki_tau = self.kp_tau * (self.kp_beta == 0)
+
+            D = (
+                self.I_drivetrain * w**2
+                + (dQ_dOm + self.kp_beta * dQ_dPi - self.Ng * kp_tau) * 1j * w
+                + self.ki_beta * dQ_dPi
+                - self.Ng * ki_tau
+            )
+            self.C = 1j * w * (dQ_dU - self.k_float * dQ_dPi / self.Zhub) / D
+
+            H_QT = (
+                (dT_dOm + self.kp_beta * dT_dPi) * 1j * w
+                + self.ki_beta * dT_dPi
+            ) / D
+            self.c_exc = dT_dU - H_QT * dQ_dU
+
+            f_aero = (dT_dU - H_QT * dQ_dU) * self.V_w
+            b_aero = np.real(
+                dT_dU - self.k_float * dT_dPi
+                - H_QT * (dQ_dU - self.k_float * dQ_dPi)
+            )
+            a_aero = np.real(
+                (dT_dU - self.k_float * dT_dPi
+                 - H_QT * (dQ_dU - self.k_float * dQ_dPi)) / (1j * w)
+            )
+        else:
+            raise ValueError(f"aeroServoMod={self.aeroServoMod} not supported here")
+
+        return F_aero0, f_aero, a_aero, b_aero

@@ -11,8 +11,10 @@ Everything here is plain NumPy float64 and runs once per design; only the
 packed arrays go to device.
 """
 
+import dataclasses
 from dataclasses import dataclass, field
 
+import jax
 import numpy as np
 
 from raft_tpu.io.schema import get_from_dict
@@ -337,6 +339,22 @@ class HydroNodes:
     Cd_End: np.ndarray
     submerged: np.ndarray   # [N] bool
     strip_mask: np.ndarray  # [N] bool
+
+    def astype(self, dtype):
+        """Copy with all float arrays cast to ``dtype`` (masks stay bool) —
+        used to stage the node bundle into a f32 TPU graph or f64 CPU graph."""
+        out = {}
+        for f in dataclasses.fields(self):
+            a = getattr(self, f.name)
+            out[f.name] = a if a.dtype == bool else np.asarray(a, dtype)
+        return HydroNodes(**out)
+
+
+jax.tree_util.register_dataclass(
+    HydroNodes,
+    data_fields=[f.name for f in dataclasses.fields(HydroNodes)],
+    meta_fields=[],
+)
 
 
 def pack_nodes(members):

@@ -279,3 +279,27 @@ def tension_jacobian(r6, anchors, rFair, L, EA, w):
     """J_moor = d tensions / d r6  [2 nL, 6] (reference raft_model.py:366,
     consumed for tension FFTs at :273-283)."""
     return jax.jacfwd(lambda r: line_tensions(r, anchors, rFair, L, EA, w))(r6)
+
+
+def case_mooring(f6_ext, m, v, rCG, rM, AWP, anchors, rFair, L, EA, w,
+                 rho=1025.0, g=9.81, yawstiff=0.0):
+    """One-shot per-case mooring analysis: equilibrium pose plus all the
+    linearized quantities the dynamics solve consumes
+    (reference raft/raft_model.py:332-392 calcMooringAndOffsets).
+
+    Designed to be jitted once and vmapped over the case axis of ``f6_ext``
+    (per-case mean aero loads) — every Model.analyze_cases call then reuses
+    the same compiled executable instead of retracing the autodiff-through-
+    catenary graphs per case.
+
+    Returns (r6, C_moor, F_moor, T_moor, J_moor).
+    """
+    r6 = solve_equilibrium(
+        f6_ext, (m, v, rCG, rM, AWP), anchors, rFair, L, EA, w, rho=rho, g=g
+    )
+    C_moor = coupled_stiffness(r6, anchors, rFair, L, EA, w)
+    C_moor = C_moor.at[5, 5].add(yawstiff)
+    F_moor = line_forces(r6, anchors, rFair, L, EA, w)[0]
+    T_moor = line_tensions(r6, anchors, rFair, L, EA, w)
+    J_moor = tension_jacobian(r6, anchors, rFair, L, EA, w)
+    return r6, C_moor, F_moor, T_moor, J_moor

@@ -1,0 +1,118 @@
+"""IEC 61400-1 wind turbulence models and the rotor-averaged Kaimal spectrum.
+
+Provides the subset of the reference's pyIECWind + Rotor.IECKaimal that the
+spectral-domain path consumes (reference raft/pyIECWind.py:25-77 setup/NTM/
+ETM/EWM; raft/raft_rotor.py:551-643 IECKaimal).  Host-side (runs once per
+load case); the rotor-averaging needs modified Struve / Bessel functions,
+taken from scipy.special here — a JAX implementation is only needed if the
+whole aero path moves on-device for design sweeps.
+"""
+
+import numpy as np
+from scipy.special import iv, modstruve
+
+_TURBINE_CLASS_VREF = {"I": 50.0, "II": 42.5, "III": 37.5, "IV": 30.0}
+_TURBULENCE_CLASS_IREF = {"A+": 0.18, "A": 0.16, "B": 0.14, "C": 0.12}
+
+
+class IECWind:
+    """IEC extreme/normal turbulence parameters
+    (reference raft/pyIECWind.py:8-77)."""
+
+    def __init__(self, turbine_class="I", turbulence_class="B", z_hub=90.0):
+        self.turbine_class = turbine_class
+        self.turbulence_class = turbulence_class
+        self.z_hub = z_hub
+        self.V_ref = _TURBINE_CLASS_VREF[turbine_class]
+        self.V_ave = 0.2 * self.V_ref
+        self.I_ref = _TURBULENCE_CLASS_IREF[turbulence_class]
+        self.Sigma_1 = 42.0 if z_hub > 60 else 0.7 * z_hub
+
+    def NTM(self, V_hub):
+        """Normal turbulence model sigma_1 (IEC 6.3.1.3)."""
+        return self.I_ref * (0.75 * V_hub + 5.6)
+
+    def ETM(self, V_hub):
+        """Extreme turbulence model sigma_1 (IEC 6.3.2.3)."""
+        c = 2.0
+        return c * self.I_ref * (0.072 * (self.V_ave / c + 3) * (V_hub / c - 4) + 10)
+
+    def EWM(self, V_hub):
+        """Extreme wind model sigma_1 (IEC 6.3.2.1)."""
+        return 0.11 * V_hub
+
+
+def parse_turbulence(turbulence):
+    """Decode the case 'turbulence' entry: either a float turbulence
+    intensity (NTM assumed) or a class string like 'IB_NTM'
+    (reference raft/raft_rotor.py:566-596).
+
+    Returns (I_ref or None, turbine_class, turbulence_class, model).
+    """
+    if isinstance(turbulence, (int, float)):
+        return float(turbulence), "I", "B", "NTM"
+    s = str(turbulence)
+    cls = ""
+    for ch in s:
+        if ch in ("I", "V"):
+            cls += ch
+        else:
+            break
+    if not cls:
+        raise ValueError(
+            f"Turbulence class must start with I, II, III, or IV: {turbulence}"
+        )
+    categ = s[len(cls)]
+    try:
+        model = s.split("_")[1]
+    except IndexError:
+        raise ValueError(f"Error reading the turbulence model: {turbulence}")
+    return None, cls, categ, model
+
+
+def kaimal_rotor_spectrum(w, V_ref, HH, R, turbulence):
+    """Rotor-averaged Kaimal turbulence spectra (U, V, W, Rot) at frequencies
+    w [rad/s] (reference raft/raft_rotor.py:551-643).
+
+    V_ref : hub wind speed; HH : hub height; R : rotor radius;
+    turbulence : case turbulence entry (intensity float or 'IB_NTM' style).
+    Returns (U, V, W, Rot) PSDs [(m/s)^2 / (rad/s)] — Rot is the
+    rotor-averaged longitudinal spectrum used for thrust excitation.
+    """
+    f = np.asarray(w) / 2 / np.pi
+
+    I_ref_override, cls, categ, model = parse_turbulence(turbulence)
+    iec = IECWind(cls, categ, z_hub=HH)
+    if I_ref_override is not None:
+        iec.I_ref = I_ref_override
+        model = "NTM"
+
+    if model == "NTM":
+        sigma_1 = iec.NTM(V_ref)
+    elif model == "ETM":
+        sigma_1 = iec.ETM(V_ref)
+    elif model == "EWM":
+        sigma_1 = iec.EWM(V_ref)
+    else:
+        raise ValueError(f"Wind model must be NTM, ETM, or EWM, not {model}")
+
+    # turbulence scale parameters, IEC 61400-1-2019 Annex C3
+    L_1 = 0.7 * HH if HH <= 60 else 42.0
+    sigma_u, L_u = sigma_1, 8.1 * L_1
+    sigma_v, L_v = 0.8 * sigma_1, 2.7 * L_1
+    sigma_w, L_w = 0.5 * sigma_1, 0.66 * L_1
+
+    U = (4 * L_u / V_ref) * sigma_u**2 / (1 + 6 * f * L_u / V_ref) ** (5.0 / 3.0)
+    V = (4 * L_v / V_ref) * sigma_v**2 / (1 + 6 * f * L_v / V_ref) ** (5.0 / 3.0)
+    W = (4 * L_w / V_ref) * sigma_w**2 / (1 + 6 * f * L_w / V_ref) ** (5.0 / 3.0)
+
+    kappa = 12 * np.sqrt((f / V_ref) ** 2 + (0.12 / L_u) ** 2)
+
+    with np.errstate(over="ignore", invalid="ignore"):
+        Rot = (2 * U / (R * kappa) ** 3) * (
+            modstruve(1, 2 * R * kappa) - iv(1, 2 * R * kappa) - 2 / np.pi
+            + R * kappa
+            * (-2 * modstruve(-2, 2 * R * kappa) + 2 * iv(2, 2 * R * kappa) + 1)
+        )
+    Rot = np.nan_to_num(Rot, nan=0.0, posinf=0.0, neginf=0.0)
+    return U, V, W, Rot
