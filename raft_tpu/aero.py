@@ -220,21 +220,28 @@ def _solve_phi(theta, cl_tab, cd_tab, aoa_grid, sigma_p, F_args,
     r_lo = resid(eps)
     r_hi = resid(jnp.pi / 2)
     primary = r_lo * r_hi <= 0
-    # fallback selection (ccblade.py __runBEM bracket logic)
-    r_neg = resid(-jnp.pi / 4)
-    use_neg = (~primary) & (r_neg < 0) & (r_lo > 0)
+    # fallback selection (Ning's bracket logic): the residual is
+    # discontinuous at phi=0 (momentum vs propeller-brake branch), so the
+    # negative bracket is tested with resid(-eps), NOT resid(+eps)
+    r_neg_lo = resid(-jnp.pi / 4)
+    r_neg_hi = resid(-eps)
+    use_neg = (~primary) & (r_neg_lo < 0) & (r_neg_hi > 0)
     lo = jnp.where(primary, eps, jnp.where(use_neg, -jnp.pi / 4, jnp.pi / 2))
     hi = jnp.where(primary, jnp.pi / 2, jnp.where(use_neg, -eps, jnp.pi - eps))
+    rl0 = jnp.where(primary, r_lo, jnp.where(use_neg, r_neg_lo, r_hi))
 
     def bis_body(_, state):
-        lo, hi = state
+        lo, hi, rl = state
         mid = 0.5 * (lo + hi)
         rm = resid(mid)
-        rl = resid(lo)
         same = rl * rm > 0
-        return jnp.where(same, mid, lo), jnp.where(same, hi, mid)
+        return (
+            jnp.where(same, mid, lo),
+            jnp.where(same, hi, mid),
+            jnp.where(same, rm, rl),
+        )
 
-    lo, hi = jax.lax.fori_loop(0, n_bisect, bis_body, (lo, hi))
+    lo, hi, _ = jax.lax.fori_loop(0, n_bisect, bis_body, (lo, hi, rl0))
     phi = jax.lax.stop_gradient(0.5 * (lo + hi))
 
     dresid = jax.grad(resid)

@@ -1,0 +1,189 @@
+"""Potential-flow (BEM) coefficient interop: WAMIT-format readers, writers,
+and interpolation onto the model frequency grid.
+
+Replaces the pyHAMS reader path the reference consumes
+(reference raft/raft_fowt.py:394-420 calcBEM reading WAMIT `.1`/`.3` output
+and interpolating onto the RAFT grid; tests/verification.py:240-254 reading
+the OC3/OC4 golden files) so externally computed radiation/diffraction
+coefficients — from WAMIT, HAMS, Capytaine, or our native solver — flow into
+the batched dynamics pipeline as frequency-dependent A(w), B(w) and
+excitation X(w).
+
+File conventions (WAMIT v6+ numeric output, ULEN = 1):
+  `.1` rows:  PER  I  J  Abar(I,J)  [Bbar(I,J)]
+      PER > 0: A = rho * Abar,  B = rho * omega * Bbar
+      PER = 0 (omega = inf) and PER < 0 (omega = 0): added mass only.
+  `.3` rows:  PER  BETA  I  MOD  PHA  RE  IM  ->  X = rho * g * (RE + i IM)
+
+Pure NumPy, host side; the outputs are plain arrays fed into
+Model.prepare_case_inputs.
+"""
+
+from dataclasses import dataclass
+
+import numpy as np
+
+
+@dataclass
+class HydroCoeffs:
+    """Radiation/diffraction coefficient set on its native frequency grid.
+
+    A [nw, 6, 6]  : added mass (dimensional, kg / kg m / kg m^2)
+    B [nw, 6, 6]  : radiation damping
+    w [nw]        : rad/s, ascending
+    A0, Ainf      : zero-/infinite-frequency added mass if present, else None
+    headings [nh] : wave headings (deg) of the excitation data
+    X [nw, nh, 6] : complex excitation force per unit amplitude
+    """
+
+    w: np.ndarray
+    A: np.ndarray
+    B: np.ndarray
+    headings: np.ndarray = None
+    X: np.ndarray = None
+    A0: np.ndarray = None
+    Ainf: np.ndarray = None
+
+
+def read_wamit_1(path, rho=1025.0):
+    """Read a WAMIT `.1` added-mass/damping file -> (w, A, B, A0, Ainf).
+
+    Accepts both 4-column (A only, zero/infinite frequency) and 5-column
+    rows; damping is dimensionalized with the rho*omega WAMIT convention.
+    """
+    per, ij, vals = [], [], []
+    with open(path) as f:
+        rows = [ln.split() for ln in f if ln.strip()]
+    A0 = np.zeros((6, 6))
+    Ainf = np.zeros((6, 6))
+    has_A0 = has_Ainf = False
+    finite = {}
+    for row in rows:
+        T = float(row[0])
+        i, j = int(row[1]) - 1, int(row[2]) - 1
+        a = float(row[3])
+        if T == 0.0:            # omega = infinity
+            Ainf[i, j] = rho * a
+            has_Ainf = True
+        elif T < 0.0:           # omega = 0
+            A0[i, j] = rho * a
+            has_A0 = True
+        else:
+            b = float(row[4]) if len(row) > 4 else 0.0
+            finite.setdefault(T, []).append((i, j, a, b))
+    periods = sorted(finite.keys(), reverse=True)      # ascending omega
+    w = 2.0 * np.pi / np.array(periods)
+    nw = len(w)
+    A = np.zeros((nw, 6, 6))
+    B = np.zeros((nw, 6, 6))
+    for iw, T in enumerate(periods):
+        for i, j, a, b in finite[T]:
+            A[iw, i, j] = rho * a
+            B[iw, i, j] = rho * w[iw] * b
+    return w, A, B, (A0 if has_A0 else None), (Ainf if has_Ainf else None)
+
+
+def read_wamit_3(path, rho=1025.0, g=9.81):
+    """Read a WAMIT `.3` excitation file -> (w, headings_deg, X[nw, nh, 6])."""
+    data = {}
+    heads = set()
+    with open(path) as f:
+        for ln in f:
+            row = ln.split()
+            if not row:
+                continue
+            T = float(row[0])
+            beta = float(row[1])
+            i = int(row[2]) - 1
+            re, im = float(row[5]), float(row[6])
+            data[(T, beta, i)] = re + 1j * im
+            heads.add(beta)
+    periods = sorted({k[0] for k in data}, reverse=True)
+    headings = np.array(sorted(heads))
+    w = 2.0 * np.pi / np.array(periods)
+    X = np.zeros((len(w), len(headings), 6), complex)
+    for iw, T in enumerate(periods):
+        for ih, beta in enumerate(headings):
+            for i in range(6):
+                X[iw, ih, i] = rho * g * data.get((T, beta, i), 0.0)
+    return w, headings, X
+
+
+def read_coeffs(file1, file3=None, rho=1025.0, g=9.81):
+    """Load a coefficient set from WAMIT-format files."""
+    w, A, B, A0, Ainf = read_wamit_1(file1, rho=rho)
+    headings = X = None
+    if file3 is not None:
+        w3, headings, X3 = read_wamit_3(file3, rho=rho, g=g)
+        if len(w3) != len(w) or not np.allclose(w3, w, rtol=1e-6):
+            # re-interpolate excitation onto the .1 grid
+            X = np.empty((len(w), len(headings), 6), complex)
+            for ih in range(len(headings)):
+                for i in range(6):
+                    X[:, ih, i] = np.interp(w, w3, X3[:, ih, i].real) + 1j * np.interp(
+                        w, w3, X3[:, ih, i].imag
+                    )
+        else:
+            X = X3
+    return HydroCoeffs(w=w, A=A, B=B, headings=headings, X=X, A0=A0, Ainf=Ainf)
+
+
+def write_wamit_1(path, coeffs, rho=1025.0):
+    """Write the `.1` format (round-trip/interop; inverse of read_wamit_1)."""
+    with open(path, "w") as f:
+        for iw, wi in enumerate(coeffs.w):
+            T = 2.0 * np.pi / wi
+            for i in range(6):
+                for j in range(6):
+                    a = coeffs.A[iw, i, j] / rho
+                    b = coeffs.B[iw, i, j] / (rho * wi)
+                    if a != 0.0 or b != 0.0:
+                        f.write(
+                            f"{T:14.6E} {i+1:5d} {j+1:5d} {a:13.6E} {b:13.6E}\n"
+                        )
+
+
+def interp_to_grid(coeffs, w, beta=0.0):
+    """Interpolate a HydroCoeffs set onto the model grid `w` [rad/s].
+
+    Mirrors the reference's semantics (raft/raft_fowt.py:398-406): added
+    mass is extended toward omega=0 with the zero-frequency value when
+    available (else the lowest-frequency value), damping tends to zero at
+    omega=0, excitation is linearly interpolated; out-of-range frequencies
+    clamp to the nearest data (np.interp semantics).  NaNs raise, matching
+    the reference's guards (raft_fowt.py:409-420).
+
+    beta : wave heading (deg) — the nearest heading in the data is used
+    (the reference supports only one heading; per-case selection here).
+
+    Returns (A[nw,6,6], B[nw,6,6], X[nw,6] complex).
+    """
+    wB = coeffs.w
+    nw = len(w)
+    A = np.empty((nw, 6, 6))
+    B = np.empty((nw, 6, 6))
+    A_lo = coeffs.A0 if coeffs.A0 is not None else coeffs.A[0]
+    wA = np.concatenate([[0.0], wB])
+    for i in range(6):
+        for j in range(6):
+            A[:, i, j] = np.interp(
+                w, wA, np.concatenate([[A_lo[i, j]], coeffs.A[:, i, j]])
+            )
+            B[:, i, j] = np.interp(
+                w, np.concatenate([[0.0], wB]),
+                np.concatenate([[0.0], coeffs.B[:, i, j]]),
+            )
+    X = np.zeros((nw, 6), complex)
+    if coeffs.X is not None:
+        ih = int(np.argmin(np.abs(np.asarray(coeffs.headings) - beta)))
+        for i in range(6):
+            X[:, i] = np.interp(w, wB, coeffs.X[:, ih, i].real) + 1j * np.interp(
+                w, wB, coeffs.X[:, ih, i].imag
+            )
+    for name, arr in (("added mass", A), ("damping", B), ("excitation", X)):
+        if np.isnan(arr).any():
+            raise Exception(
+                f"NaN values detected in BEM {name} coefficients. "
+                f"Check the input data."
+            )
+    return A, B, X

@@ -17,7 +17,7 @@ CPU); complex arrays never cross the jit boundary.
 import jax.numpy as jnp
 
 from raft_tpu.utils.frames import translate_matrix_3to6
-from raft_tpu.waves import get_psd, jonswap, wave_kinematics
+from raft_tpu.waves import jonswap
 
 
 def make_wave_spectrum(w, spectrum, height, period, dtype=None):
@@ -87,14 +87,6 @@ def excitation_froude_krylov(nodes, u, ud, pDyn, rho):
     return _sum_force_3to6(f3, nodes.r, nodes.strip_mask)
 
 
-def node_wave_kinematics(nodes, zeta, beta, w, k, depth, rho, g, dtype):
-    """Wave kinematics spectra at every node: u, ud [N,3,nw], pDyn [N,nw]
-    (reference raft/raft_fowt.py:517 calling helpers.getWaveKin per node).
-    Above-surface nodes yield zeros via the submergence mask in
-    wave_kinematics."""
-    return wave_kinematics(zeta, beta, w, k, depth, nodes.r, rho=rho, g=g, dtype=dtype)
-
-
 def linearized_drag(nodes, Xi, u, w, dw, rho):
     """Amplitude-dependent stochastic drag linearization
     (reference raft/raft_fowt.py:595-703, HOT LOOP #2).
@@ -161,8 +153,3 @@ def linearized_drag(nodes, Xi, u, w, dw, rho):
     f3 = jnp.einsum("nij,njw->niw", Bmat.astype(u.dtype), u)
     F_drag = _sum_force_3to6(f3, nodes.r, nodes.submerged)
     return B_drag, F_drag
-
-
-def wave_psd_outputs(zeta):
-    """Wave elevation PSD channel (reference raft/raft_fowt.py:775)."""
-    return get_psd(zeta)

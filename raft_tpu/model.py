@@ -41,11 +41,10 @@ from raft_tpu.mooring import (
 from raft_tpu.statics import compute_statics, member_inertia
 from raft_tpu.utils.frames import (
     transform_force,
-    translate_force_3to6,
     translate_matrix_3to6,
     translate_matrix_6to6,
 )
-from raft_tpu.waves import jonswap, wave_kinematics, wave_number
+from raft_tpu.waves import wave_kinematics, wave_number
 
 _RAD2DEG = 57.29577951308232
 
@@ -127,6 +126,7 @@ class Model:
         self.results = {}
         self._pipeline = None
         self._moor_case_fn = None
+        self.bem_coeffs = None
 
     # ------------------------------------------------------------------
     # statics / unloaded analysis
@@ -155,6 +155,20 @@ class Model:
         self.Xi0_unloaded = Xi0
         self.results["properties"]["offset_unloaded"] = Xi0
         return self.results
+
+    def import_bem(self, file1, file3=None):
+        """Load potential-flow radiation/diffraction coefficients from
+        WAMIT-format `.1`/`.3` files (the reference's pyHAMS output-reading
+        path, raft/raft_fowt.py:394-406; also the WAMIT/Capytaine interop
+        route shown by tests/verification.py:240-254).  Members flagged
+        ``potMod`` are already excluded from strip-theory inertial terms via
+        the packed ``strip_mask``."""
+        from raft_tpu.bem import read_coeffs
+
+        self.bem_coeffs = read_coeffs(
+            file1, file3, rho=self.rho_water, g=self.g
+        )
+        return self.bem_coeffs
 
     def _added_mass_f64(self):
         cpu = jax.devices("cpu")[0]
@@ -276,8 +290,14 @@ class Model:
             )
         )
 
-    def _build_pipeline(self):
-        """The single jitted device graph: [case] -> Xi, F_iner."""
+    def case_pipeline_fn(self):
+        """The (un-jitted) batched device function for the case dynamics:
+        (zeta[nc,nw], beta[nc], C_lin[nc,6,6], M_lin[nc,nw,6,6],
+        B_lin[nc,nw,6,6], F_add_r[nc,nw,6], F_add_i[nc,nw,6])
+        -> (Xi_r[nc,6,nw], Xi_i[nc,6,nw], iters[nc], conv[nc]).
+
+        Exposed separately so the driver entry point and the multichip dryrun
+        can jit it with explicit shardings."""
         dtype, cdtype = self.dtype, self.cdtype
         nodes = self.nodes.astype(dtype)
         w = self.w.astype(dtype)
@@ -303,21 +323,30 @@ class Model:
             )
             return xr, xi, iters, conv
 
-        batched = jax.vmap(one_case)
-        return jax.jit(batched)
+        return jax.vmap(one_case)
 
-    def analyze_cases(self, display=0, runPyHAMS=False, meshDir=None):
-        """Run all load cases: per-case statics (aero means + mooring
-        equilibrium), batched dynamics solve, and response metrics
-        (reference raft/raft_model.py:149-309)."""
-        cases = cases_as_dicts(self.design)
+    def _build_pipeline(self):
+        """The single jitted device graph: [case] -> Xi, F_iner."""
+        return jax.jit(self.case_pipeline_fn())
+
+    def prepare_case_inputs(self, cases=None):
+        """Host-side setup for the batched case solve: per-case aero means,
+        mooring equilibrium/linearization, and assembly of the linear-term
+        arrays (reference solveStatics + the pre-sums at
+        raft/raft_model.py:504-555).
+
+        Returns (args, aux): ``args`` is the input tuple for
+        :meth:`case_pipeline_fn` (all NumPy, working dtype); ``aux`` carries
+        the per-case quantities the output stage needs.
+        """
+        if cases is None:
+            cases = cases_as_dicts(self.design)
         ncase = len(cases)
         if ncase == 0:
             raise ValueError("design has no cases table")
         if self.statics is None:
             self.analyze_unloaded()
 
-        nLines = self.ms.n_lines
         st = self.statics
 
         spec, height, period, beta, wind = self._case_arrays(cases)
@@ -392,18 +421,55 @@ class Model:
         F_add_r = np.zeros((ncase, self.nw, 6), self.dtype)  # BEM excitation slot
         F_add_i = np.zeros((ncase, self.nw, 6), self.dtype)
 
+        # ---- potential-flow coefficients (reference raft_fowt.py:486-495:
+        # A_BEM/B_BEM join the frequency-dependent linear terms and
+        # F_BEM = X_BEM * zeta joins the excitation) ----
+        if self.bem_coeffs is not None:
+            from raft_tpu.bem import interp_to_grid
+
+            for i in range(ncase):
+                A_bem, B_bem, X_bem = interp_to_grid(
+                    self.bem_coeffs, self.w, beta=np.rad2deg(beta[i])
+                )
+                M_lin[i] += A_bem.astype(self.dtype)
+                B_lin[i] += B_bem.astype(self.dtype)
+                F_bem = X_bem * zeta[i][:, None]
+                F_add_r[i] = np.real(F_bem).astype(self.dtype)
+                F_add_i[i] = np.imag(F_bem).astype(self.dtype)
+
+        args = (
+            zeta.astype(self.dtype),
+            beta.astype(self.dtype),
+            C_lin,
+            M_lin,
+            B_lin,
+            F_add_r,
+            F_add_i,
+        )
+        aux = dict(
+            cases=cases, ncase=ncase, zeta=zeta, Xi0=Xi0,
+            T_moor=T_moor, J_moor=J_moor, F_aero0=F_aero0,
+        )
+        return args, aux
+
+    def analyze_cases(self, display=0, runPyHAMS=False, meshDir=None):
+        """Run all load cases: per-case statics (aero means + mooring
+        equilibrium), batched dynamics solve, and response metrics
+        (reference raft/raft_model.py:149-309)."""
+        args, aux = self.prepare_case_inputs()
+        cases = aux["cases"]
+        ncase = aux["ncase"]
+        zeta = aux["zeta"]
+        Xi0 = aux["Xi0"]
+        T_moor = aux["T_moor"]
+        J_moor = aux["J_moor"]
+        F_aero0 = aux["F_aero0"]
+        nLines = self.ms.n_lines
+
         # ---- the batched device solve ----
         if self._pipeline is None:
             self._pipeline = self._build_pipeline()
-        xr, xi, iters, conv = self._pipeline(
-            jnp.asarray(zeta, self.dtype),
-            jnp.asarray(beta, self.dtype),
-            jnp.asarray(C_lin),
-            jnp.asarray(M_lin),
-            jnp.asarray(B_lin),
-            jnp.asarray(F_add_r),
-            jnp.asarray(F_add_i),
-        )
+        xr, xi, iters, conv = self._pipeline(*(jnp.asarray(a) for a in args))
         Xi = np.asarray(xr, np.float64) + 1j * np.asarray(xi, np.float64)  # [case,6,nw]
         self.Xi = Xi
         self.zeta = zeta
@@ -529,7 +595,9 @@ class Model:
 
         # rotor/control output spectra (reference raft_fowt.py:797-833)
         if rc is not None and self.aeroServoMod > 1 and case.get("wind_speed", 0) > 0:
-            radps2rpm = 1.0 / 0.1047  # the reference's rounded conversion
+            from raft_tpu.aero import _RPM2RADPS
+
+            radps2rpm = 1.0 / _RPM2RADPS
             phi_w = rc["C"] * (XiHub - rc["V_w"] / (1j * w))
             omega_w = 1j * w * phi_w
             m["omega_avg"][iCase] = rc["Omega_case"]

@@ -1,0 +1,66 @@
+"""Dual-path equivalence: the batched XLA case pipeline vs the
+reference-style single-core NumPy implementation (the pattern the reference
+uses for OMDAO-vs-YAML equivalence, tests/common.py:5-14, applied here to
+backend parity per SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.designs import deep_spar, demo_semi
+from raft_tpu.model import Model
+from raft_tpu.reference_numpy import rao_solve_numpy
+
+
+@pytest.fixture(scope="module", params=["spar", "semi"])
+def solved(request):
+    import jax
+
+    design = (
+        deep_spar(n_cases=2, nw_settings=(0.05, 0.6))
+        if request.param == "spar"
+        else demo_semi(n_cases=2, nw_settings=(0.05, 0.6))
+    )
+    model = Model(design, precision="float64")
+    model.analyze_unloaded()
+    args, aux = model.prepare_case_inputs()
+    fn = jax.jit(model.case_pipeline_fn())
+    xr, xi, iters, conv = fn(*(np.asarray(a) for a in args))
+    Xi_jax = np.asarray(xr) + 1j * np.asarray(xi)
+    Xi_np = rao_solve_numpy(
+        model.nodes.astype(np.float64), model.w, model.k, model.depth,
+        model.rho_water, model.g, *[np.asarray(a, np.float64) for a in args],
+        XiStart=model.XiStart, nIter=model.nIter,
+    )
+    return model, aux, Xi_jax, Xi_np, np.asarray(conv)
+
+
+def test_converged(solved):
+    _, _, _, _, conv = solved
+    assert conv.all()
+
+
+def test_xi_parity(solved):
+    """Response amplitudes agree to near machine precision in f64."""
+    _, _, Xi_jax, Xi_np, _ = solved
+    scale = np.abs(Xi_np).max()
+    assert np.max(np.abs(Xi_jax - Xi_np)) / scale < 1e-8
+
+
+def test_rao_parity(solved):
+    """RAO L-inf between paths well under the 1e-4 driver target."""
+    model, aux, Xi_jax, Xi_np, _ = solved
+    zeta = aux["zeta"]
+    mask = np.abs(zeta) > 1e-3
+    denom = np.where(mask, np.abs(zeta), np.inf)[:, None, :]
+    assert np.max(np.abs(np.abs(Xi_jax) / denom - np.abs(Xi_np) / denom)) < 1e-6
+
+
+def test_response_is_physical(solved):
+    """Surge RAO tends to ~1 at low frequency for a compliant platform and
+    rolls off at high frequency."""
+    model, aux, Xi_jax, _, _ = solved
+    zeta = aux["zeta"]
+    i = 0
+    rao = np.abs(Xi_jax[i, 0]) / np.maximum(np.abs(zeta[i]), 1e-12)
+    sel = np.abs(zeta[i]) > 1e-3
+    assert rao[sel][-1] < rao[sel][0]
