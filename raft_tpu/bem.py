@@ -131,6 +131,22 @@ def read_coeffs(file1, file3=None, rho=1025.0, g=9.81):
 def write_wamit_1(path, coeffs, rho=1025.0):
     """Write the `.1` format (round-trip/interop; inverse of read_wamit_1)."""
     with open(path, "w") as f:
+        if coeffs.A0 is not None:
+            for i in range(6):
+                for j in range(6):
+                    if coeffs.A0[i, j] != 0.0:
+                        f.write(
+                            f"{-1.0:14.6E} {i+1:5d} {j+1:5d} "
+                            f"{coeffs.A0[i, j] / rho:13.6E}\n"
+                        )
+        if coeffs.Ainf is not None:
+            for i in range(6):
+                for j in range(6):
+                    if coeffs.Ainf[i, j] != 0.0:
+                        f.write(
+                            f"{0.0:14.6E} {i+1:5d} {j+1:5d} "
+                            f"{coeffs.Ainf[i, j] / rho:13.6E}\n"
+                        )
         for iw, wi in enumerate(coeffs.w):
             T = 2.0 * np.pi / wi
             for i in range(6):
@@ -164,11 +180,17 @@ def interp_to_grid(coeffs, w, beta=0.0):
     B = np.empty((nw, 6, 6))
     A_lo = coeffs.A0 if coeffs.A0 is not None else coeffs.A[0]
     wA = np.concatenate([[0.0], wB])
+    if coeffs.Ainf is not None:
+        # anchor the high-frequency end at the tabulated omega=inf limit
+        # (placed just past the model grid so in-range data is untouched)
+        w_hi = max(wB[-1], np.max(w)) * 2.0
+        wA = np.concatenate([wA, [w_hi]])
     for i in range(6):
         for j in range(6):
-            A[:, i, j] = np.interp(
-                w, wA, np.concatenate([[A_lo[i, j]], coeffs.A[:, i, j]])
-            )
+            col = np.concatenate([[A_lo[i, j]], coeffs.A[:, i, j]])
+            if coeffs.Ainf is not None:
+                col = np.concatenate([col, [coeffs.Ainf[i, j]]])
+            A[:, i, j] = np.interp(w, wA, col)
             B[:, i, j] = np.interp(
                 w, np.concatenate([[0.0], wB]),
                 np.concatenate([[0.0], coeffs.B[:, i, j]]),

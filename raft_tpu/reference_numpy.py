@@ -37,7 +37,7 @@ def _wave_kin_node(zeta0, beta, w, k, h, r):
     c = (ekz + emk) / denom
     cc = (ekz + emk) / (1.0 + e2h)
     u = np.stack([w * zeta * c * cb, w * zeta * c * sb, 1j * w * zeta * s])
-    return u, 1j * w * u, 1025.0 / 1025.0 * zeta * cc  # pDyn scaled later
+    return u, 1j * w * u, zeta * cc  # pDyn: rho*g applied by the caller
 
 
 def _translate_matrix_3to6(Mat, r):
