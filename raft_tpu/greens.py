@@ -1,0 +1,233 @@
+"""Free-surface wave Green function for the native BEM solver.
+
+Infinite-depth first-order wave Green function (Wehausen & Laitone form):
+
+    G(x, xi) = 1/r + 1/r' + Gw,
+    Gw = 2 nu [ F(a, b) + i pi e^b J0(a) ],        a = nu R,  b = nu (z+zeta) <= 0
+    F(a, b) = PV int_0^inf e^{bt} J0(at) / (t-1) dt
+
+with nu = omega^2/g, r the direct distance, r' the free-surface-image
+distance, R the horizontal distance.  This replaces the reference's external
+Fortran BEM solver HAMS (invoked at reference raft/raft_fowt.py:367-395) with
+a TPU-resident formulation: the transcendental kernel F (and the J1-weighted
+companion F1 used for the R-derivative) is precomputed ONCE on host into
+dense tables over nondimensional (a, b), and on device the N^2 x n_omega
+influence evaluations are pure bilinear table lookups + Bessel/exponential
+math — MXU/VPU-friendly with static shapes.
+
+Key identity used for tabulation (verified in tests/test_greens.py):
+
+    PV int_0^inf e^{tw}/(t-1) dt = e^w (E1(w) + i pi),   Re w <= 0, Im w >= 0
+
+so with J0(at) = Re[(1/pi) int_0^pi e^{i a t sin th} d th]:
+
+    F(a,b)  = Re[(1/pi) int_0^pi C(b + i a sin th) d th]
+    F1(a,b) = Re[(1/pi) int_0^pi e^{-i th} C(b + i a sin th) d th]
+              (J1 companion:  PV int e^{bt} J1(at)/(t-1) dt)
+
+Derivatives follow from the analytic Laplace transforms
+L  = int e^{bt} J0(at) dt = 1/s,          s = sqrt(a^2+b^2)
+La = int e^{bt} J1(at) dt = (1 + b/s)/a:
+
+    dF/db = L + F
+    dF/da = -(La + F1)
+
+Finite depth is handled by the caller at the physics level (strip theory uses
+exact finite-depth kinematics; the BEM path documents its deep-water
+assumption — the reference's own verification cases are deep-water spars).
+"""
+
+import os
+
+import numpy as np
+
+_TABLE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "data", "greens_tables.npz")
+
+# table extents: a = nu*R in [0, A_MAX] (uniform), b = nu*(z+zeta) in
+# [-B_MAX, 0] on a log grid y = log(-b), y in [Y_MIN, Y_MAX]
+A_MAX = 100.0
+NA = 1001
+Y_MIN, Y_MAX = np.log(1e-5), np.log(40.0)
+NY = 200
+
+
+def _C(w):
+    """PV int_0^inf e^{tw}/(t-1) dt for Re w <= 0, Im w >= 0."""
+    from scipy.special import exp1
+
+    w = np.asarray(w, complex)
+    # keep off the branch cut (negative real axis)
+    w = w + 1e-300j
+    return np.exp(w) * (exp1(w) + 1j * np.pi)
+
+
+def _theta_nodes(n):
+    x, wq = np.polynomial.legendre.leggauss(n)
+    th = 0.5 * np.pi * (x + 1.0)
+    return th, 0.5 * np.pi * wq
+
+
+def compute_F_F1(a, b, n_theta=None):
+    """Reference (host) evaluation of F and F1 at arrays a>=0, b<=0 by
+    theta-quadrature of the C kernel.  Used to build the tables and as the
+    gold standard in tests."""
+    a = np.atleast_1d(np.asarray(a, float))
+    b = np.atleast_1d(np.asarray(b, float))
+    if n_theta is None:
+        n_theta = max(64, int(4 * np.max(a)) + 64)
+    th, wq = _theta_nodes(n_theta)
+    sin_th = np.sin(th)
+    # [n, ntheta]
+    w = b[:, None] + 1j * a[:, None] * sin_th[None, :]
+    Cw = _C(w)
+    F = (Cw.real @ wq) / np.pi
+    F1 = ((Cw * np.exp(-1j * th)[None, :]).real @ wq) / np.pi
+    return F, F1
+
+
+_EULER_GAMMA = 0.5772156649015329
+
+
+def singular_parts(a, b, xp=np):
+    """Closed-form near-origin singular behavior (subtracted before
+    tabulation so bilinear interpolation stays accurate; verified against
+    quadrature in tests/test_greens.py):
+
+        F  -> -gamma - ln((s - b)/2)        (log singular)
+        F1 ->  a / (s - b)   (= tan(theta/2) on rays, bounded but
+                              direction-dependent at the origin)
+    """
+    s = xp.sqrt(a * a + b * b)
+    smb = xp.maximum(s - b, 1e-30) if xp is np else xp.maximum(s - b, 1e-30)
+    return -_EULER_GAMMA - xp.log(smb / 2.0), a / smb
+
+
+def build_tables(path=_TABLE_PATH, verbose=False):
+    """Build and cache the (a, y=log(-b)) tables of the REGULARIZED kernels
+    Ft = F - F_sing and F1t = F1 - F1_sing."""
+    a_grid = np.linspace(0.0, A_MAX, NA)
+    y_grid = np.linspace(Y_MIN, Y_MAX, NY)
+    b_grid = -np.exp(y_grid)
+    F = np.empty((NA, NY))
+    F1 = np.empty((NA, NY))
+    # chunk over a so the theta resolution can scale with a
+    for i0 in range(0, NA, 50):
+        i1 = min(i0 + 50, NA)
+        amax = a_grid[i1 - 1]
+        n_th = max(64, int(4 * amax) + 64)
+        A, B = np.meshgrid(a_grid[i0:i1], b_grid, indexing="ij")
+        f, f1 = compute_F_F1(A.ravel(), B.ravel(), n_theta=n_th)
+        fs, f1s = singular_parts(A.ravel(), B.ravel())
+        F[i0:i1] = (f - fs).reshape(i1 - i0, NY)
+        F1[i0:i1] = (f1 - f1s).reshape(i1 - i0, NY)
+        if verbose:
+            print(f"greens tables: a rows {i0}..{i1} done (n_theta={n_th})")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    np.savez_compressed(
+        path, F=F.astype(np.float32), F1=F1.astype(np.float32),
+        a_max=A_MAX, y_min=Y_MIN, y_max=Y_MAX, regularized=True,
+    )
+    return path
+
+
+_tables = None
+
+
+def load_tables():
+    """Load (building if needed) the F/F1 tables as float32 arrays."""
+    global _tables
+    if _tables is None:
+        if not os.path.exists(_TABLE_PATH):
+            build_tables()
+        d = np.load(_TABLE_PATH)
+        _tables = (d["F"], d["F1"])
+    return _tables
+
+
+# ------------------------------------------------------------ JAX lookup ----
+
+def interp_F_F1(a, b, F_tab, F1_tab):
+    """Bilinear table interpolation of F, F1 at (a, b) — JAX, any shape.
+
+    Out-of-table behavior: a > A_MAX uses the large-argument stationary-phase
+    asymptote F ~ -pi e^b Y0(a) - 1/s, F1 ~ -pi e^b Y1(a) - b/(a s)
+    (verified against quadrature in tests); b < -B_MAX returns the asymptote
+    too (the wave term is ~e^b there, negligible); b -> 0 clamps to the
+    log-grid floor y_min (the log-singular sliver above it is handled by the
+    caller's panel quadrature smoothing).
+    """
+    import jax.numpy as jnp
+
+    from raft_tpu.utils import bessel
+
+    a = jnp.asarray(a)
+    b = jnp.asarray(b)
+    s = jnp.sqrt(a * a + b * b)
+    s = jnp.where(s > 1e-12, s, 1e-12)
+
+    ya = jnp.clip(a, 0.0, A_MAX) / A_MAX * (NA - 1)
+    ia = jnp.clip(jnp.floor(ya).astype(jnp.int32), 0, NA - 2)
+    fa = ya - ia
+
+    y = jnp.log(jnp.clip(-b, np.exp(Y_MIN), np.exp(Y_MAX)))
+    yy = (y - Y_MIN) / (Y_MAX - Y_MIN) * (NY - 1)
+    iy = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, NY - 2)
+    fy = yy - iy
+
+    def bilin(T):
+        t00 = T[ia, iy]
+        t10 = T[ia + 1, iy]
+        t01 = T[ia, iy + 1]
+        t11 = T[ia + 1, iy + 1]
+        return ((1 - fa) * (1 - fy) * t00 + fa * (1 - fy) * t10
+                + (1 - fa) * fy * t01 + fa * fy * t11)
+
+    # tables hold the regularized kernels; add the singular parts back
+    smb = jnp.maximum(s - b, 1e-30)
+    F_sing = -0.5772156649015329 - jnp.log(smb / 2.0)
+    F1_sing = a / smb
+    F = bilin(jnp.asarray(F_tab)) + F_sing
+    F1 = bilin(jnp.asarray(F1_tab)) + F1_sing
+
+    # large-a / large-|b| asymptote
+    eb = jnp.exp(jnp.maximum(b, -80.0))
+    a_s = jnp.maximum(a, 1e-6)
+    F_asym = -jnp.pi * eb * bessel.y0(a_s) - 1.0 / s
+    F1_asym = -jnp.pi * eb * bessel.y1(a_s) - (1.0 + b / s) / a_s
+    out = a > A_MAX
+    F = jnp.where(out, F_asym, F)
+    F1 = jnp.where(out, F1_asym, F1)
+    return F, F1
+
+
+def wave_term(nu, R, zz, F_tab, F1_tab):
+    """Gw and its R- and z-derivatives at wavenumber nu (= omega^2/g).
+
+    R : horizontal distances (>=0); zz : z + zeta (<0, both points submerged).
+    Returns complex (Gw, dGw/dR, dGw/dz) — JAX, elementwise over any shape.
+
+        Gw      = 2 nu [F + i pi e^b J0(a)]
+        dGw/dR  = 2 nu^2 [-(La + F1) - i pi e^b J1(a)]
+        dGw/dz  = 2 nu^2 [(L + F) + i pi e^b J0(a)]
+    """
+    import jax.numpy as jnp
+
+    from raft_tpu.utils import bessel
+
+    a = nu * R
+    b = nu * zz
+    b = jnp.minimum(b, -1e-9)
+    F, F1 = interp_F_F1(a, b, F_tab, F1_tab)
+    s = jnp.sqrt(a * a + b * b)
+    s = jnp.where(s > 1e-12, s, 1e-12)
+    L = 1.0 / s
+    a_safe = jnp.where(a > 1e-9, a, 1e-9)
+    La = (1.0 + b / s) / a_safe
+    eb = jnp.exp(jnp.maximum(b, -80.0))
+    J0 = bessel.j0(a)
+    J1 = bessel.j1(a)
+    Gw = 2.0 * nu * (F + 1j * jnp.pi * eb * J0)
+    dGw_dR = 2.0 * nu * nu * (-(La + F1) - 1j * jnp.pi * eb * J1)
+    dGw_dz = 2.0 * nu * nu * ((L + F) + 1j * jnp.pi * eb * J0)
+    return Gw, dGw_dR, dGw_dz

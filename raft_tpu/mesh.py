@@ -1,0 +1,532 @@
+"""Axisymmetric panel mesher for potential-flow (BEM) members.
+
+Meshes each ``potMod`` member into quadrilateral/triangular surface panels for
+the native radiation/diffraction solver (raft_tpu/bem_solver.py) and for
+HAMS/WAMIT interop (.pnl / .gdf writers).  Capability-equivalent to the
+reference mesher (reference raft/member2pnl.py:73-275): adaptive subdivision
+of the member generator curve by panel-size targets, azimuthal refinement in
+powers of two with 2:1 transition rings, end-cap fill, member pose rotation,
+and waterplane clipping — but restructured: panels are generated as vectorized
+rings per profile segment, node dedup is O(n) hashing (the reference is O(n²)
+list scanning), and panel geometry (centroids/areas/normals) is computed for
+direct consumption by the BEM solver rather than only file output.
+
+A C++ core for the data-dependent adaptive loops lives in
+raft_tpu/native/mesher.cpp (SURVEY.md §2.3: the one XLA-hostile host-side
+component); this module transparently uses it when the shared library is
+available and falls back to the pure-Python implementation below.
+"""
+
+import os
+
+import numpy as np
+
+
+# ---------------------------------------------------------------- profile ---
+
+def profile_points(stations, radii, dz_max=0.0, da_max=0.0, end_a=True,
+                   end_b=True):
+    """Discretize the member generator curve (radius vs axial coordinate).
+
+    Subdivision rule (reference member2pnl.py:115-165): vertical segments are
+    split by ``dz_max``; horizontal (flat) segments by ``0.6*da_max``; sloped
+    segments by a slope-angle-weighted blend of the two.  End caps are filled
+    with concentric rings down to r=0.
+
+    Returns (r, z) profile arrays ordered from end A to end B.
+    """
+    stations = np.asarray(stations, float)
+    radii = np.asarray(radii, float)
+    if dz_max <= 0.0:
+        dz_max = float(stations[-1]) / 20.0
+    if da_max <= 0.0:
+        da_max = float(np.max(radii)) / 8.0
+
+    r_rp = [float(radii[0])]
+    z_rp = [float(stations[0])]
+    for i in range(1, len(radii)):
+        dr = float(radii[i] - radii[i - 1])
+        dz = float(stations[i] - stations[i - 1])
+        hyp = np.hypot(dr, dz)
+        if hyp == 0.0:
+            continue
+        if dr == 0.0:
+            target = dz_max
+        elif dz == 0.0:
+            target = 0.6 * da_max
+        else:
+            # blend by the segment's inclination angle
+            a_r = np.arctan(abs(dr / dz)) * 2.0 / np.pi
+            a_z = np.arctan(abs(dz / dr)) * 2.0 / np.pi
+            target = a_r * 0.6 * da_max + a_z * dz_max
+        n = max(1, int(np.ceil(hyp / target)))
+        for j in range(1, n + 1):
+            frac = j / n
+            r_rp.append(float(radii[i - 1]) + frac * dr)
+            z_rp.append(float(stations[i - 1]) + frac * dz)
+
+    # end-cap rings: concentric circles shrinking to the axis
+    if end_b and radii[-1] > 0.0:
+        n = max(1, int(np.ceil(radii[-1] / (0.6 * da_max))))
+        for j in range(1, n + 1):
+            r_rp.append(float(radii[-1]) * (1.0 - j / n))
+            z_rp.append(float(stations[-1]))
+    if end_a and radii[0] > 0.0:
+        n = max(1, int(np.ceil(radii[0] / (0.6 * da_max))))
+        head_r = [float(radii[0]) * (1.0 - j / n) for j in range(n, 0, -1)]
+        head_z = [float(stations[0])] * n
+        r_rp = head_r + r_rp
+        z_rp = head_z + z_rp
+    return np.array(r_rp), np.array(z_rp)
+
+
+def _ring_quads(r1, z1, r2, z2, naz):
+    """One ring of naz quads between profile points (r1,z1)-(r2,z2),
+    vectorized over azimuth.  Winding matches the reference's so that panel
+    normals point out of the body (reference member2pnl.py:233-241)."""
+    th = np.linspace(0.0, 2.0 * np.pi, naz + 1)
+    c, s = np.cos(th), np.sin(th)
+    quads = np.empty((naz, 4, 3))
+    quads[:, 0, 0] = r1 * c[1:]
+    quads[:, 0, 1] = r1 * s[1:]
+    quads[:, 0, 2] = z1
+    quads[:, 1, 0] = r2 * c[1:]
+    quads[:, 1, 1] = r2 * s[1:]
+    quads[:, 1, 2] = z2
+    quads[:, 2, 0] = r2 * c[:-1]
+    quads[:, 2, 1] = r2 * s[:-1]
+    quads[:, 2, 2] = z2
+    quads[:, 3, 0] = r1 * c[:-1]
+    quads[:, 3, 1] = r1 * s[:-1]
+    quads[:, 3, 2] = z1
+    return quads
+
+
+def _transition_ring(r1, z1, r2, z2, naz, refine_bottom):
+    """2:1 transition ring: naz/2 coarse cells each split into two panels.
+
+    ``refine_bottom``: the (r2,z2) edge is the finer one (reference's
+    'increase azimuthal discretization' branch, member2pnl.py:194-210);
+    otherwise the (r1,z1) edge is finer (member2pnl.py:213-229).
+    """
+    panels = []
+    for ia in range(1, naz // 2 + 1):
+        th1 = (ia - 1.0) * 2.0 * np.pi / naz * 2.0
+        th2 = (ia - 0.5) * 2.0 * np.pi / naz * 2.0
+        th3 = (ia - 0.0) * 2.0 * np.pi / naz * 2.0
+        c1_, s1_ = np.cos(th1), np.sin(th1)
+        c2_, s2_ = np.cos(th2), np.sin(th2)
+        c3_, s3_ = np.cos(th3), np.sin(th3)
+        if refine_bottom:
+            mid = ((r1 * c1_ + r1 * c3_) / 2.0, (r1 * s1_ + r1 * s3_) / 2.0)
+            panels.append([[mid[0], mid[1], z1],
+                           [r2 * c2_, r2 * s2_, z2],
+                           [r2 * c1_, r2 * s1_, z2],
+                           [r1 * c1_, r1 * s1_, z1]])
+            panels.append([[r1 * c3_, r1 * s3_, z1],
+                           [r2 * c3_, r2 * s3_, z2],
+                           [r2 * c2_, r2 * s2_, z2],
+                           [mid[0], mid[1], z1]])
+        else:
+            mid = ((r2 * c1_ + r2 * c3_) / 2.0, (r2 * s1_ + r2 * s3_) / 2.0)
+            panels.append([[r1 * c2_, r1 * s2_, z1],
+                           [mid[0], mid[1], z2],
+                           [r2 * c1_, r2 * s1_, z2],
+                           [r1 * c1_, r1 * s1_, z1]])
+            panels.append([[r1 * c3_, r1 * s3_, z1],
+                           [r2 * c3_, r2 * s3_, z2],
+                           [mid[0], mid[1], z2],
+                           [r1 * c2_, r1 * s2_, z1]])
+    return np.array(panels)
+
+
+def revolve_profile(r_rp, z_rp, da_max):
+    """Revolve the profile into panels with adaptive azimuthal refinement.
+
+    The azimuth count follows the reference's hysteresis state machine
+    (member2pnl.py:188-191): starting from 8, double while both edge widths
+    are >= da_max/2, halve while both are < da_max/2; mixed edges emit a 2:1
+    transition ring.  Returns [npan, 4, 3] panel vertices (local frame).
+    """
+    panels = []
+    naz = 8
+    for i in range(len(z_rp) - 1):
+        r1, z1 = r_rp[i], z_rp[i]
+        r2, z2 = r_rp[i + 1], z_rp[i + 1]
+        while (r1 * 2 * np.pi / naz >= da_max / 2
+               and r2 * 2 * np.pi / naz >= da_max / 2):
+            naz *= 2
+        while (naz > 2 and r1 * 2 * np.pi / naz < da_max / 2
+               and r2 * 2 * np.pi / naz < da_max / 2):
+            naz //= 2
+        w1 = r1 * 2 * np.pi / naz
+        w2 = r2 * 2 * np.pi / naz
+        if w1 < da_max / 2 <= w2:
+            panels.append(_transition_ring(r1, z1, r2, z2, naz,
+                                           refine_bottom=True))
+        elif w2 < da_max / 2 <= w1:
+            panels.append(_transition_ring(r1, z1, r2, z2, naz,
+                                           refine_bottom=False))
+        else:
+            panels.append(_ring_quads(r1, z1, r2, z2, naz))
+    return np.concatenate(panels, axis=0) if panels else np.zeros((0, 4, 3))
+
+
+def member_pose_matrix(rA, rB, gamma=0.0):
+    """Z1Y2Z3 member pose rotation (reference member2pnl.py:245-260)."""
+    rAB = np.asarray(rB, float) - np.asarray(rA, float)
+    beta = np.arctan2(rAB[1], rAB[0])
+    phi = np.arctan2(np.hypot(rAB[0], rAB[1]), rAB[2])
+    s1, c1 = np.sin(beta), np.cos(beta)
+    s2, c2 = np.sin(phi), np.cos(phi)
+    s3, c3 = np.sin(np.deg2rad(gamma)), np.cos(np.deg2rad(gamma))
+    return np.array([
+        [c1 * c2 * c3 - s1 * s3, -c3 * s1 - c1 * c2 * s3, c1 * s2],
+        [c1 * s3 + c2 * c3 * s1, c1 * c3 - c2 * s1 * s3, s1 * s2],
+        [-c3 * s2, s2 * s3, c2],
+    ])
+
+
+def mesh_member(stations, diameters, rA, rB, dz_max=0.0, da_max=0.0):
+    """Mesh one axisymmetric member: profile → revolve → pose transform.
+
+    ``stations`` are axial coordinates from end A; ``rA``/``rB`` global end
+    positions.  Returns [npan, 4, 3] global-frame panel vertices (unclipped).
+    """
+    rA = np.asarray(rA, float)
+    rB = np.asarray(rB, float)
+    radii = 0.5 * np.asarray(diameters, float)
+    stations = np.asarray(stations, float)
+    # profile z measured from end A along the member axis
+    r_rp, z_rp = profile_points(stations - stations[0], radii, dz_max, da_max)
+    panels = _native_or_python_revolve(r_rp, z_rp, da_max)
+    R = member_pose_matrix(rA, rB)
+    return panels @ R.T + rA[None, None, :]
+
+
+def clip_waterplane(panels, z_max=0.0):
+    """Drop panels fully above the waterline and clamp remaining vertices to
+    the free surface (reference member2pnl.py:23-30).  Panels squashed to
+    zero area by the clamp are also dropped."""
+    if len(panels) == 0:
+        return panels
+    keep = ~np.all(panels[:, :, 2] > z_max, axis=1)
+    out = panels[keep].copy()
+    out[:, :, 2] = np.minimum(out[:, :, 2], z_max)
+    areas = panel_geometry(out)[2]
+    return out[areas > 1e-10]
+
+
+def dedupe_nodes(panels, decimals=6):
+    """Merge coincident vertices: returns (nodes [N,3], conn [npan,4] int).
+
+    Panels with a repeated vertex (clip-degenerate quads) become triangles:
+    the repeated index appears once and the 4th entry is -1
+    (the reference detects these the same way, member2pnl.py:49-56).
+    """
+    nodes = []
+    index = {}
+    conn = np.full((len(panels), 4), -1, dtype=int)
+    for ip, quad in enumerate(panels):
+        ids = []
+        for v in quad:
+            key = tuple(np.round(v, decimals) + 0.0)
+            j = index.get(key)
+            if j is None:
+                j = len(nodes)
+                index[key] = j
+                nodes.append(v)
+            if j not in ids:
+                ids.append(j)
+        conn[ip, : len(ids)] = ids
+    return np.array(nodes), conn
+
+
+def panel_geometry(panels):
+    """Centroids, normals, areas of quad/tri panels [npan,4,3].
+
+    Each quad is split into two triangles; the panel normal is the
+    area-weighted triangle normal (robust for clip-degenerate quads), the
+    centroid the area-weighted triangle centroid.  Returns
+    (centroids [n,3], normals [n,3], areas [n]).
+    """
+    p = np.asarray(panels, float)
+    a, b, c, d = p[:, 0], p[:, 1], p[:, 2], p[:, 3]
+    n1 = 0.5 * np.cross(b - a, c - a)
+    n2 = 0.5 * np.cross(c - a, d - a)
+    c1 = (a + b + c) / 3.0
+    c2 = (a + c + d) / 3.0
+    A1 = np.linalg.norm(n1, axis=1)
+    A2 = np.linalg.norm(n2, axis=1)
+    areas = A1 + A2
+    nvec = n1 + n2
+    norm = np.linalg.norm(nvec, axis=1)
+    normals = nvec / np.where(norm > 0, norm, 1.0)[:, None]
+    w = np.where(areas > 0, areas, 1.0)
+    centroids = (c1 * A1[:, None] + c2 * A2[:, None]) / w[:, None]
+    return centroids, normals, areas
+
+
+def mesh_volume(panels):
+    """Signed enclosed volume by the divergence theorem (positive when panel
+    normals point out of the body) — used to sanity-check orientation."""
+    cen, nrm, areas = panel_geometry(panels)
+    return float(np.sum(areas * np.einsum("ij,ij->i", cen, nrm)) / 3.0)
+
+
+# -------------------------------------------------------------- file I/O ----
+
+def write_pnl(path, nodes, conn):
+    """Write a HAMS-format HullMesh .pnl file (reference member2pnl.py:279-307
+    format: header, 1-based node table, panel connectivity)."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        f.write("    --------------Hull Mesh File---------------\n\n")
+        f.write("    # Number of Panels, Nodes, X-Symmetry and Y-Symmetry\n")
+        f.write(f"         {len(conn)}         {len(nodes)}         0         0\n\n")
+        f.write("    #Start Definition of Node Coordinates     "
+                "! node_number   x   y   z\n")
+        for i, nd in enumerate(nodes):
+            f.write(f"{i+1:>5}{nd[0]:18.6f}{nd[1]:18.6f}{nd[2]:18.6f}\n")
+        f.write("   #End Definition of Node Coordinates\n\n")
+        f.write("   #Start Definition of Node Relations   ! panel_number  "
+                "number_of_vertices   Vertex1_ID   Vertex2_ID   Vertex3_ID   "
+                "(Vertex4_ID)\n")
+        for i, row in enumerate(conn):
+            ids = [int(j) + 1 for j in row if j >= 0]
+            f.write("".join(f"{v:>8}" for v in [i + 1, len(ids)] + ids) + "\n")
+        f.write("   #End Definition of Node Relations\n\n")
+        f.write("    --------------End Hull Mesh File---------------\n")
+
+
+def read_pnl(path):
+    """Read a HAMS .pnl file back into (nodes [N,3], conn [npan,4])."""
+    nodes, conn = [], []
+    section = None
+    with open(path) as f:
+        for line in f:
+            s = line.strip()
+            if s.startswith("#Start Definition of Node Coordinates"):
+                section = "nodes"
+                continue
+            if s.startswith("#Start Definition of Node Relations"):
+                section = "panels"
+                continue
+            if s.startswith("#End"):
+                section = None
+                continue
+            if not s or s.startswith("-") or s.startswith("#"):
+                continue
+            parts = s.split()
+            if section == "nodes" and len(parts) >= 4:
+                nodes.append([float(parts[1]), float(parts[2]), float(parts[3])])
+            elif section == "panels" and len(parts) >= 5:
+                nv = int(parts[1])
+                ids = [int(p) - 1 for p in parts[2:2 + nv]]
+                conn.append(ids + [-1] * (4 - nv))
+    return np.array(nodes), np.array(conn, dtype=int)
+
+
+def conn_to_panels(nodes, conn):
+    """Expand (nodes, conn) back to [npan,4,3] vertex panels (triangles
+    repeat their last vertex, making a degenerate quad)."""
+    out = np.empty((len(conn), 4, 3))
+    for i, row in enumerate(conn):
+        ids = [j for j in row if j >= 0]
+        while len(ids) < 4:
+            ids.append(ids[-1])
+        out[i] = nodes[ids]
+    return out
+
+
+def write_gdf(path, panels, ulen=1.0, g=9.8):
+    """Write panels to a WAMIT .gdf file (reference member2pnl.py:501-529)."""
+    verts = np.asarray(panels, float).reshape(-1, 3)
+    with open(path, "w") as f:
+        f.write("gdf mesh written by raft_tpu\n")
+        f.write(f"{ulen}   {g}\n")
+        f.write("0, 0\n")
+        f.write(f"{len(verts) // 4}\n")
+        for v in verts:
+            f.write(f"{v[0]:>12.6f} {v[1]:>12.6f} {v[2]:>12.6f}\n")
+
+
+def read_gdf(path):
+    """Read a WAMIT .gdf file into [npan,4,3] panels."""
+    with open(path) as f:
+        lines = f.readlines()
+    npan = int(lines[3].split()[0])
+    vals = []
+    for line in lines[4:]:
+        parts = line.split()
+        if len(parts) >= 3:
+            vals.append([float(parts[0]), float(parts[1]), float(parts[2])])
+    verts = np.array(vals[: npan * 4])
+    return verts.reshape(npan, 4, 3)
+
+
+def _grid_quads(P00, P10, P01, P11, n_u, n_v):
+    """Panel a bilinear patch defined by its 4 corners into n_u x n_v quads.
+    Winding (u x v right-handed) chosen by the caller via corner order."""
+    u = np.linspace(0.0, 1.0, n_u + 1)
+    v = np.linspace(0.0, 1.0, n_v + 1)
+    U, V = np.meshgrid(u, v, indexing="ij")
+    pts = ((1 - U)[:, :, None] * (1 - V)[:, :, None] * P00
+           + U[:, :, None] * (1 - V)[:, :, None] * P10
+           + (1 - U)[:, :, None] * V[:, :, None] * P01
+           + U[:, :, None] * V[:, :, None] * P11)
+    quads = np.empty((n_u, n_v, 4, 3))
+    quads[:, :, 0] = pts[:-1, :-1]
+    quads[:, :, 1] = pts[1:, :-1]
+    quads[:, :, 2] = pts[1:, 1:]
+    quads[:, :, 3] = pts[:-1, 1:]
+    return quads.reshape(-1, 4, 3)
+
+
+def mesh_rect_member(stations, side_lengths, rA, rB, dz_max=0.0, da_max=0.0,
+                     gamma=0.0):
+    """Mesh a rectangular member as a (tapered) box: four side faces plus end
+    caps.  ``side_lengths`` is [n,2] per station.  This extends the reference
+    mesher, which only handles axisymmetric members (member2pnl.py:73).
+    Returns [npan,4,3] global-frame panels with outward normals."""
+    stations = np.asarray(stations, float) - float(np.asarray(stations)[0])
+    sl = np.asarray(side_lengths, float).reshape(len(stations), 2)
+    if dz_max <= 0.0:
+        dz_max = float(stations[-1]) / 20.0
+    if da_max <= 0.0:
+        da_max = float(np.max(sl)) / 8.0
+
+    # subdivide the axial profile (same rule as circular: straight segments
+    # split by dz_max)
+    zs = [0.0]
+    sls = [sl[0]]
+    for i in range(1, len(stations)):
+        dz = stations[i] - stations[i - 1]
+        if dz <= 0.0:
+            continue
+        n = max(1, int(np.ceil(dz / dz_max)))
+        for j in range(1, n + 1):
+            f = j / n
+            zs.append(stations[i - 1] + f * dz)
+            sls.append(sl[i - 1] + f * (sl[i] - sl[i - 1]))
+    zs = np.array(zs)
+    sls = np.array(sls)
+
+    def corners(i):
+        a, b = 0.5 * sls[i]
+        z = zs[i]
+        return np.array([[+a, +b, z], [-a, +b, z], [-a, -b, z], [+a, -b, z]])
+
+    chunks = []
+    n_a = max(1, int(np.ceil(float(np.max(sls[:, 0])) / da_max)))
+    n_b = max(1, int(np.ceil(float(np.max(sls[:, 1])) / da_max)))
+    n_per = [n_b, n_a, n_b, n_a]  # panels along each perimeter edge
+    for i in range(len(zs) - 1):
+        c1 = corners(i)
+        c2 = corners(i + 1)
+        for e in range(4):
+            j = (e + 1) % 4
+            # outward-facing side patch between axial rings i and i+1
+            chunks.append(_grid_quads(c1[e], c1[j], c2[e], c2[j],
+                                      n_per[e], 1))
+    # end caps (normals along -z at end A, +z at end B in local frame)
+    cA = corners(0)
+    chunks.append(_grid_quads(cA[0], cA[3], cA[1], cA[2], n_a, n_b))
+    cB = corners(len(zs) - 1)
+    chunks.append(_grid_quads(cB[0], cB[1], cB[3], cB[2], n_a, n_b))
+
+    panels = np.concatenate(chunks, axis=0)
+    R = member_pose_matrix(rA, rB, gamma=gamma)
+    panels = panels @ R.T + np.asarray(rA, float)[None, None, :]
+    # ensure outward orientation (flip all if the enclosed volume is negative)
+    if mesh_volume(panels) < 0:
+        panels = panels[:, ::-1, :]
+    return panels
+
+
+# -------------------------------------------------- platform-level helper ---
+
+def mesh_platform(members, dz_max=0.0, da_max=0.0, clip=True):
+    """Mesh every potential-flow member of a platform into one panel set.
+
+    ``members`` is the processed Member list (raft_tpu.geometry); only members
+    with ``potMod=True`` are meshed (reference raft_fowt.py:349-357).  Returns
+    [npan,4,3] waterplane-clipped panels for the wetted hull.
+    """
+    chunks = []
+    for mem in members:
+        if not getattr(mem, "potMod", False):
+            continue
+        if mem.circular:
+            chunks.append(
+                mesh_member(mem.stations, mem.d, mem.rA, mem.rB, dz_max, da_max)
+            )
+        else:
+            # rectangular members: box mesh (beyond the reference mesher,
+            # which is axisymmetric-only, member2pnl.py:73)
+            chunks.append(
+                mesh_rect_member(mem.stations, mem.sl, mem.rA, mem.rB,
+                                 dz_max, da_max, gamma=mem.gamma)
+            )
+    if not chunks:
+        return np.zeros((0, 4, 3))
+    panels = np.concatenate(chunks, axis=0)
+    return clip_waterplane(panels) if clip else panels
+
+
+# ------------------------------------------------------------ native core ---
+
+_native = None
+_native_tried = False
+
+
+def _load_native():
+    """Load the C++ mesher core (raft_tpu/native/libraft_mesher.so) lazily;
+    build it with `make -C raft_tpu/native` if missing.  Returns None when
+    unavailable — callers fall back to the Python implementation."""
+    global _native, _native_tried
+    if _native_tried:
+        return _native
+    _native_tried = True
+    try:
+        import ctypes
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        lib_path = os.path.join(here, "native", "libraft_mesher.so")
+        if not os.path.exists(lib_path):
+            return None
+        lib = ctypes.CDLL(lib_path)
+        lib.raft_revolve_profile.restype = ctypes.c_int
+        lib.raft_revolve_profile.argtypes = [
+            ctypes.POINTER(ctypes.c_double),  # r profile
+            ctypes.POINTER(ctypes.c_double),  # z profile
+            ctypes.c_int,                     # n profile points
+            ctypes.c_double,                  # da_max
+            ctypes.POINTER(ctypes.c_double),  # out vertices (cap*12)
+            ctypes.c_int,                     # capacity (panels)
+        ]
+        _native = lib
+    except OSError:
+        _native = None
+    return _native
+
+
+def _native_or_python_revolve(r_rp, z_rp, da_max):
+    lib = _load_native()
+    if lib is None:
+        return revolve_profile(r_rp, z_rp, da_max)
+    import ctypes
+
+    r = np.ascontiguousarray(r_rp, dtype=np.float64)
+    z = np.ascontiguousarray(z_rp, dtype=np.float64)
+    cap = 65536
+    out = np.empty((cap, 4, 3), dtype=np.float64)
+    n = lib.raft_revolve_profile(
+        r.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        z.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        len(r), float(da_max),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), cap,
+    )
+    if n < 0:  # capacity exceeded — fall back
+        return revolve_profile(r_rp, z_rp, da_max)
+    return out[:n]

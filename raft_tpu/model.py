@@ -170,6 +170,36 @@ class Model:
         )
         return self.bem_coeffs
 
+    def run_bem(self, headings=(0.0,), nw_bem=24, dz_max=None, da_max=None):
+        """Run the NATIVE radiation/diffraction panel solver on all potMod
+        members (the reference's calcBEM path, raft/raft_fowt.py:318-423,
+        with the external Fortran HAMS subprocess replaced by the TPU-native
+        solver in raft_tpu/bem_solver.py).
+
+        Coefficients are solved on a coarse grid spanning the model band
+        (min_freq_BEM .. max model frequency, reference raft_fowt.py:59-62)
+        and interpolated onto the model grid inside the case pipeline exactly
+        like imported WAMIT data.  Panel sizes default to the design's
+        dz_BEM/da_BEM.
+        """
+        from raft_tpu.bem_solver import coeffs_from_members
+        from raft_tpu.io.schema import get_from_dict
+
+        platform = self.design["platform"]
+        dz = dz_max if dz_max is not None else get_from_dict(
+            platform, "dz_BEM", default=3.0)
+        da = da_max if da_max is not None else get_from_dict(
+            platform, "da_BEM", default=2.0)
+        w_min = 2 * np.pi * get_from_dict(
+            platform, "min_freq_BEM", default=self.w[0] / 2 / np.pi)
+        w_bem = np.linspace(max(w_min, self.w[0]), self.w[-1], nw_bem)
+        self.bem_coeffs = coeffs_from_members(
+            [m for m in self.members if m.potMod], w_bem,
+            headings_deg=headings, rho=self.rho_water, g=self.g,
+            dz_max=dz, da_max=da,
+        )
+        return self.bem_coeffs
+
     def _added_mass_f64(self):
         cpu = jax.devices("cpu")[0]
         nodes64 = jax.device_put(self.nodes.astype(np.float64), cpu)

@@ -1,0 +1,121 @@
+// Native mesher core for raft_tpu: adaptive azimuthal revolve of a member
+// radius profile into surface panels.  This is the one host-side component
+// whose data-dependent control flow (azimuth-count hysteresis, 2:1 transition
+// rings) is XLA-hostile (SURVEY.md §2.3), so it is implemented natively; the
+// Python fallback in raft_tpu/mesh.py::revolve_profile produces identical
+// output (asserted by tests/test_mesh.py).
+//
+// Build: make -C raft_tpu/native   (g++ -O2 -shared -fPIC)
+// ABI: raft_revolve_profile(r, z, n, da_max, out, cap) -> npanels written,
+//      or -1 if more than `cap` panels would be required.
+
+#include <cmath>
+#include <cstdint>
+
+namespace {
+
+constexpr double kTwoPi = 6.283185307179586476925286766559;
+
+struct Writer {
+  double* out;      // [cap][4][3]
+  int cap;
+  int n = 0;
+  bool overflow = false;
+
+  void quad(const double* a, const double* b, const double* c,
+            const double* d) {
+    if (n >= cap) {
+      overflow = true;
+      return;
+    }
+    double* p = out + static_cast<int64_t>(n) * 12;
+    for (int i = 0; i < 3; ++i) p[i] = a[i];
+    for (int i = 0; i < 3; ++i) p[3 + i] = b[i];
+    for (int i = 0; i < 3; ++i) p[6 + i] = c[i];
+    for (int i = 0; i < 3; ++i) p[9 + i] = d[i];
+    ++n;
+  }
+};
+
+// One full ring of naz quads between profile points (r1,z1) and (r2,z2).
+// Winding matches mesh.py::_ring_quads (normals out of the body).
+void ring(Writer& w, double r1, double z1, double r2, double z2, int naz) {
+  for (int ia = 0; ia < naz; ++ia) {
+    double th0 = kTwoPi * ia / naz;
+    double th1 = kTwoPi * (ia + 1) / naz;
+    double c0 = std::cos(th0), s0 = std::sin(th0);
+    double c1 = std::cos(th1), s1 = std::sin(th1);
+    double a[3] = {r1 * c1, r1 * s1, z1};
+    double b[3] = {r2 * c1, r2 * s1, z2};
+    double c[3] = {r2 * c0, r2 * s0, z2};
+    double d[3] = {r1 * c0, r1 * s0, z1};
+    w.quad(a, b, c, d);
+  }
+}
+
+// 2:1 transition ring; refine_bottom == true means the (r2,z2) edge carries
+// the finer discretization.  Mirrors mesh.py::_transition_ring.
+void transition(Writer& w, double r1, double z1, double r2, double z2,
+                int naz, bool refine_bottom) {
+  for (int ia = 1; ia <= naz / 2; ++ia) {
+    double th1 = (ia - 1.0) * kTwoPi / naz * 2.0;
+    double th2 = (ia - 0.5) * kTwoPi / naz * 2.0;
+    double th3 = (ia - 0.0) * kTwoPi / naz * 2.0;
+    double c1 = std::cos(th1), s1 = std::sin(th1);
+    double c2 = std::cos(th2), s2 = std::sin(th2);
+    double c3 = std::cos(th3), s3 = std::sin(th3);
+    if (refine_bottom) {
+      double mx = (r1 * c1 + r1 * c3) / 2.0, my = (r1 * s1 + r1 * s3) / 2.0;
+      double a0[3] = {mx, my, z1};
+      double b0[3] = {r2 * c2, r2 * s2, z2};
+      double c0[3] = {r2 * c1, r2 * s1, z2};
+      double d0[3] = {r1 * c1, r1 * s1, z1};
+      w.quad(a0, b0, c0, d0);
+      double a1[3] = {r1 * c3, r1 * s3, z1};
+      double b1[3] = {r2 * c3, r2 * s3, z2};
+      double c1v[3] = {r2 * c2, r2 * s2, z2};
+      double d1[3] = {mx, my, z1};
+      w.quad(a1, b1, c1v, d1);
+    } else {
+      double mx = (r2 * c1 + r2 * c3) / 2.0, my = (r2 * s1 + r2 * s3) / 2.0;
+      double a0[3] = {r1 * c2, r1 * s2, z1};
+      double b0[3] = {mx, my, z2};
+      double c0[3] = {r2 * c1, r2 * s1, z2};
+      double d0[3] = {r1 * c1, r1 * s1, z1};
+      w.quad(a0, b0, c0, d0);
+      double a1[3] = {r1 * c3, r1 * s3, z1};
+      double b1[3] = {r2 * c3, r2 * s3, z2};
+      double c1v[3] = {mx, my, z2};
+      double d1[3] = {r1 * c2, r1 * s2, z1};
+      w.quad(a1, b1, c1v, d1);
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" int raft_revolve_profile(const double* r_rp, const double* z_rp,
+                                    int n, double da_max, double* out,
+                                    int cap) {
+  Writer w{out, cap};
+  int naz = 8;
+  for (int i = 0; i + 1 < n; ++i) {
+    double r1 = r_rp[i], z1 = z_rp[i];
+    double r2 = r_rp[i + 1], z2 = z_rp[i + 1];
+    while (r1 * kTwoPi / naz >= da_max / 2.0 &&
+           r2 * kTwoPi / naz >= da_max / 2.0)
+      naz *= 2;
+    while (naz > 2 && r1 * kTwoPi / naz < da_max / 2.0 &&
+           r2 * kTwoPi / naz < da_max / 2.0)
+      naz /= 2;
+    double w1 = r1 * kTwoPi / naz;
+    double w2 = r2 * kTwoPi / naz;
+    if (w1 < da_max / 2.0 && w2 >= da_max / 2.0)
+      transition(w, r1, z1, r2, z2, naz, /*refine_bottom=*/true);
+    else if (w2 < da_max / 2.0 && w1 >= da_max / 2.0)
+      transition(w, r1, z1, r2, z2, naz, /*refine_bottom=*/false);
+    else
+      ring(w, r1, z1, r2, z2, naz);
+  }
+  return w.overflow ? -1 : w.n;
+}

@@ -1,0 +1,88 @@
+"""JAX Bessel functions J0, J1, Y0, Y1 via Abramowitz & Stegun rational
+approximations (A&S 9.4.1-9.4.6, |error| < 1e-7 absolute).
+
+Needed on-device by the BEM solver's wave-term evaluation
+(raft_tpu/greens.py): jax.scipy.special has no Y0/Y1, and the rotor-averaged
+Kaimal spectrum host path uses scipy — these are the TPU-side equivalents.
+All functions accept x >= 0 (Y0/Y1 require x > 0).
+"""
+
+import jax.numpy as jnp
+
+
+def _poly(x, coeffs):
+    r = coeffs[0]
+    for c in coeffs[1:]:
+        r = r * x + c
+    return r
+
+
+def j0(x):
+    x = jnp.asarray(x)
+    ax = jnp.abs(x)
+    # |x| <= 3 : A&S 9.4.1
+    y = (ax / 3.0) ** 2
+    small = _poly(y, [0.00021, -0.0039444, 0.0444479, -0.3163866,
+                      1.2656208, -2.2499997, 1.0])
+    # |x| > 3 : A&S 9.4.3 modulus/phase
+    z = 3.0 / jnp.where(ax > 1e-30, ax, 1.0)
+    f0 = _poly(z, [0.00014476, -0.00072805, 0.00137237, -0.00009512,
+                   -0.00552740, -0.00000077, 0.79788456])
+    t0 = ax + _poly(z, [0.00013558, -0.00029333, -0.00054125, 0.00262573,
+                        -0.00003954, -0.04166397, -0.78539816])
+    big = f0 * jnp.cos(t0) / jnp.sqrt(jnp.where(ax > 1e-30, ax, 1.0))
+    return jnp.where(ax <= 3.0, small, big)
+
+
+def j1(x):
+    x = jnp.asarray(x)
+    ax = jnp.abs(x)
+    # |x| <= 3 : A&S 9.4.4  (J1/x form)
+    y = (ax / 3.0) ** 2
+    small = ax * _poly(y, [0.00001109, -0.00031761, 0.00443319, -0.03954289,
+                           0.21093573, -0.56249985, 0.5])
+    # |x| > 3 : A&S 9.4.6
+    z = 3.0 / jnp.where(ax > 1e-30, ax, 1.0)
+    f1 = _poly(z, [-0.00020033, 0.00113653, -0.00249511, 0.00017105,
+                   0.01659667, 0.00000156, 0.79788456])
+    t1 = ax + _poly(z, [-0.00029166, 0.00079824, 0.00074348, -0.00637879,
+                        0.00005650, 0.12499612, -2.35619449])
+    big = f1 * jnp.cos(t1) / jnp.sqrt(jnp.where(ax > 1e-30, ax, 1.0))
+    return jnp.sign(x) * jnp.where(ax <= 3.0, small, big)
+
+
+def y0(x):
+    x = jnp.asarray(x)
+    xs = jnp.where(x > 1e-30, x, 1e-30)
+    # x <= 3 : A&S 9.4.2
+    y = (xs / 3.0) ** 2
+    small = (2.0 / jnp.pi) * jnp.log(xs / 2.0) * j0(xs) + _poly(
+        y, [-0.00024846, 0.00427916, -0.04261214, 0.25300117, -0.74350384,
+            0.60559366, 0.36746691]
+    )
+    z = 3.0 / xs
+    f0 = _poly(z, [0.00014476, -0.00072805, 0.00137237, -0.00009512,
+                   -0.00552740, -0.00000077, 0.79788456])
+    t0 = xs + _poly(z, [0.00013558, -0.00029333, -0.00054125, 0.00262573,
+                        -0.00003954, -0.04166397, -0.78539816])
+    big = f0 * jnp.sin(t0) / jnp.sqrt(xs)
+    return jnp.where(x <= 3.0, small, big)
+
+
+def y1(x):
+    x = jnp.asarray(x)
+    xs = jnp.where(x > 1e-30, x, 1e-30)
+    # x <= 3 : A&S 9.4.5  (x*Y1 = (2/pi) x ln(x/2) J1(x) + poly((x/3)^2))
+    y = (xs / 3.0) ** 2
+    small = (
+        (2.0 / jnp.pi) * xs * jnp.log(xs / 2.0) * j1(xs)
+        + _poly(y, [0.0027873, -0.0400976, 0.3123951, -1.3164827,
+                    2.1682709, 0.2212091, -0.6366198])
+    ) / xs
+    z = 3.0 / xs
+    f1 = _poly(z, [-0.00020033, 0.00113653, -0.00249511, 0.00017105,
+                   0.01659667, 0.00000156, 0.79788456])
+    t1 = xs + _poly(z, [-0.00029166, 0.00079824, 0.00074348, -0.00637879,
+                        0.00005650, 0.12499612, -2.35619449])
+    big = f1 * jnp.sin(t1) / jnp.sqrt(xs)
+    return jnp.where(x <= 3.0, small, big)

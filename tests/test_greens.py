@@ -1,0 +1,78 @@
+"""Green-function kernel tests: PV-integral identity, table interpolation
+accuracy, singular-part regularization, and far-field asymptotes
+(raft_tpu/greens.py — the kernel of the native BEM solver that replaces the
+reference's external Fortran HAMS, reference raft/raft_fowt.py:367-395)."""
+
+import numpy as np
+import pytest
+from scipy import integrate, special
+
+from raft_tpu import greens
+
+
+def test_pv_kernel_identity():
+    """C(w) = e^w (E1(w) + i pi) against brute-force PV quadrature."""
+    for w in [-0.5 + 0.3j, -2 + 5j, -10 + 1j]:
+        f = lambda t: np.exp(t * w.real) * np.cos(t * w.imag)
+        g = lambda t: np.exp(t * w.real) * np.sin(t * w.imag)
+        re = integrate.quad(f, 0, 2, weight="cauchy", wvar=1.0)[0]
+        im = integrate.quad(g, 0, 2, weight="cauchy", wvar=1.0)[0]
+        re += integrate.quad(lambda t: f(t) / (t - 1), 2, np.inf,
+                             limit=300)[0]
+        im += integrate.quad(lambda t: g(t) / (t - 1), 2, np.inf,
+                             limit=300)[0]
+        C = greens._C(np.array([w]))[0]
+        assert abs(C - (re + 1j * im)) < 1e-6
+
+
+def test_singular_parts():
+    """Near the origin F -> -gamma - ln((s-b)/2), F1 -> a/(s-b)."""
+    for th in [0.2, 0.8, 1.3]:
+        s = 1e-4
+        a, b = s * np.sin(th), -s * np.cos(th)
+        F, F1 = greens.compute_F_F1([a], [b], n_theta=200)
+        Fs, F1s = greens.singular_parts(np.array([a]), np.array([b]))
+        assert abs(F[0] - Fs[0]) < 5e-3
+        assert abs(F1[0] - F1s[0]) < 5e-3
+
+
+def test_table_interpolation_accuracy():
+    F_tab, F1_tab = greens.load_tables()
+    rng = np.random.default_rng(7)
+    a = rng.uniform(0.01, 95.0, 300)
+    b = -(10.0 ** rng.uniform(-4, 1.2, 300))
+    Fi, F1i = greens.interp_F_F1(a, b, F_tab, F1_tab)
+    Fd, F1d = greens.compute_F_F1(a, b)
+    assert np.max(np.abs(np.asarray(Fi) - Fd)) < 0.03
+    assert np.max(np.abs(np.asarray(F1i) - F1d)) < 0.03
+
+
+def test_far_field_asymptote():
+    """Beyond the table, F ~ -pi e^b Y0(a) - 1/s (stationary phase at the
+    pole + endpoint contribution)."""
+    F_tab, F1_tab = greens.load_tables()
+    a = np.array([120.0, 200.0])
+    b = np.array([-0.5, -2.0])
+    Fi, F1i = greens.interp_F_F1(a, b, F_tab, F1_tab)
+    Fd, F1d = greens.compute_F_F1(a, b, n_theta=1500)
+    assert np.max(np.abs(np.asarray(Fi) - Fd)) < 1e-3
+    assert np.max(np.abs(np.asarray(F1i) - F1d)) < 1e-3
+
+
+def test_wave_term_derivative_consistency():
+    """dGw/dR and dGw/dz from the tables vs finite differences of Gw."""
+    F_tab, F1_tab = greens.load_tables()
+    nu = 0.15
+    R = np.array([6.0, 20.0, 55.0])
+    zz = np.array([-4.0, -11.0, -0.8])
+    Gw, dR, dz = greens.wave_term(nu, R, zz, F_tab, F1_tab)
+    h = 1e-3
+    GwR1, _, _ = greens.wave_term(nu, R + h, zz, F_tab, F1_tab)
+    GwR0, _, _ = greens.wave_term(nu, R - h, zz, F_tab, F1_tab)
+    Gwz1, _, _ = greens.wave_term(nu, R, zz + h, F_tab, F1_tab)
+    Gwz0, _, _ = greens.wave_term(nu, R, zz - h, F_tab, F1_tab)
+    # tolerance set by the bilinear-table resolution (~1e-3 absolute)
+    assert np.allclose((np.asarray(GwR1) - np.asarray(GwR0)) / (2 * h),
+                       np.asarray(dR), rtol=0.05, atol=2e-3)
+    assert np.allclose((np.asarray(Gwz1) - np.asarray(Gwz0)) / (2 * h),
+                       np.asarray(dz), rtol=0.05, atol=2e-3)

@@ -1,0 +1,115 @@
+"""Panel mesher tests: volume convergence, clipping, dedup, file round-trips,
+and native-C++ vs Python equivalence (reference capability:
+raft/member2pnl.py:8-307)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu import mesh
+
+STATIONS = [0.0, 4.0, 12.0, 130.0]
+DIAMETERS = [9.4, 9.4, 6.5, 6.5]
+RA = np.array([0.0, 0.0, -120.0])
+RB = np.array([0.0, 0.0, 10.0])
+
+
+def analytic_submerged_volume():
+    v_base = np.pi / 4 * 9.4**2 * 4.0
+    r1, r2 = 4.7, 3.25
+    v_taper = np.pi * 8.0 / 3.0 * (r1 * r1 + r1 * r2 + r2 * r2)
+    v_col = np.pi / 4 * 6.5**2 * 108.0
+    return v_base + v_taper + v_col
+
+
+def test_volume_convergence():
+    panels = mesh.clip_waterplane(
+        mesh.mesh_member(STATIONS, DIAMETERS, RA, RB, dz_max=1.0, da_max=0.6)
+    )
+    vol = mesh.mesh_volume(panels)
+    assert abs(vol - analytic_submerged_volume()) / analytic_submerged_volume() < 0.01
+
+
+def test_normals_outward():
+    panels = mesh.clip_waterplane(
+        mesh.mesh_member(STATIONS, DIAMETERS, RA, RB, dz_max=4.0, da_max=2.0)
+    )
+    # positive divergence-theorem volume means outward normals
+    assert mesh.mesh_volume(panels) > 0
+    # every centroid normal should point away from the member axis or be axial
+    cen, nrm, areas = mesh.panel_geometry(panels)
+    radial = cen[:, :2]
+    rn = np.einsum("ij,ij->i", radial, nrm[:, :2])
+    side = np.abs(nrm[:, 2]) < 0.7
+    assert (rn[side] > -1e-6).all()
+
+
+def test_clip_drops_above_water_panels():
+    panels = mesh.mesh_member(STATIONS, DIAMETERS, RA, RB, dz_max=4.0, da_max=2.0)
+    assert panels[:, :, 2].max() > 1.0          # mesh extends above water
+    clipped = mesh.clip_waterplane(panels)
+    assert clipped[:, :, 2].max() <= 1e-12
+    assert len(clipped) < len(panels)
+
+
+def test_dedupe_and_pnl_roundtrip(tmp_path):
+    panels = mesh.clip_waterplane(
+        mesh.mesh_member(STATIONS, DIAMETERS, RA, RB, dz_max=6.0, da_max=3.0)
+    )
+    nodes, conn = mesh.dedupe_nodes(panels)
+    assert conn.max() < len(nodes)
+    # every shared edge vertex appears once in the node table
+    assert len(np.unique(np.round(nodes, 6), axis=0)) == len(nodes)
+    path = str(tmp_path / "HullMesh.pnl")
+    mesh.write_pnl(path, nodes, conn)
+    nodes2, conn2 = mesh.read_pnl(path)
+    assert np.allclose(nodes2, nodes, atol=1e-5)
+    assert (conn2 == conn).all()
+
+
+def test_gdf_roundtrip(tmp_path):
+    panels = mesh.mesh_member(STATIONS, DIAMETERS, RA, RB, dz_max=8.0, da_max=4.0)
+    path = str(tmp_path / "mesh.gdf")
+    mesh.write_gdf(path, panels)
+    back = mesh.read_gdf(path)
+    assert back.shape == panels.shape
+    assert np.allclose(back, panels, atol=1e-5)
+
+
+def test_native_matches_python():
+    lib = mesh._load_native()
+    if lib is None:
+        pytest.skip("native mesher library not built")
+    r_rp, z_rp = mesh.profile_points(
+        np.array(STATIONS), 0.5 * np.array(DIAMETERS), 4.0, 2.0
+    )
+    py = mesh.revolve_profile(r_rp, z_rp, 2.0)
+    nat = mesh._native_or_python_revolve(r_rp, z_rp, 2.0)
+    assert py.shape == nat.shape
+    assert np.allclose(py, nat, atol=1e-12)
+
+
+def test_inclined_member_pose():
+    # horizontal pontoon: a cylinder along +x at depth -15
+    rA = np.array([-10.0, 0.0, -15.0])
+    rB = np.array([30.0, 0.0, -15.0])
+    panels = mesh.mesh_member([0.0, 40.0], [4.0, 4.0], rA, rB,
+                              dz_max=1.0, da_max=0.5)
+    vol = mesh.mesh_volume(panels)
+    assert abs(vol - np.pi / 4 * 16.0 * 40.0) / (np.pi / 4 * 16.0 * 40.0) < 0.01
+    cen = mesh.panel_geometry(panels)[0]
+    assert cen[:, 2].min() > -17.1 and cen[:, 2].max() < -12.9
+
+
+def test_mesh_platform_pot_members():
+    from raft_tpu.designs import demo_semi
+    from raft_tpu.geometry import process_members
+
+    design = demo_semi()
+    design["platform"]["potModMaster"] = 2
+    members = process_members(design)
+    # tower (type 1) is in the list but above water; platform members meshed
+    panels = mesh.mesh_platform(
+        [m for m in members if m.type != 1], dz_max=3.0, da_max=3.0
+    )
+    assert len(panels) > 50
+    assert panels[:, :, 2].max() <= 1e-12
