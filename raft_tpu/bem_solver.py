@@ -126,8 +126,7 @@ def _radiation_normals(pa):
     return np.concatenate([pa.nrm.T, rxn.T], axis=0)  # [6, N]
 
 
-def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81,
-              batch=8, return_potentials=False):
+def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81):
     """Radiation + diffraction solve over frequencies.
 
     panels : [npan,4,3] wetted-hull panels (outward normals)
@@ -257,11 +256,13 @@ def coeffs_from_members(members, omegas, headings_deg=(0.0,), rho=1025.0,
     from raft_tpu.bem import HydroCoeffs
     from raft_tpu.mesh import mesh_platform
 
+    from raft_tpu.mesh import panel_geometry
+
     omegas = np.sort(np.asarray(omegas, float))
     panels = mesh_platform(members, dz_max=dz_max, da_max=da_max)
     if len(panels) == 0:
         raise ValueError("no potMod members to mesh for the BEM solve")
-    size = float(np.sqrt(np.median(panel_arrays(panels).area)))
+    size = float(np.sqrt(np.median(panel_geometry(panels)[2])))
     w_cap = max_resolved_omega(size, g=g)
     w_solve = np.unique(np.minimum(omegas, w_cap))
     betas = np.deg2rad(np.asarray(headings_deg, float))

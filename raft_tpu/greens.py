@@ -48,7 +48,8 @@ _TABLE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 # [-B_MAX, 0] on a log grid y = log(-b), y in [Y_MIN, Y_MAX]
 A_MAX = 100.0
 NA = 1001
-Y_MIN, Y_MAX = np.log(1e-5), np.log(40.0)
+B_MAX = 40.0
+Y_MIN, Y_MAX = np.log(1e-5), np.log(B_MAX)
 NY = 200
 
 
@@ -99,7 +100,7 @@ def singular_parts(a, b, xp=np):
                               direction-dependent at the origin)
     """
     s = xp.sqrt(a * a + b * b)
-    smb = xp.maximum(s - b, 1e-30) if xp is np else xp.maximum(s - b, 1e-30)
+    smb = xp.maximum(s - b, 1e-30)
     return -_EULER_GAMMA - xp.log(smb / 2.0), a / smb
 
 
@@ -150,10 +151,11 @@ def load_tables():
 def interp_F_F1(a, b, F_tab, F1_tab):
     """Bilinear table interpolation of F, F1 at (a, b) — JAX, any shape.
 
-    Out-of-table behavior: a > A_MAX uses the large-argument stationary-phase
-    asymptote F ~ -pi e^b Y0(a) - 1/s, F1 ~ -pi e^b Y1(a) - b/(a s)
-    (verified against quadrature in tests); b < -B_MAX returns the asymptote
-    too (the wave term is ~e^b there, negligible); b -> 0 clamps to the
+    Out-of-table behavior: a > A_MAX or b < -B_MAX uses the large-argument
+    asymptote F ~ -pi e^b Y0(a) - 1/s, F1 ~ -pi e^b Y1(a) - (1+b/s)/a
+    (stationary-phase for large a; for deep b the e^b factor vanishes and
+    the -1/s / -(1+b/s)/a terms are the exact leading Laplace-transform
+    behavior — verified against quadrature in tests); b -> 0 clamps to the
     log-grid floor y_min (the log-singular sliver above it is handled by the
     caller's panel quadrature smoothing).
     """
@@ -191,11 +193,13 @@ def interp_F_F1(a, b, F_tab, F1_tab):
     F1 = bilin(jnp.asarray(F1_tab)) + F1_sing
 
     # large-a / large-|b| asymptote
+    # F ~ -pi e^b Y0(a) - (L + dL/db) with L = 1/s, dL/db = -b/s^3: the
+    # second Laplace-series term matters in the deep-b regime (|b| ~ 50)
     eb = jnp.exp(jnp.maximum(b, -80.0))
     a_s = jnp.maximum(a, 1e-6)
-    F_asym = -jnp.pi * eb * bessel.y0(a_s) - 1.0 / s
+    F_asym = -jnp.pi * eb * bessel.y0(a_s) - 1.0 / s + b / s**3
     F1_asym = -jnp.pi * eb * bessel.y1(a_s) - (1.0 + b / s) / a_s
-    out = a > A_MAX
+    out = (a > A_MAX) | (b < -B_MAX)
     F = jnp.where(out, F_asym, F)
     F1 = jnp.where(out, F1_asym, F1)
     return F, F1

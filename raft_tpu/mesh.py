@@ -420,7 +420,9 @@ def mesh_rect_member(stations, side_lengths, rA, rB, dz_max=0.0, da_max=0.0,
     chunks = []
     n_a = max(1, int(np.ceil(float(np.max(sls[:, 0])) / da_max)))
     n_b = max(1, int(np.ceil(float(np.max(sls[:, 1])) / da_max)))
-    n_per = [n_b, n_a, n_b, n_a]  # panels along each perimeter edge
+    # edges 0/2 run corner->corner along the x side (length sl[:,0]),
+    # edges 1/3 along the y side (length sl[:,1])
+    n_per = [n_a, n_b, n_a, n_b]  # panels along each perimeter edge
     for i in range(len(zs) - 1):
         c1 = corners(i)
         c2 = corners(i + 1)
@@ -430,8 +432,8 @@ def mesh_rect_member(stations, side_lengths, rA, rB, dz_max=0.0, da_max=0.0,
             chunks.append(_grid_quads(c1[e], c1[j], c2[e], c2[j],
                                       n_per[e], 1))
     # end caps (normals along -z at end A, +z at end B in local frame)
-    cA = corners(0)
-    chunks.append(_grid_quads(cA[0], cA[3], cA[1], cA[2], n_a, n_b))
+    cA = corners(0)  # u: c0->c3 runs along the y side, v along the x side
+    chunks.append(_grid_quads(cA[0], cA[3], cA[1], cA[2], n_b, n_a))
     cB = corners(len(zs) - 1)
     chunks.append(_grid_quads(cB[0], cB[1], cB[3], cB[2], n_a, n_b))
 

@@ -183,7 +183,6 @@ class Model:
         dz_BEM/da_BEM.
         """
         from raft_tpu.bem_solver import coeffs_from_members
-        from raft_tpu.io.schema import get_from_dict
 
         platform = self.design["platform"]
         dz = dz_max if dz_max is not None else get_from_dict(
@@ -714,8 +713,13 @@ class Model:
         if hasattr(self, "Xi"):
             r = self.results.setdefault("response", {})
             with np.errstate(divide="ignore", invalid="ignore"):
+                # bins where the wave spectrum underflows to exactly zero
+                # (far tails of JONSWAP) carry zero response too; report a
+                # zero RAO there instead of the reference's 0/0 NaN
+                # (raft_model.py:707)
                 zeta = np.where(np.abs(self.zeta) > 0, self.zeta, np.nan)
                 RAOmag = np.abs(self.Xi / zeta[:, None, :])  # [case, 6, nw]
+                RAOmag = np.where(np.isfinite(RAOmag), RAOmag, 0.0)
             r["frequencies"] = self.w / 2 / np.pi
             r["wave elevation"] = self.zeta
             r["Xi"] = self.Xi

@@ -59,6 +59,20 @@ def test_far_field_asymptote():
     assert np.max(np.abs(np.asarray(F1i) - F1d)) < 1e-3
 
 
+def test_deep_b_asymptote():
+    """Below the table floor (b < -B_MAX) the kernel must fall back to the
+    -1/s leading behavior, not the table-edge value (regression: deep-draft
+    hulls like the OC3 spar reach b ~ -240 nu inside the solve band)."""
+    F_tab, F1_tab = greens.load_tables()
+    a = np.array([0.5, 3.0, 20.0])
+    b = np.array([-50.0, -100.0, -200.0])
+    Fi, F1i = greens.interp_F_F1(a, b, F_tab, F1_tab)
+    Fd, F1d = greens.compute_F_F1(a, b)
+    # exact values are O(1/|b|); require small absolute + relative error
+    assert np.max(np.abs(np.asarray(Fi) - Fd)) < 2e-4
+    assert np.max(np.abs(np.asarray(F1i) - F1d)) < 2e-4
+
+
 def test_wave_term_derivative_consistency():
     """dGw/dR and dGw/dz from the tables vs finite differences of Gw."""
     F_tab, F1_tab = greens.load_tables()

@@ -100,6 +100,20 @@ def test_inclined_member_pose():
     assert cen[:, 2].min() > -17.1 and cen[:, 2].max() < -12.9
 
 
+def test_rect_member_box():
+    """Non-square box: exact volume and the requested panel size honored in
+    BOTH azimuthal directions (regression: the per-edge subdivision counts
+    were swapped, giving 10 m panels on the long side)."""
+    panels = mesh.mesh_rect_member(
+        [0.0, 5.0], [[10.0, 2.0], [10.0, 2.0]],
+        np.array([0.0, 0.0, -5.0]), np.array([0.0, 0.0, 0.0]),
+        dz_max=2.5, da_max=2.0,
+    )
+    assert abs(mesh.mesh_volume(panels) - 100.0) < 1e-9
+    edges = np.linalg.norm(np.roll(panels, -1, axis=1) - panels, axis=2)
+    assert edges.max() <= 2.5 + 1e-9
+
+
 def test_mesh_platform_pot_members():
     from raft_tpu.designs import demo_semi
     from raft_tpu.geometry import process_members
