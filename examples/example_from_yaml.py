@@ -1,0 +1,45 @@
+"""Full-pipeline example: load a YAML design, run the complete analysis,
+print the standard output tables, and save plots.
+
+Equivalent of the reference's examples/example_from_yaml.py.  Uses the
+built-in demo semisubmersible when no YAML path is given.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from raft_tpu.model import Model
+from raft_tpu.utils.profiling import Timers
+
+
+def main(path=None):
+    if path is None:
+        from raft_tpu.designs import demo_semi
+
+        design = demo_semi(n_cases=2)
+    else:
+        design = path
+
+    with Timers() as tm:
+        model = Model(design)
+        model.analyze_unloaded()
+        model.solve_eigen()
+        model.analyze_cases(display=1)
+        model.calc_outputs()
+    tm.report(log=True)
+
+    import matplotlib
+
+    matplotlib.use("Agg")
+    fig, _ = model.plot()
+    fig.savefig("system_geometry.png", dpi=120)
+    fig, _ = model.plot_responses()
+    fig.savefig("response_psds.png", dpi=120)
+    print("saved system_geometry.png, response_psds.png")
+    return model
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else None)

@@ -1,0 +1,52 @@
+"""Sharded design-space sweep example: vary the demo semisubmersible's
+outer-column diameter and draft over a grid, solve every point with the
+design axis laid across all visible devices, checkpoint each chunk, and
+print a result table.
+
+Equivalent of the reference's raft/parametersweep.py (which runs one full
+serial model per point with no restart capability).
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from raft_tpu.designs import demo_semi
+from raft_tpu.sweep import grid_points, results_to_grid, run_sweep
+
+AXES = {"d_col": [11.0, 12.5, 14.0], "draft_scale": [0.9, 1.0, 1.1]}
+
+
+def apply_point(design, point):
+    for mem in design["platform"]["members"]:
+        if mem["name"] == "outer":
+            mem["d"] = [point["d_col"]] * len(np.atleast_1d(mem["d"]))
+        mem["rA"][2] *= point["draft_scale"]
+        if mem["rB"][2] < 0:
+            mem["rB"][2] *= point["draft_scale"]
+    return design
+
+
+def main():
+    base = demo_semi(n_cases=2)
+    points = grid_points(AXES)
+    res = run_sweep(base, points, apply_point, out_dir="sweep_ckpt")
+
+    mass = results_to_grid(res, AXES, "mass")
+    pitch = results_to_grid(res, AXES, "pitch_std_deg")[:, :, 0]
+    print("\n      mass (t) by d_col x draft_scale")
+    for i, d in enumerate(AXES["d_col"]):
+        print(f"  d={d:5.1f}: " + "  ".join(f"{mass[i,j]/1e3:9.1f}"
+                                            for j in range(len(AXES["draft_scale"]))))
+    print("\n      pitch std (deg), case 1")
+    for i, d in enumerate(AXES["d_col"]):
+        print(f"  d={d:5.1f}: " + "  ".join(f"{pitch[i,j]:9.4f}"
+                                            for j in range(len(AXES["draft_scale"]))))
+    return res
+
+
+if __name__ == "__main__":
+    main()

@@ -159,6 +159,24 @@ def write_wamit_1(path, coeffs, rho=1025.0):
                         )
 
 
+def write_wamit_3(path, coeffs, rho=1025.0, g=9.81):
+    """Write the `.3` excitation format (inverse of read_wamit_3)."""
+    if coeffs.X is None:
+        raise ValueError("coefficient set has no excitation data to write")
+    headings = np.atleast_1d(coeffs.headings)
+    with open(path, "w") as f:
+        for iw, wi in enumerate(coeffs.w):
+            T = 2.0 * np.pi / wi
+            for ih, beta in enumerate(headings):
+                for i in range(6):
+                    x = coeffs.X[iw, ih, i] / (rho * g)
+                    f.write(
+                        f"{T:14.6E} {beta:10.3f} {i+1:5d} "
+                        f"{abs(x):13.6E} {np.degrees(np.angle(x)):10.3f} "
+                        f"{x.real:13.6E} {x.imag:13.6E}\n"
+                    )
+
+
 def interp_to_grid(coeffs, w, beta=0.0):
     """Interpolate a HydroCoeffs set onto the model grid `w` [rad/s].
 

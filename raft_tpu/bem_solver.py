@@ -243,10 +243,12 @@ def max_resolved_omega(panel_size, g=9.81, panels_per_wavelength=7.0):
 
 
 def coeffs_from_members(members, omegas, headings_deg=(0.0,), rho=1025.0,
-                        g=9.81, dz_max=0.0, da_max=0.0):
+                        g=9.81, dz_max=0.0, da_max=0.0, panels=None):
     """Mesh all potMod members, run the native solver, return a HydroCoeffs
     set (same container the WAMIT-file import path produces, so the Model
     pipeline is agnostic to where coefficients came from).
+
+    A pre-built panel array can be passed to skip the meshing step.
 
     Frequencies above what the mesh resolves are clamped to the solve cap
     and back-filled with the cap value for A (B, X decay there anyway) —
@@ -254,12 +256,11 @@ def coeffs_from_members(members, omegas, headings_deg=(0.0,), rho=1025.0,
     (reference raft/raft_fowt.py:398-401).
     """
     from raft_tpu.bem import HydroCoeffs
-    from raft_tpu.mesh import mesh_platform
-
-    from raft_tpu.mesh import panel_geometry
+    from raft_tpu.mesh import mesh_platform, panel_geometry
 
     omegas = np.sort(np.asarray(omegas, float))
-    panels = mesh_platform(members, dz_max=dz_max, da_max=da_max)
+    if panels is None:
+        panels = mesh_platform(members, dz_max=dz_max, da_max=da_max)
     if len(panels) == 0:
         raise ValueError("no potMod members to mesh for the BEM solve")
     size = float(np.sqrt(np.median(panel_geometry(panels)[2])))

@@ -19,6 +19,8 @@ Architecture (TPU-first, not a port):
    ``precision=``).
 """
 
+import os
+
 import numpy as np
 
 import jax
@@ -39,6 +41,7 @@ from raft_tpu.mooring import (
     parse_mooring,
 )
 from raft_tpu.statics import compute_statics, member_inertia
+from raft_tpu.utils.profiling import timer
 from raft_tpu.utils.frames import (
     transform_force,
     translate_matrix_3to6,
@@ -49,6 +52,48 @@ from raft_tpu.waves import wave_kinematics, wave_number
 _RAD2DEG = 57.29577951308232
 
 _SPECTRUM_CODES = {"still": 0, "none": 0, "unit": 1, "JONSWAP": 2}
+
+
+def make_case_dynamics(w, k, depth, rho, g, XiStart, nIter, dtype, cdtype):
+    """Build the single-case device function
+    ``fn(nodes, zeta[nw], beta, C_lin[6,6], M_lin[nw,6,6], B_lin[nw,6,6],
+    F_add_r[nw,6], F_add_i[nw,6]) -> (Xi_r[6,nw], Xi_i[6,nw], iters, conv)``.
+
+    ``nodes`` is an explicit argument (a HydroNodes pytree in the working
+    dtype) so callers can vmap over *designs* as well as cases — the sweep
+    driver (raft_tpu/sweep.py) batches padded node bundles over a device
+    mesh, while :meth:`Model.case_pipeline_fn` closes over one design's
+    nodes and vmaps over cases only.
+    """
+    w = np.asarray(w).astype(dtype)
+    k = np.asarray(k).astype(dtype)
+    dw = float(w[1] - w[0])
+    rho = float(rho)
+    depth = float(depth)
+    g = float(g)
+    nIter = int(nIter)
+    XiStart = float(XiStart)
+
+    def one_case(nodes, zeta, beta, C_lin, M_lin, B_lin, F_add_r, F_add_i):
+        # full-f32 matmul precision: the TPU's default bf16 matmul passes
+        # cost ~3 decimal digits on the RAO (measured 4e-3 L_inf vs 2e-6
+        # with this), and the matmuls here are tiny (6x6 solves, [N,3,3]
+        # einsums) so the highest-precision path is essentially free
+        with jax.default_matmul_precision("highest"):
+            u, ud, pD = wave_kinematics(
+                zeta.astype(cdtype), beta, w, k, depth, nodes.r,
+                rho=rho, g=g, dtype=cdtype,
+            )
+            F_iner = excitation_froude_krylov(nodes, u, ud, pD, rho)  # [nw,6]
+            Fr = jnp.real(F_iner) + F_add_r
+            Fi = jnp.imag(F_iner) + F_add_i
+            xr, xi, iters, conv = solve_dynamics(
+                nodes, u, w, dw, rho, M_lin, B_lin, C_lin, Fr, Fi,
+                XiStart, nIter=nIter,
+            )
+        return xr, xi, iters, conv
+
+    return one_case
 
 
 class Model:
@@ -145,10 +190,11 @@ class Model:
         elif ballast == 2:
             self.adjust_ballast_density()
 
-        self.statics = compute_statics(
-            self.members, self.design["turbine"], self.rho_water, self.g
-        )
-        self._A_morison = np.asarray(self._added_mass_f64())
+        with timer("statics"):
+            self.statics = compute_statics(
+                self.members, self.design["turbine"], self.rho_water, self.g
+            )
+            self._A_morison = np.asarray(self._added_mass_f64())
 
         self.results["properties"] = {}
         Xi0 = self._mooring_and_offsets(np.zeros((1, 6)))[0][0]
@@ -170,7 +216,8 @@ class Model:
         )
         return self.bem_coeffs
 
-    def run_bem(self, headings=(0.0,), nw_bem=24, dz_max=None, da_max=None):
+    def run_bem(self, headings=(0.0,), nw_bem=24, dz_max=None, da_max=None,
+                panels=None):
         """Run the NATIVE radiation/diffraction panel solver on all potMod
         members (the reference's calcBEM path, raft/raft_fowt.py:318-423,
         with the external Fortran HAMS subprocess replaced by the TPU-native
@@ -195,7 +242,7 @@ class Model:
         self.bem_coeffs = coeffs_from_members(
             [m for m in self.members if m.potMod], w_bem,
             headings_deg=headings, rho=self.rho_water, g=self.g,
-            dz_max=dz, da_max=da,
+            dz_max=dz, da_max=da, panels=panels,
         )
         return self.bem_coeffs
 
@@ -327,43 +374,18 @@ class Model:
 
         Exposed separately so the driver entry point and the multichip dryrun
         can jit it with explicit shardings."""
-        dtype, cdtype = self.dtype, self.cdtype
-        nodes = self.nodes.astype(dtype)
-        w = self.w.astype(dtype)
-        k = self.k.astype(dtype)
-        dw = float(self.dw)
-        rho = float(self.rho_water)
-        depth = float(self.depth)
-        g = float(self.g)
-        nIter = int(self.nIter)
-        XiStart = float(self.XiStart)
-
-        def one_case(zeta, beta, C_lin, M_lin, B_lin, F_add_r, F_add_i):
-            # full-f32 matmul precision: the TPU's default bf16 matmul passes
-            # cost ~3 decimal digits on the RAO (measured 4e-3 L_inf vs 2e-6
-            # with this), and the matmuls here are tiny (6x6 solves, [N,3,3]
-            # einsums) so the highest-precision path is essentially free
-            with jax.default_matmul_precision("highest"):
-                u, ud, pD = wave_kinematics(
-                    zeta.astype(cdtype), beta, w, k, depth, nodes.r,
-                    rho=rho, g=g, dtype=cdtype,
-                )
-                F_iner = excitation_froude_krylov(nodes, u, ud, pD, rho)  # [nw,6]
-                Fr = jnp.real(F_iner) + F_add_r
-                Fi = jnp.imag(F_iner) + F_add_i
-                xr, xi, iters, conv = solve_dynamics(
-                    nodes, u, w, dw, rho, M_lin, B_lin, C_lin, Fr, Fi,
-                    XiStart, nIter=nIter,
-                )
-            return xr, xi, iters, conv
-
-        return jax.vmap(one_case)
+        one_case = make_case_dynamics(
+            self.w, self.k, self.depth, self.rho_water, self.g,
+            self.XiStart, self.nIter, self.dtype, self.cdtype,
+        )
+        nodes = self.nodes.astype(self.dtype)
+        return jax.vmap(lambda *a: one_case(nodes, *a))
 
     def _build_pipeline(self):
         """The single jitted device graph: [case] -> Xi, F_iner."""
         return jax.jit(self.case_pipeline_fn())
 
-    def prepare_case_inputs(self, cases=None):
+    def prepare_case_inputs(self, cases=None, verbose=True):
         """Host-side setup for the batched case solve: per-case aero means,
         mooring equilibrium/linearization, and assembly of the linear-term
         arrays (reference solveStatics + the pre-sums at
@@ -403,12 +425,14 @@ class Model:
 
         # ---- mean offsets & linearized mooring, all cases in one jitted
         # vmapped CPU f64 call ----
-        Xi0, C_moor, _, T_moor, J_moor = self._mooring_and_offsets(F_aero0)
-        for i in range(ncase):
-            print(
-                f"Case {i+1}: mean offsets surge={Xi0[i,0]:.2f} m, "
-                f"pitch={Xi0[i,4]*_RAD2DEG:.2f} deg"
-            )
+        with timer("mooring_offsets"):
+            Xi0, C_moor, _, T_moor, J_moor = self._mooring_and_offsets(F_aero0)
+        if verbose:
+            for i in range(ncase):
+                print(
+                    f"Case {i+1}: mean offsets surge={Xi0[i,0]:.2f} m, "
+                    f"pitch={Xi0[i,4]*_RAD2DEG:.2f} deg"
+                )
 
         # ---- re-run aero at the mean platform pitch (reference
         # solveStatics second pass, raft_model.py:516-517) and build the
@@ -504,8 +528,11 @@ class Model:
 
         # ---- the batched device solve ----
         if self._pipeline is None:
-            self._pipeline = self._build_pipeline()
-        xr, xi, iters, conv = self._pipeline(*(jnp.asarray(a) for a in args))
+            with timer("pipeline_compile"):
+                self._pipeline = self._build_pipeline()
+        with timer("rao_solve"):
+            xr, xi, iters, conv = self._pipeline(*(jnp.asarray(a) for a in args))
+            jax.block_until_ready(xr)
         Xi = np.asarray(xr, np.float64) + 1j * np.asarray(xi, np.float64)  # [case,6,nw]
         self.Xi = Xi
         self.zeta = zeta
@@ -857,8 +884,103 @@ class Model:
                 mem.rho_fill = np.where(lf > 0.0, rf + delta_rho, rf)
         return delta_rho
 
+    # ------------------------------------------------------------------
+    # HAMS/OpenFAST interop
+    # ------------------------------------------------------------------
+
+    def preprocess_hams(self, dw=0, wMax=0, dz=0, da=0, mesh_dir="BEM",
+                        headings=(0.0,), nw_bem=24):
+        """Generate the HAMS working tree (Input/HullMesh.pnl,
+        ControlFile.in, Hydrostatic.in) and WAMIT-format ``.1``/``.3``
+        output files for OpenFAST handoff (reference
+        raft/raft_model.py:769-790 preprocess_HAMS + raft_fowt.py:349-391),
+        with the Fortran HAMS run replaced by the native panel solver.
+
+        The tree is drop-in compatible: point an external HAMS build at
+        ``mesh_dir`` to recompute with higher fidelity, then load its
+        output with :meth:`import_bem`.
+        """
+        from raft_tpu.bem import write_wamit_1, write_wamit_3
+        from raft_tpu.hams_io import (
+            create_hams_dirs,
+            write_control_file,
+            write_hydrostatic_file,
+        )
+        from raft_tpu.mesh import dedupe_nodes, mesh_platform, write_pnl
+
+        platform = self.design["platform"]
+        dz = dz or get_from_dict(platform, "dz_BEM", default=3.0)
+        da = da or get_from_dict(platform, "da_BEM", default=2.0)
+
+        panels = mesh_platform(self.members, dz_max=dz, da_max=da)
+        if len(panels) == 0:
+            raise RuntimeError(
+                "preprocess_hams: no members have potMod=True"
+            )
+        create_hams_dirs(mesh_dir)
+        nodes, conn = dedupe_nodes(panels)
+        write_pnl(
+            os.path.join(mesh_dir, "Input", "HullMesh.pnl"), nodes, conn
+        )
+        if self.statics is None:
+            self.analyze_unloaded()
+        write_hydrostatic_file(mesh_dir, k_hydro=self.statics.C_hydro)
+        dw_hams = float(dw) if dw else get_from_dict(
+            platform, "dw_BEM", default=0.05)
+        w_max = max(float(wMax), float(self.w[-1]))
+        write_control_file(
+            mesh_dir, water_depth=self.depth,
+            num_freqs=-int(np.ceil(w_max / dw_hams)),
+            min_freq=dw_hams, d_freq=dw_hams,
+            num_headings=len(headings),
+            min_heading=float(headings[0]),
+            d_heading=(float(headings[1] - headings[0])
+                       if len(headings) > 1 else 0.0),
+        )
+
+        coeffs = self.run_bem(
+            headings=headings, nw_bem=nw_bem, dz_max=dz, da_max=da,
+            panels=panels,
+        )
+        out = os.path.join(mesh_dir, "Output", "Wamit_format")
+        write_wamit_1(os.path.join(out, "Buoy.1"), coeffs,
+                      rho=self.rho_water)
+        write_wamit_3(os.path.join(out, "Buoy.3"), coeffs,
+                      rho=self.rho_water, g=self.g)
+        return mesh_dir
+
+    preprocess_HAMS = preprocess_hams
+
+    # ------------------------------------------------------------------
+    # plotting (host-side, optional; raft_tpu/viz.py)
+    # ------------------------------------------------------------------
+
+    def plot(self, ax=None, color="k", nodes=False, **kwargs):
+        """3-D wireframe of the full system
+        (reference raft/raft_model.py:792-823).  Reference-only keyword
+        arguments (hideGrid, draw_body, ...) are accepted and ignored so
+        ported call sites keep working."""
+        import inspect
+
+        from raft_tpu.viz import plot_model
+
+        accepted = inspect.signature(plot_model).parameters
+        ignored = [k for k in kwargs if k not in accepted]
+        if ignored:
+            print(f"Model.plot: ignoring unsupported options {ignored}")
+        kwargs = {k: v for k, v in kwargs.items() if k in accepted}
+        return plot_model(self, ax=ax, color=color, nodes=nodes, **kwargs)
+
+    def plot_responses(self, channels=None):
+        """Response PSD subplot grid
+        (reference raft/raft_model.py:730-765)."""
+        from raft_tpu.viz import plot_responses
+
+        return plot_responses(self, channels=channels)
+
     # camelCase aliases for reference-API compatibility
     analyzeUnloaded = analyze_unloaded
+    plotResponses = plot_responses
     adjustBallast = adjust_ballast
     analyzeCases = analyze_cases
     solveEigen = solve_eigen

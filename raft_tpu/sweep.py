@@ -1,0 +1,236 @@
+"""Sharded design-space sweep driver.
+
+Replaces the reference's serial nested-loop parameter sweep
+(reference raft/parametersweep.py:56-100: 3^5 VolturnUS-S geometry variants,
+one full Model run each, no checkpointing) with a TPU-first batch pipeline:
+
+ - host side, each design point is preprocessed independently (geometry
+   packing, statics, per-case mooring equilibrium — all NumPy f64);
+ - the packed strip-node bundles are padded to a common node count and
+   stacked, so the whole sweep chunk is ONE pytree with a leading
+   [design] axis;
+ - the case-dynamics graph (wave kinematics -> Froude-Krylov -> drag
+   linearization fixed point -> per-frequency 6x6 solves) is vmapped over
+   cases AND designs and jitted with an explicit NamedSharding that lays the
+   design axis across the device mesh — XLA runs each shard's designs on its
+   own chip with zero communication (the sweep is embarrassingly parallel;
+   the only collective is the implicit all-gather when results are fetched);
+ - chunks of `mesh size` designs are processed at a time, and every chunk's
+   results are checkpointed to an .npz so a crashed 243-point sweep resumes
+   instead of restarting (the reference has no checkpoint/restart —
+   SURVEY.md §5).
+
+Typical use::
+
+    points = grid_points({"d_col": [9, 10, 11], "draft": [18, 20, 22]})
+    res = run_sweep(base_design, points, apply_point, out_dir="sweep_ckpt")
+"""
+
+import copy
+import dataclasses
+import itertools
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from raft_tpu.geometry import HydroNodes
+from raft_tpu.model import Model, make_case_dynamics
+
+
+def grid_points(axes):
+    """Cartesian product of named parameter axes -> list of dicts
+    (the reference's nested loops, parametersweep.py:56-84)."""
+    names = list(axes)
+    return [
+        dict(zip(names, vals))
+        for vals in itertools.product(*(axes[n] for n in names))
+    ]
+
+
+def pad_and_stack_nodes(nodes_list):
+    """Stack a list of HydroNodes into one bundle with a leading [design]
+    axis, zero-padding the node axis to the largest design.
+
+    Zero padding is inert by construction: padded nodes have zero strip
+    volumes/areas and False submerged/strip masks, so every hydro term they
+    touch (added mass, Froude-Krylov, drag linearization) contributes 0.
+    """
+    N = max(n.r.shape[0] for n in nodes_list)
+    out = {}
+    for f in dataclasses.fields(HydroNodes):
+        arrs = []
+        for n in nodes_list:
+            a = getattr(n, f.name)
+            pad = N - a.shape[0]
+            if pad:
+                a = np.concatenate(
+                    [a, np.zeros((pad,) + a.shape[1:], a.dtype)], axis=0
+                )
+            arrs.append(a)
+        out[f.name] = np.stack(arrs)
+    return HydroNodes(**out)
+
+
+def _prepare_design(base_design, point, apply_point, precision):
+    """One design point -> (model, nodes, args) on host."""
+    design = copy.deepcopy(base_design)
+    design = apply_point(design, point) or design
+    model = Model(design, precision=precision)
+    model.analyze_unloaded()
+    args, _ = model.prepare_case_inputs(verbose=False)
+    return model, model.nodes.astype(model.dtype), args
+
+
+def default_collect(model, point, Xi):
+    """Per-design summary metrics (the reference sweep's getOutputs,
+    parametersweep.py:9-21, plus response statistics).
+
+    Xi : [ncase, 6, nw] complex response amplitudes.
+    """
+    st = model.statics
+    dw = model.dw
+    std = np.sqrt(np.sum(np.abs(Xi) ** 2, axis=-1) * dw)  # [ncase, 6]
+    return {
+        "mass": st.mass,
+        "displacement": st.V,
+        "GMT": st.zMeta - st.rCG_TOT[2],
+        "surge_std": std[:, 0],
+        "heave_std": std[:, 2],
+        "pitch_std_deg": np.rad2deg(std[:, 4]),
+    }
+
+
+def make_sweep_mesh(devices=None):
+    """1-D 'design' mesh over all (or the given) local devices."""
+    devs = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.array(devs), ("design",))
+
+
+def run_sweep(
+    base_design,
+    points,
+    apply_point,
+    mesh=None,
+    precision=None,
+    out_dir=None,
+    collect=default_collect,
+    verbose=True,
+):
+    """Run the analysis over all design ``points`` with the design axis
+    sharded across ``mesh`` and per-chunk checkpointing under ``out_dir``.
+
+    Parameters
+    ----------
+    base_design : dict
+        The template design (all points share its cases table + settings,
+        so every point solves the same [case, freq] batch shape).
+    points : list[dict]
+        Parameter values per design point (see :func:`grid_points`).
+    apply_point : callable(design, point) -> design | None
+        Mutates/returns a deep copy of the base design for one point —
+        the equivalent of the reference's dependent-geometry update block
+        (parametersweep.py:60-100).
+    mesh : jax.sharding.Mesh | None
+        1-D mesh with axis "design"; default spans all local devices.
+    out_dir : str | None
+        Checkpoint directory. Chunk k's results live in ``chunk_{k:04d}.npz``
+        and are loaded instead of recomputed on restart.
+
+    Returns
+    -------
+    dict of stacked result arrays, leading axis = len(points), plus
+    ``Xi`` [npoints, ncase, 6, nw] complex response amplitudes.
+    """
+    if mesh is None:
+        mesh = make_sweep_mesh()
+    n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+
+    sharding = NamedSharding(mesh, P("design"))
+    pipeline = None  # built after the first chunk is prepped (needs w grid)
+
+    npoints = len(points)
+    chunk_results = []
+    for k0 in range(0, npoints, n_dev):
+        k = k0 // n_dev
+        ck_path = os.path.join(out_dir, f"chunk_{k:04d}.npz") if out_dir else None
+        chunk_pts = points[k0 : k0 + n_dev]
+        n_real = len(chunk_pts)
+
+        if ck_path and os.path.exists(ck_path):
+            with np.load(ck_path, allow_pickle=False) as zf:
+                chunk_results.append({key: zf[key] for key in zf.files})
+            if verbose:
+                print(f"sweep chunk {k}: loaded checkpoint ({n_real} designs)")
+            continue
+
+        # host prep (independent per design; the expensive part is the
+        # vmapped CPU mooring equilibrium inside prepare_case_inputs)
+        models, nodes_list, args_list = [], [], []
+        for pt in chunk_pts:
+            m, nd, ar = _prepare_design(base_design, pt, apply_point, precision)
+            models.append(m)
+            nodes_list.append(nd)
+            args_list.append(ar)
+        # pad the ragged trailing chunk by repeating the last design so the
+        # batch still fills the mesh; the copies are dropped on collect
+        while len(nodes_list) < n_dev:
+            nodes_list.append(nodes_list[-1])
+            args_list.append(args_list[-1])
+
+        nodes_b = pad_and_stack_nodes(nodes_list)
+        args_b = tuple(
+            np.stack([a[i] for a in args_list]) for i in range(len(args_list[0]))
+        )
+
+        if pipeline is None:
+            m0 = models[0]
+            one_case = make_case_dynamics(
+                m0.w, m0.k, m0.depth, m0.rho_water, m0.g,
+                m0.XiStart, m0.nIter, m0.dtype, m0.cdtype,
+            )
+            per_design = jax.vmap(one_case, in_axes=(None, 0, 0, 0, 0, 0, 0, 0))
+            pipeline = jax.jit(
+                jax.vmap(per_design),
+                in_shardings=(sharding,) * 8,
+                out_shardings=sharding,
+            )
+
+        dev_in = jax.device_put((nodes_b,) + args_b, sharding)
+        xr, xi, iters, conv = pipeline(*dev_in)
+        xr, xi = np.asarray(xr, np.float64), np.asarray(xi, np.float64)
+        Xi = xr + 1j * xi  # [n_dev, ncase, 6, nw]
+
+        res = {"Xi_r": xr[:n_real], "Xi_i": xi[:n_real],
+               "converged": np.asarray(conv)[:n_real]}
+        per_design_metrics = [
+            collect(models[i], chunk_pts[i], Xi[i]) for i in range(n_real)
+        ]
+        for key in per_design_metrics[0]:
+            res[key] = np.stack([d[key] for d in per_design_metrics])
+        for name in chunk_pts[0]:
+            res[f"param_{name}"] = np.array([pt[name] for pt in chunk_pts])
+
+        if ck_path:
+            np.savez(ck_path, **res)
+        if verbose:
+            print(f"sweep chunk {k}: solved {n_real} designs on {n_dev} devices")
+        chunk_results.append(res)
+
+    out = {}
+    for key in chunk_results[0]:
+        out[key] = np.concatenate([c[key] for c in chunk_results], axis=0)
+    out["Xi"] = out.pop("Xi_r") + 1j * out.pop("Xi_i")
+    return out
+
+
+def results_to_grid(results, axes, key):
+    """Reshape a flat sweep result array back onto the named parameter grid
+    (for the reference's contour-matrix plots, parametersweep.py:122-561)."""
+    shape = tuple(len(v) for v in axes.values())
+    return np.asarray(results[key]).reshape(shape + results[key].shape[1:])

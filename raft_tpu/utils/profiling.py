@@ -1,0 +1,117 @@
+"""Structured logging, solve timers, and profiler hooks.
+
+The reference's observability is bare ``print()`` statements
+(reference raft/raft_model.py:241-242,363,603-611; SURVEY.md §5 'Tracing /
+profiling: None').  Here the framework gets a real instrumentation layer:
+
+ - a package logger (``raft_tpu``) with an opt-in structured formatter;
+ - ``timer`` / ``Timers``: wall-clock counters around the expensive stages
+   (geometry packing, mooring equilibrium, BEM solve, the batched RAO
+   pipeline) with per-stage call counts and totals;
+ - ``trace`` : context manager wrapping ``jax.profiler.trace`` so a TPU
+   trace of the case pipeline is one ``with`` statement
+   (view with TensorBoard or xprof).
+
+Everything is no-overhead-by-default: timers are only active inside an
+explicit ``Timers()`` context, and the logger follows standard logging
+levels.
+"""
+
+import contextlib
+import logging
+import time
+
+logger = logging.getLogger("raft_tpu")
+
+
+def configure_logging(level=logging.INFO, structured=False):
+    """Attach a stream handler to the package logger.
+
+    structured=True emits ``key=value`` lines (machine-parseable);
+    otherwise a plain human format is used.
+    """
+    fmt = (
+        "ts=%(created).3f level=%(levelname)s module=%(module)s msg=%(message)s"
+        if structured
+        else "[raft_tpu %(levelname)s] %(message)s"
+    )
+    handler = logging.StreamHandler()
+    handler.setFormatter(logging.Formatter(fmt))
+    logger.handlers = [handler]
+    logger.setLevel(level)
+    return logger
+
+
+class Timers:
+    """Accumulating named wall-clock counters.
+
+    >>> tm = Timers()
+    >>> with tm.time("rao_solve"):
+    ...     run()
+    >>> tm.report()
+    {'rao_solve': {'calls': 1, 'total_s': ..., 'mean_s': ...}}
+    """
+
+    _active = None  # innermost active Timers (for the module-level timer())
+
+    def __init__(self):
+        self.counters = {}
+
+    def __enter__(self):
+        self._prev = Timers._active
+        Timers._active = self
+        return self
+
+    def __exit__(self, *exc):
+        Timers._active = self._prev
+        return False
+
+    @contextlib.contextmanager
+    def time(self, name):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            c = self.counters.setdefault(name, {"calls": 0, "total_s": 0.0})
+            c["calls"] += 1
+            c["total_s"] += dt
+
+    def report(self, log=False):
+        out = {
+            k: {**v, "mean_s": v["total_s"] / max(v["calls"], 1)}
+            for k, v in self.counters.items()
+        }
+        if log:
+            for k, v in sorted(out.items(), key=lambda kv: -kv[1]["total_s"]):
+                logger.info(
+                    "timer %s: calls=%d total=%.4fs mean=%.4fs",
+                    k, v["calls"], v["total_s"], v["mean_s"],
+                )
+        return out
+
+
+@contextlib.contextmanager
+def timer(name):
+    """Time a block against the innermost active ``Timers`` context;
+    a silent no-op when none is active (so library code can instrument
+    unconditionally)."""
+    tm = Timers._active
+    if tm is None:
+        yield
+    else:
+        with tm.time(name):
+            yield
+
+
+@contextlib.contextmanager
+def trace(log_dir="/tmp/raft_tpu_trace"):
+    """Capture a JAX/XLA profiler trace of the enclosed block
+    (open in TensorBoard: `tensorboard --logdir <log_dir>`)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()

@@ -1,0 +1,207 @@
+"""Visualization: 3-D system geometry and response-spectrum plots.
+
+Re-provides the reference's plotting surface (reference
+raft/raft_model.py:730-765 plotResponses, :792-823 plot;
+raft/raft_member.py:801-873 member wireframes; mooring-line profiles drawn
+by MoorPy's ms.plot) on top of matplotlib.  All functions are host-side and
+optional — nothing in the numeric path imports this module.
+"""
+
+import numpy as np
+
+
+def _require_mpl():
+    import matplotlib
+
+    matplotlib.use("Agg", force=False)
+    import matplotlib.pyplot as plt  # noqa: F401
+
+    return plt
+
+
+# ------------------------------------------------------------------ members
+
+def member_wireframe(mem, n_az=12):
+    """Line segments ([n, 2, 3] arrays) tracing one member: longitudinal
+    edges at n_az azimuths plus a ring/rectangle at each station
+    (the reference draws the same station-ring + edge wireframe,
+    raft_member.py:801-873)."""
+    lines = []
+    stations = np.asarray(mem.stations, float)
+    if mem.circular:
+        radii = 0.5 * np.asarray(mem.d, float)
+        az = np.linspace(0, 2 * np.pi, n_az, endpoint=False)
+        # longitudinal edges
+        for a in az[:: max(1, n_az // 6)]:
+            pts = [
+                mem.rA + mem.q * s
+                + r * (np.cos(a) * mem.p1 + np.sin(a) * mem.p2)
+                for s, r in zip(stations, radii)
+            ]
+            lines.extend(
+                np.stack([p0, p1]) for p0, p1 in zip(pts[:-1], pts[1:])
+            )
+        # station rings
+        ring_az = np.linspace(0, 2 * np.pi, 24)
+        for s, r in zip(stations, radii):
+            ring = np.stack(
+                [
+                    mem.rA + mem.q * s
+                    + r * (np.cos(a) * mem.p1 + np.sin(a) * mem.p2)
+                    for a in ring_az
+                ]
+            )
+            lines.extend(
+                np.stack([p0, p1]) for p0, p1 in zip(ring[:-1], ring[1:])
+            )
+    else:
+        sl = np.asarray(mem.sl, float)  # [n, 2]
+        corners = np.array([[1, 1], [1, -1], [-1, -1], [-1, 1]]) * 0.5
+        ringpts = []
+        for s, (s1, s2) in zip(stations, sl):
+            ring = np.stack(
+                [
+                    mem.rA + mem.q * s + c1 * s1 * mem.p1 + c2 * s2 * mem.p2
+                    for c1, c2 in corners
+                ]
+            )
+            ringpts.append(ring)
+            closed = np.vstack([ring, ring[:1]])
+            lines.extend(
+                np.stack([p0, p1]) for p0, p1 in zip(closed[:-1], closed[1:])
+            )
+        for r0, r1 in zip(ringpts[:-1], ringpts[1:]):
+            lines.extend(np.stack([p0, p1]) for p0, p1 in zip(r0, r1))
+    return lines
+
+
+# ------------------------------------------------------------- mooring lines
+
+def line_profile(anchor, fairlead, HF, VF, L, EA, w, n=40):
+    """Sampled 3-D shape of one catenary mooring line from the converged
+    fairlead tension components (the same elastic-catenary branches as
+    mooring._profile, evaluated at n arc-length stations from the anchor)."""
+    anchor = np.asarray(anchor, float)
+    fairlead = np.asarray(fairlead, float)
+    dxy = fairlead[:2] - anchor[:2]
+    XF = max(float(np.hypot(*dxy)), 1e-9)
+    u = dxy / XF
+    s = np.linspace(0.0, L, n)
+    VA = VF - w * L
+    if VA >= 0:  # fully suspended
+        Vs = VA + w * s
+        x = HF / w * (np.arcsinh(Vs / HF) - np.arcsinh(VA / HF)) + HF * s / EA
+        z = (
+            HF / w * (np.sqrt(1 + (Vs / HF) ** 2) - np.sqrt(1 + (VA / HF) ** 2))
+            + (VA * s + 0.5 * w * s**2) / EA
+        )
+    else:  # touchdown: seabed segment of length LB, then catenary
+        LB = np.clip(L - VF / w, 0.0, L)
+        sp = np.maximum(s - LB, 0.0)
+        x = np.where(
+            s <= LB,
+            s + HF * s / EA,
+            LB + HF / w * np.arcsinh(w * sp / HF) + HF * s / EA,
+        )
+        z = np.where(
+            s <= LB,
+            0.0,
+            HF / w * (np.sqrt(1 + (w * sp / HF) ** 2) - 1.0)
+            + w * sp**2 / (2 * EA),
+        )
+    pts = np.zeros((n, 3))
+    pts[:, 0] = anchor[0] + u[0] * x
+    pts[:, 1] = anchor[1] + u[1] * x
+    pts[:, 2] = anchor[2] + z
+    return pts
+
+
+# ------------------------------------------------------------------- figures
+
+def plot_model(model, ax=None, color="k", nodes=False, station_plot=None):
+    """3-D wireframe of platform + tower members and mooring lines
+    (reference raft/raft_model.py:792-823)."""
+    plt = _require_mpl()
+    from mpl_toolkits.mplot3d.art3d import Line3DCollection
+
+    if ax is None:
+        fig = plt.figure(figsize=(8, 8))
+        ax = fig.add_subplot(projection="3d")
+    else:
+        fig = ax.get_figure()
+
+    segs = []
+    for mem in model.members:
+        segs.extend(member_wireframe(mem))
+    ax.add_collection3d(
+        Line3DCollection(segs, colors=color, linewidths=0.5, alpha=0.8)
+    )
+    if nodes:
+        r = model.nodes.r
+        ax.scatter(r[:, 0], r[:, 1], r[:, 2], s=4, c="r")
+
+    # mooring lines at the unloaded mean position
+    import jax.numpy as jnp
+
+    from raft_tpu.mooring import line_forces
+
+    arr = model._moor_arrays
+    r6 = getattr(model, "Xi0_unloaded", np.zeros(6))
+    _, HF, VF = line_forces(jnp.asarray(r6, jnp.float64), *arr)
+    ms = model.ms
+    for i in range(ms.n_lines):
+        fair = np.asarray(ms.rFair[i]) + np.asarray(r6[:3])
+        pts = line_profile(
+            ms.anchors[i], fair, float(HF[i]), float(VF[i]),
+            float(ms.L[i]), float(ms.EA[i]), float(ms.w[i]),
+        )
+        ax.plot(pts[:, 0], pts[:, 1], pts[:, 2], color="b", lw=1.0)
+
+    # free surface
+    lim = max(float(np.abs(ms.anchors[:, :2]).max()), 20.0)
+    xs = np.linspace(-lim, lim, 2)
+    X, Y = np.meshgrid(xs, xs)
+    ax.plot_surface(X, Y, 0 * X, alpha=0.1, color="c")
+
+    ax.set_xlabel("x (m)")
+    ax.set_ylabel("y (m)")
+    ax.set_zlabel("z (m)")
+    zmin = float(ms.anchors[:, 2].min())
+    ax.set_zlim(min(zmin, -1.0), max(float(model.hHub) + 10.0, 10.0))
+    return fig, ax
+
+
+_PSD_CHANNELS = [
+    ("wave_PSD", "wave elevation (m²/(rad/s))"),
+    ("surge_PSD", "surge (m²/(rad/s))"),
+    ("heave_PSD", "heave (m²/(rad/s))"),
+    ("pitch_PSD", "pitch (deg²/(rad/s))"),
+    ("AxRNA_PSD", "nacelle accel. ((m/s²)²/(rad/s))"),
+    ("Mbase_PSD", "tower base moment ((Nm)²/(rad/s))"),
+]
+
+
+def plot_responses(model, channels=None):
+    """Response power-spectral-density subplot grid, one line per case
+    (reference raft/raft_model.py:730-765)."""
+    plt = _require_mpl()
+    metrics = model.results.get("case_metrics")
+    if metrics is None:
+        raise RuntimeError("run analyze_cases() before plot_responses()")
+    channels = channels or _PSD_CHANNELS
+    freqs = model.w / (2 * np.pi)
+
+    fig, axes = plt.subplots(
+        len(channels), 1, sharex=True, figsize=(8, 2.2 * len(channels))
+    )
+    axes = np.atleast_1d(axes)
+    ncase = metrics[channels[0][0]].shape[0]
+    for ax, (key, label) in zip(axes, channels):
+        for i in range(ncase):
+            ax.plot(freqs, metrics[key][i], label=f"case {i+1}")
+        ax.set_ylabel(label, fontsize=8)
+        ax.grid(alpha=0.3)
+    axes[0].legend(fontsize=8)
+    axes[-1].set_xlabel("frequency (Hz)")
+    fig.tight_layout()
+    return fig, axes

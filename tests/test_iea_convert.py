@@ -1,0 +1,124 @@
+"""IEA ontology turbine converter tests (raft_tpu/io/iea.py; reference
+raft/helpers.py:518-663) against a small synthetic windIO description."""
+
+import numpy as np
+import pytest
+import yaml
+
+from raft_tpu.io.iea import convert_iea_turbine
+
+
+def _synthetic_windio():
+    lin = {"grid": [0.0, 1.0], "values": [0.0, 100.0]}
+    return {
+        "name": "demo-turbine",
+        "assembly": {
+            "number_of_blades": 3,
+            "rotor_diameter": 208.0,
+            "hub_height": 0.0,
+        },
+        "components": {
+            "hub": {"diameter": 8.0, "cone_angle": np.deg2rad(4.0)},
+            "nacelle": {
+                "drivetrain": {
+                    "uptilt": np.deg2rad(6.0),
+                    "overhang": 11.0,
+                    "distance_tt_hub": 4.0,
+                }
+            },
+            "tower": {
+                "outer_shape_bem": {
+                    "reference_axis": {"z": {"values": [10.0, 140.0]}}
+                }
+            },
+            "blade": {
+                "outer_shape_bem": {
+                    "reference_axis": {
+                        "x": {"grid": [0.0, 1.0], "values": [0.0, -4.0]},
+                        "y": {"grid": [0.0, 1.0], "values": [0.0, 0.0]},
+                        "z": lin,
+                    },
+                    "chord": {"grid": [0.0, 0.5, 1.0],
+                              "values": [5.0, 6.0, 1.0]},
+                    "twist": {"grid": [0.0, 1.0],
+                              "values": [np.deg2rad(15.0), 0.0]},
+                    "airfoil_position": {
+                        "grid": [0.0, 1.0],
+                        "labels": ["thick", "thin"],
+                    },
+                }
+            },
+        },
+        "environment": {
+            "air_density": 1.2, "air_dyn_viscosity": 1.8e-5,
+            "shear_exp": 0.14,
+        },
+        "airfoils": [
+            {
+                "name": n,
+                "relative_thickness": rt,
+                "polars": [{
+                    "c_l": {"grid": [-np.pi, 0.0, np.pi],
+                            "values": [0.0, 0.8, 0.0]},
+                    "c_d": {"grid": [-np.pi, 0.0, np.pi],
+                            "values": [0.02, 0.01, 0.02]},
+                    "c_m": {"grid": [-np.pi, 0.0, np.pi],
+                            "values": [0.0, -0.1, 0.0]},
+                }],
+            }
+            for n, rt in [("thick", 0.4), ("thin", 0.18)]
+        ],
+    }
+
+
+def test_convert_basic_fields():
+    t = convert_iea_turbine(_synthetic_windio(), n_span=10)
+    assert t["nBlades"] == 3
+    np.testing.assert_allclose(t["precone"], 4.0)
+    np.testing.assert_allclose(t["shaft_tilt"], 6.0)
+    assert t["Rhub"] == 4.0
+    # hub_height == 0 -> tower top + distance_tt_hub
+    np.testing.assert_allclose(t["Zhub"], 144.0)
+    assert t["env"]["rho"] == 1.2 and t["env"]["shearExp"] == 0.14
+
+
+def test_convert_blade_geometry_scaled_to_diameter():
+    t = convert_iea_turbine(_synthetic_windio(), n_span=10)
+    # Rtip must equal the stated rotor radius after arc-length rescaling
+    # (curved blade: straight span shrinks slightly below arc length)
+    assert t["blade"]["Rtip"] <= 104.0 + 1e-9
+    assert t["blade"]["Rtip"] > 100.0
+    g = np.asarray(t["blade"]["geometry"])
+    assert g.shape == (8, 5)                      # interior stations only
+    assert (np.diff(g[:, 0]) > 0).all()           # r ascending
+    np.testing.assert_allclose(g[0, 2], 15.0, atol=2.0)  # root twist in deg
+    assert t["blade"]["precurveTip"] == pytest.approx(-4.0)
+    assert [n for _, n in t["blade"]["airfoils"]] == ["thick", "thin"]
+
+
+def test_convert_airfoil_polars_in_degrees():
+    t = convert_iea_turbine(_synthetic_windio())
+    af = t["airfoils"][0]
+    data = np.asarray(af["data"])
+    np.testing.assert_allclose(data[0, 0], -180.0)
+    np.testing.assert_allclose(data[-1, 0], 180.0)
+    np.testing.assert_allclose(data[1, 1], 0.8)   # c_l at alpha=0
+
+
+def test_convert_rejects_mismatched_aoa_grids():
+    wt = _synthetic_windio()
+    wt["airfoils"][0]["polars"][0]["c_d"]["grid"] = [-3.0, 0.0, 3.0]
+    with pytest.raises(ValueError, match="not consistent"):
+        convert_iea_turbine(wt)
+
+
+def test_write_yaml_roundtrip(tmp_path):
+    p = str(tmp_path / "turbine.yaml")
+    t = convert_iea_turbine(_synthetic_windio(), out_path=p)
+    loaded = yaml.safe_load(open(p))["turbine"]
+    assert loaded["nBlades"] == 3
+    g = np.asarray(loaded["blade"]["geometry"])
+    np.testing.assert_allclose(
+        g, np.asarray(t["blade"]["geometry"]), atol=1e-4
+    )
+    assert loaded["airfoils"][0]["key"] == ["alpha", "c_l", "c_d", "c_m"]

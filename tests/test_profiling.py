@@ -1,0 +1,68 @@
+"""Observability-layer tests (raft_tpu/utils/profiling.py): timers
+accumulate inside an active context, stay no-op outside one, and the Model
+hot path reports its stage counters (SURVEY.md §5)."""
+
+import logging
+
+import numpy as np
+
+from raft_tpu.designs import deep_spar
+from raft_tpu.model import Model
+from raft_tpu.utils.profiling import Timers, configure_logging, timer
+
+
+def test_timer_noop_without_context():
+    with timer("orphan"):
+        pass  # must not raise or record anywhere
+
+
+def test_timers_accumulate():
+    tm = Timers()
+    with tm:
+        for _ in range(3):
+            with timer("stage"):
+                pass
+        with timer("other"):
+            pass
+    rep = tm.report()
+    assert rep["stage"]["calls"] == 3
+    assert rep["other"]["calls"] == 1
+    assert rep["stage"]["total_s"] >= 0.0
+    assert "mean_s" in rep["stage"]
+    # context popped: timing outside records nothing new
+    with timer("stage"):
+        pass
+    assert tm.counters["stage"]["calls"] == 3
+
+
+def test_nested_timers_inner_wins():
+    outer, inner = Timers(), Timers()
+    with outer:
+        with inner:
+            with timer("x"):
+                pass
+        with timer("y"):
+            pass
+    assert "x" in inner.counters and "x" not in outer.counters
+    assert "y" in outer.counters
+
+
+def test_model_hot_path_instrumented():
+    tm = Timers()
+    with tm:
+        m = Model(deep_spar(n_cases=1))
+        m.analyze_unloaded()
+        m.analyze_cases()
+    rep = tm.report(log=True)
+    for stage in ["statics", "mooring_offsets", "pipeline_compile",
+                  "rao_solve"]:
+        assert rep[stage]["calls"] >= 1, stage
+    assert np.isfinite(rep["rao_solve"]["total_s"])
+
+
+def test_configure_logging_structured(capsys):
+    logger = configure_logging(level=logging.INFO, structured=True)
+    logger.info("hello")
+    err = capsys.readouterr().err
+    assert "msg=hello" in err and "level=INFO" in err
+    logger.handlers = []

@@ -1,0 +1,117 @@
+"""Sharded design-sweep driver tests (raft_tpu/sweep.py), run on the
+8-virtual-device CPU mesh from conftest.py.
+
+Parity model: each sweep design solved through the sharded batch pipeline
+must match the same design solved alone through Model.analyze_cases
+(the reference sweep runs one full serial model per point,
+reference raft/parametersweep.py:56-100)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from raft_tpu.designs import demo_semi
+from raft_tpu.model import Model
+from raft_tpu.sweep import (
+    grid_points,
+    make_sweep_mesh,
+    pad_and_stack_nodes,
+    results_to_grid,
+    run_sweep,
+)
+
+
+AXES = {"d_col": [9.0, 10.0, 11.0], "draft_scale": [1.0, 1.1]}
+
+
+def _apply_point(design, point):
+    """Scale the outer-column diameter and draft of the demo semi."""
+    for mem in design["platform"]["members"]:
+        if mem["name"] == "outer":
+            mem["d"] = [point["d_col"]] * len(np.atleast_1d(mem["d"]))
+        mem["rA"][2] *= point["draft_scale"]
+        if mem["rB"][2] < 0:
+            mem["rB"][2] *= point["draft_scale"]
+    return design
+
+
+@pytest.fixture(scope="module")
+def sweep_results(tmp_path_factory):
+    base = demo_semi(n_cases=2)
+    points = grid_points(AXES)
+    out_dir = str(tmp_path_factory.mktemp("sweep_ckpt"))
+    res = run_sweep(base, points, _apply_point, out_dir=out_dir, verbose=False)
+    return base, points, out_dir, res
+
+
+def test_grid_points():
+    pts = grid_points(AXES)
+    assert len(pts) == 6
+    assert pts[0] == {"d_col": 9.0, "draft_scale": 1.0}
+    assert pts[-1] == {"d_col": 11.0, "draft_scale": 1.1}
+
+
+def test_sweep_matches_serial_model(sweep_results):
+    base, points, _, res = sweep_results
+    assert res["Xi"].shape[0] == len(points)
+    assert res["converged"].all()
+    # check the first and last design against standalone serial runs
+    for idx in (0, len(points) - 1):
+        import copy
+
+        design = _apply_point(copy.deepcopy(base), points[idx])
+        m = Model(design)
+        m.analyze_unloaded()
+        m.analyze_cases()
+        np.testing.assert_allclose(
+            res["Xi"][idx], m.Xi, rtol=1e-6, atol=1e-12,
+            err_msg=f"design {idx} mismatch vs serial Model",
+        )
+        st = m.statics
+        np.testing.assert_allclose(res["mass"][idx], st.mass, rtol=1e-12)
+        np.testing.assert_allclose(res["displacement"][idx], st.V, rtol=1e-12)
+
+
+def test_sweep_monotone_metric(sweep_results):
+    _, _, _, res = sweep_results
+    grid_mass = results_to_grid(res, AXES, "mass")
+    assert grid_mass.shape == (3, 2)
+    # larger outer columns -> heavier platform (shell mass grows with d)
+    assert (np.diff(grid_mass[:, 0]) > 0).all()
+
+
+def test_sweep_checkpoint_restart(sweep_results, monkeypatch):
+    base, points, out_dir, res = sweep_results
+    # all chunks checkpointed; a restart must not re-run any design solve
+    import raft_tpu.sweep as sweep_mod
+
+    def boom(*a, **k):
+        raise AssertionError("solver ran despite complete checkpoints")
+
+    monkeypatch.setattr(sweep_mod, "_prepare_design", boom)
+    res2 = run_sweep(base, points, _apply_point, out_dir=out_dir, verbose=False)
+    np.testing.assert_array_equal(res2["Xi"], res["Xi"])
+    np.testing.assert_array_equal(res2["mass"], res["mass"])
+
+
+def test_pad_and_stack_nodes_inert_padding():
+    base = demo_semi(n_cases=1)
+    m1 = Model(base)
+    import copy
+
+    small = copy.deepcopy(base)
+    small["platform"]["members"] = small["platform"]["members"][:1]
+    m2 = Model(small)
+    bundle = pad_and_stack_nodes([m1.nodes, m2.nodes])
+    n1, n2 = m1.nodes.r.shape[0], m2.nodes.r.shape[0]
+    assert bundle.r.shape == (2, max(n1, n2), 3)
+    pad = bundle.v_side[1, n2:]
+    assert (pad == 0).all()
+    assert not bundle.strip_mask[1, n2:].any()
+
+
+def test_sweep_mesh_spans_devices():
+    mesh = make_sweep_mesh()
+    assert mesh.axis_names == ("design",)
+    assert mesh.shape["design"] == len(jax.devices())

@@ -1,0 +1,71 @@
+"""Smoke + geometry tests for the plotting module (raft_tpu/viz.py), using
+the Agg backend (no display)."""
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import numpy as np
+import pytest
+
+from raft_tpu.designs import demo_semi
+from raft_tpu.model import Model
+from raft_tpu.viz import line_profile, member_wireframe
+
+
+@pytest.fixture(scope="module")
+def analyzed_model():
+    m = Model(demo_semi(n_cases=2))
+    m.analyze_unloaded()
+    m.analyze_cases()
+    return m
+
+
+def test_member_wireframe_shapes(analyzed_model):
+    for mem in analyzed_model.members:
+        segs = member_wireframe(mem)
+        assert len(segs) > 0
+        arr = np.stack(segs)
+        assert arr.shape[1:] == (2, 3)
+        assert np.isfinite(arr).all()
+
+
+def test_line_profile_endpoints_span():
+    # taut-ish suspended line: profile must start at the anchor and end
+    # near the fairlead's horizontal/vertical span
+    anchor = np.array([100.0, 0.0, -200.0])
+    fair = np.array([20.0, 0.0, -10.0])
+    L, EA, w = 230.0, 3.84e8, 700.0
+    from raft_tpu.mooring import catenary_solve
+
+    XF = np.hypot(*(fair[:2] - anchor[:2]))
+    ZF = fair[2] - anchor[2]
+    HF, VF = catenary_solve(XF, ZF, L, EA, w)
+    pts = line_profile(anchor, fair, float(HF), float(VF), L, EA, w)
+    np.testing.assert_allclose(pts[0], anchor, atol=1e-9)
+    np.testing.assert_allclose(
+        np.hypot(*(pts[-1, :2] - anchor[:2])), XF, rtol=1e-6
+    )
+    np.testing.assert_allclose(pts[-1, 2] - anchor[2], ZF, rtol=1e-6)
+    # monotone height increase toward the fairlead for a suspended line
+    assert (np.diff(pts[:, 2]) >= -1e-9).all()
+
+
+def test_plot_model_smoke(analyzed_model):
+    fig, ax = analyzed_model.plot(nodes=True)
+    assert len(ax.collections) > 0   # member wireframe + surface
+    assert len(ax.lines) == analyzed_model.ms.n_lines
+    import matplotlib.pyplot as plt
+
+    plt.close(fig)
+
+
+def test_plot_responses_smoke(analyzed_model):
+    fig, axes = analyzed_model.plot_responses()
+    assert len(axes) == 6
+    # every axis got one line per case
+    for ax in axes:
+        assert len(ax.lines) == 2
+    import matplotlib.pyplot as plt
+
+    plt.close(fig)

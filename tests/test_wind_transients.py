@@ -1,0 +1,97 @@
+"""IEC 61400-1 transient extreme-event tests (raft_tpu/wind.py
+IECTransients), asserting the standard's closed-form values
+(the reference implements the same formulas at raft/pyIECWind.py:79-356)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.wind import IECTransients, IECWind
+
+
+@pytest.fixture
+def gen():
+    return IECTransients(turbine_class="I", turbulence_class="B",
+                         z_hub=90.0, D=126.0)
+
+
+def test_eog_amplitude_and_shape(gen):
+    V_hub = 12.0
+    events, sigma_1 = gen.EOG(V_hub)
+    assert len(events) == 1
+    label, table = events[0]
+    assert label == "EOG"
+    t, gust = table[:, 0], table[:, 7]
+    # amplitude: min(1.35(V_e1 - V), 3.3 sigma1/(1+0.1 D/Sigma1))
+    iec = IECWind("I", "B", z_hub=90.0)
+    expect = min(1.35 * (0.8 * 1.4 * 50.0 - V_hub),
+                 3.3 * iec.NTM(V_hub) / (1 + 0.1 * 126.0 / 42.0))
+    # peak of 0.37*Vg*sin(3 pi t/T)(1-cos(2 pi t/T)) is ~1.215 Vg at t~T/4ish
+    assert np.isclose(sigma_1, iec.NTM(V_hub))
+    assert np.isclose(-gust.min(), 0.37 * expect * np.nanmax(
+        np.sin(3 * np.pi * t / 10.5) * (1 - np.cos(2 * np.pi * t / 10.5))
+    ), rtol=1e-6)
+    # gust starts and ends at zero; mean wind column is constant V_hub
+    assert gust[0] == 0.0 and abs(gust[-1]) < 1e-9
+    np.testing.assert_allclose(table[:, 1], V_hub)
+
+
+def test_edc_direction_ramp(gen):
+    V_hub = 10.0
+    events, sigma_1 = gen.EDC(V_hub)
+    assert [lbl for lbl, _ in events] == ["EDC_P", "EDC_N"]
+    theta_e = np.rad2deg(
+        4 * np.arctan(sigma_1 / (V_hub * (1 + 0.01 * 126.0 / 42.0)))
+    )
+    for sign, (_, table) in zip([1, -1], events):
+        d = table[:, 2]
+        assert d[0] == 0.0
+        np.testing.assert_allclose(d[-1], sign * theta_e, rtol=1e-9)
+        # monotone half-cosine ramp
+        assert (np.sign(np.diff(d)) == sign)[1:-1].all()
+
+
+def test_edc_theta_clamped_at_180():
+    gen = IECTransients(z_hub=90.0, D=1e5)  # absurd D -> huge theta
+    gen.dir_change = "+"
+    events, _ = gen.EDC(0.5)
+    assert np.abs(events[0][1][:, 2]).max() <= 180.0
+
+
+def test_ecd_speed_rise_and_low_wind_theta(gen):
+    events, _ = gen.ECD(3.0)  # V_hub < 4 -> theta_cg = 180
+    _, table = events[0]
+    np.testing.assert_allclose(table[-1, 2], 180.0)
+    np.testing.assert_allclose(table[-1, 1], 3.0 + 15.0, rtol=1e-9)
+    events, _ = gen.ECD(12.0)
+    np.testing.assert_allclose(events[0][1][-1, 2], 720.0 / 12.0)
+
+
+def test_ews_variants_and_columns(gen):
+    events, sigma_1 = gen.EWS(11.0)
+    labels = [lbl for lbl, _ in events]
+    assert labels == ["EWS_V_P", "EWS_H_P", "EWS_V_N", "EWS_H_N"]
+    amp = (2.5 + 0.2 * 6.4 * sigma_1 * (126.0 / 42.0) ** 0.25) * 2 / 11.0
+    for lbl, table in events:
+        col = 6 if "_V_" in lbl else 4
+        other = 4 if "_V_" in lbl else 6
+        peak = table[:, col]
+        assert np.isclose(np.abs(peak).max(), amp, rtol=1e-9)
+        assert np.abs(table[:, other]).max() == 0.0
+        # pulse returns to zero at T=12 s
+        assert abs(peak[-1]) < 1e-9
+
+
+def test_write_wnd_padding_and_execute(gen, tmp_path):
+    paths = gen.execute(["EOG", "EDC"], 12.0, outdir=str(tmp_path),
+                        case_name="dlc")
+    assert len(paths) == 3  # EOG + EDC_P + EDC_N
+    for p in paths:
+        lines = open(p).read().splitlines()
+        data = np.array(
+            [[float(x) for x in ln.split()] for ln in lines
+             if not ln.startswith("!")]
+        )
+        assert data[0, 0] == gen.T0
+        assert data[-1, 0] == gen.TF
+        assert data[1, 0] == gen.T_start
+        assert data.shape[1] == 9
