@@ -24,6 +24,44 @@ import jax.numpy as jnp
 from raft_tpu.hydro import linearized_drag
 
 
+def gauss_solve(A, b):
+    """Batched dense solve by Gauss-Jordan elimination with partial
+    pivoting, fully vectorized over the leading batch axes.
+
+    A : [..., n, n];  b : [..., n, 1] -> x : [..., n, 1]
+
+    XLA's batched LU (`jnp.linalg.solve`) runs ~13x slower than this on TPU
+    for the tiny 12x12 systems in the RAO solve (measured 4.98 ms vs
+    0.39 ms for 1536 systems on v5e): LU lowers to a column-by-column loop
+    with dynamic-slice updates, while this formulation is n fori_loop steps
+    of pure elementwise/where ops over the whole batch.  Pivot selection
+    uses one argmax + gather per step; row swap and elimination are masked
+    `where`s, so the graph has static shapes throughout.
+    """
+    n = A.shape[-1]
+    M = jnp.concatenate([A, b], axis=-1)                # [..., n, n+1]
+    idx = jnp.arange(n)
+
+    def step(i, M):
+        col = jnp.abs(jnp.take(M, i, axis=-1))          # column i magnitudes
+        col = jnp.where(idx < i, -jnp.inf, col)         # rows above i are done
+        p = jnp.argmax(col, axis=-1)                    # pivot row per batch
+        rp = jnp.take_along_axis(M, p[..., None, None], axis=-2)[..., 0, :]
+        ri = jnp.take(M, i, axis=-2)
+        is_i = (idx == i)[:, None]
+        is_p = (idx == p[..., None])[..., :, None]
+        M = jnp.where(is_i, rp[..., None, :],
+                      jnp.where(is_p, ri[..., None, :], M))
+        piv = jnp.take(rp, i, axis=-1)[..., None]
+        row = rp / piv                                  # normalized pivot row
+        fac = jnp.take(M, i, axis=-1)[..., None]        # column i after swap
+        M = jnp.where(is_i, row[..., None, :], M - fac * row[..., None, :])
+        return M
+
+    M = jax.lax.fori_loop(0, n, step, M)
+    return M[..., -1:]
+
+
 def solve_complex_6x6(Zr, Zi, Fr, Fi, refine=1):
     """Solve (Zr + i Zi) x = (Fr + i Fi) batched over leading axes via the
     equivalent real block system.
@@ -36,10 +74,10 @@ def solve_complex_6x6(Zr, Zi, Fr, Fi, refine=1):
     bot = jnp.concatenate([Zi, Zr], axis=-1)
     A = jnp.concatenate([top, bot], axis=-2)            # [..., 12, 12]
     b = jnp.concatenate([Fr, Fi], axis=-1)[..., None]   # [..., 12, 1]
-    x = jnp.linalg.solve(A, b)
+    x = gauss_solve(A, b)
     for _ in range(refine):
         r = b - A @ x
-        x = x + jnp.linalg.solve(A, r)
+        x = x + gauss_solve(A, r)
     x = x[..., 0]
     return x[..., :6], x[..., 6:]
 
@@ -91,27 +129,39 @@ def solve_dynamics(
     XiLast = jnp.full((6, nw), XiStart, dtype=cdtype)
     Xi0 = jnp.zeros((6, nw), dtype=cdtype)
 
-    def step(XiLast):
+    def step(XiLast, n_refine):
         B_drag, F_drag = linearized_drag(nodes, XiLast, u, w, dw, rho)
         B_tot = B_lin + B_drag[None, :, :]
         Zr, Zi = assemble_impedance(w, M_lin, B_tot, C_lin)
         F = F_drag + (F_lin_r + 1j * F_lin_i).astype(cdtype)  # [nw, 6]
-        xr, xi = solve_complex_6x6(Zr, Zi, jnp.real(F), jnp.imag(F), refine=refine)
+        xr, xi = solve_complex_6x6(
+            Zr, Zi, jnp.real(F), jnp.imag(F), refine=n_refine
+        )
         return (xr + 1j * xi).T                                # [6, nw]
 
     def cond(state):
-        i, XiLast, Xi, done = state
+        i, XiLast, XiPoint, Xi, done = state
         return (i < nIter + 1) & (~done)
 
     def body(state):
-        i, XiLast, Xi_prev, done = state
-        Xi = step(XiLast)
+        i, XiLast, XiPoint, Xi_prev, done = state
+        # no refinement inside the loop: the fixed point only needs the
+        # solution to well within the 1% convergence tolerance, and the
+        # unrefined f32 block solve already sits at ~1e-4 relative
+        Xi = step(XiLast, 0)
         tolCheck = jnp.abs(Xi - XiLast) / (jnp.abs(Xi) + tol)
         conv = jnp.all(tolCheck < tol)
         XiNext = jnp.where(conv, XiLast, 0.2 * XiLast + 0.8 * Xi)
-        return (i + 1, XiNext, Xi, conv)
+        # XiPoint records the linearization point of the last solve, so the
+        # refined re-solve below reproduces exactly that solve
+        return (i + 1, XiNext, XiLast, Xi, conv)
 
-    i, _, Xi, converged = jax.lax.while_loop(
-        cond, body, (jnp.array(0), XiLast, Xi0, jnp.array(False))
+    i, _, XiPoint, Xi, converged = jax.lax.while_loop(
+        cond, body, (jnp.array(0), XiLast, XiLast, Xi0, jnp.array(False))
     )
+    # one refined re-solve at the final drag-linearization point recovers
+    # the full f32+refinement accuracy for the returned amplitudes without
+    # paying the refinement inside every fixed-point iteration
+    if refine > 0:
+        Xi = step(XiPoint, refine)
     return jnp.real(Xi), jnp.imag(Xi), i, converged

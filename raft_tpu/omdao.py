@@ -575,19 +575,30 @@ class RAFT_OMDAO(_ComponentBase):
                 s_ring = (np.arange(1, n_stiff + 0.1) - 0.5) * (
                     ring_spacing / height
                 )
-                d_ring = np.interp(s_ring, s_grid, np.asarray(mem["d"], float))
-                s_cap_0 = np.asarray(inputs[p + "cap_stations"], float)
-                keep_cap = (s_cap_0 >= sA) & (s_cap_0 <= sB)
+                # rect members carry two side lengths per station; rings use
+                # the first side as the effective diameter
+                d_profile = np.asarray(mem["d"], float)
+                if d_profile.ndim > 1:
+                    d_profile = d_profile[:, 0]
+                d_ring = np.interp(s_ring, s_grid, d_profile)
                 t_in = np.asarray(inputs[p + "cap_t"], float)
-                s_cap, isort = np.unique(
-                    np.r_[sA, s_cap_0[keep_cap], sB], return_index=True
-                )
-                t_cap = np.r_[t_in[0], t_in[keep_cap], t_in[-1]][isort]
-                di_cap = np.zeros(s_cap.shape)
-                if sA > 0.0:  # no end caps at member joints
-                    s_cap, t_cap, di_cap = s_cap[1:], t_cap[1:], di_cap[1:]
-                if sB < 1.0:
-                    s_cap, t_cap, di_cap = s_cap[:-1], t_cap[:-1], di_cap[:-1]
+                if ncaps > 0 and t_in.size > 0:
+                    s_cap_0 = np.asarray(inputs[p + "cap_stations"], float)
+                    keep_cap = (s_cap_0 >= sA) & (s_cap_0 <= sB)
+                    s_cap, isort = np.unique(
+                        np.r_[sA, s_cap_0[keep_cap], sB], return_index=True
+                    )
+                    t_cap = np.r_[t_in[0], t_in[keep_cap], t_in[-1]][isort]
+                    di_cap = np.zeros(s_cap.shape)
+                    if sA > 0.0:  # no end caps at member joints
+                        s_cap, t_cap, di_cap = s_cap[1:], t_cap[1:], di_cap[1:]
+                    if sB < 1.0:
+                        s_cap, t_cap, di_cap = (s_cap[:-1], t_cap[:-1],
+                                                di_cap[:-1])
+                else:  # ring stiffeners only, no discrete caps declared
+                    s_cap = np.zeros(0)
+                    t_cap = np.zeros(0)
+                    di_cap = np.zeros(0)
                 s_cap = np.r_[s_ring, s_cap]
                 t_cap = np.r_[float(inputs[p + "ring_t"]) * np.ones(n_stiff),
                               t_cap]
@@ -651,6 +662,12 @@ class RAFT_OMDAO(_ComponentBase):
             "data": [row for row, ok in
                      zip(discrete_inputs["raft_dlcs"], case_mask) if ok],
         }
+        if not design["cases"]["data"]:
+            raise ValueError(
+                "RAFT_OMDAO: no spectral-wind (NTM/ETM/EWM) cases in "
+                "raft_dlcs — the frequency-domain solve needs at least one; "
+                "transient-only DLC sets belong to the time-domain tools."
+            )
         return design, np.array(case_mask)
 
     # ----------------------------------------------------------- compute

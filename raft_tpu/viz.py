@@ -11,9 +11,14 @@ import numpy as np
 
 
 def _require_mpl():
+    import os
+
     import matplotlib
 
-    matplotlib.use("Agg", force=False)
+    # only force the headless backend when there is no display to attach to
+    # (leave interactive sessions on whatever backend the user has)
+    if not os.environ.get("DISPLAY") and not os.environ.get("MPLBACKEND"):
+        matplotlib.use("Agg", force=False)
     import matplotlib.pyplot as plt  # noqa: F401
 
     return plt

@@ -215,6 +215,38 @@ def test_stats_and_aggregates_match(both_paths):
     )
 
 
+def test_ring_stiffeners_without_caps_rebuild():
+    # ring_spacing > 0 with ncaps == 0 must produce ring-only internal
+    # structures, for circular and rectangular members alike
+    design = _design()
+    comp = _build_component(design)
+    _set_inputs(comp, design)
+    comp.set_val("platform_member2_ring_spacing", 0.25)
+    comp.set_val("platform_member2_ring_t", 0.03)
+    comp.set_val("platform_member2_ring_h", 0.5)
+    comp.set_val("platform_member3_ring_spacing", 0.5)  # rect member
+    comp.set_val("platform_member3_ring_t", 0.02)
+    comp.set_val("platform_member3_ring_h", 0.4)
+    rebuilt, _ = comp._rebuild_design(comp._inputs, comp._discrete_inputs)
+    m2 = rebuilt["platform"]["members"][1]
+    assert len(m2["cap_stations"]) == 4          # floor(1/0.25) rings
+    np.testing.assert_allclose(m2["cap_t"], 0.03)
+    np.testing.assert_allclose(m2["cap_d_in"], 12.5 - 2 * 0.5)
+    m3 = rebuilt["platform"]["members"][2]
+    assert len(m3["cap_stations"]) == 2
+    np.testing.assert_allclose(m3["cap_d_in"], 12.4 - 2 * 0.4)
+
+
+def test_all_steady_dlcs_raise_clear_error():
+    design = _design()
+    for row in design["cases"]["data"]:
+        row[2] = "steady"
+    comp = _build_component(design)
+    _set_inputs(comp, design)
+    with pytest.raises(ValueError, match="no spectral-wind"):
+        comp._rebuild_design(comp._inputs, comp._discrete_inputs)
+
+
 def test_dlc_filter_drops_steady_cases():
     design = _design()
     design["cases"]["data"].append(
