@@ -10,9 +10,9 @@ member strip nodes, load cases, sweep designs) are Python ``for`` loops
 case-dynamics pipeline is a single jitted XLA graph: strip-theory integrals
 are einsums over a padded node axis, the drag-linearization fixed point is a
 ``lax.while_loop`` with per-case convergence freezing, and the per-frequency
-6x6 complex solves are one batched ``jnp.linalg.solve`` over
-``[case, freq, 6, 6]``.  Design sweeps shard over devices with
-``jax.sharding``/``shard_map``.
+6x6 complex solves run as batched 12x12 real block systems solved by a
+vectorized Gauss-Jordan over ``[case, freq]`` (raft_tpu/dynamics.py).
+Design sweeps shard over devices with ``jax.sharding``/``shard_map``.
 
 Unlike the reference, the external native solvers (MoorPy quasi-static
 mooring, CCBlade Fortran BEM aero, HAMS Fortran potential flow) are

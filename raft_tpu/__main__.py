@@ -1,0 +1,35 @@
+"""Command-line entry point: ``python -m raft_tpu design.yaml [options]``
+(the reference's ``python raft_model.py`` __main__ path,
+reference raft/raft_model.py:1140-1147, as a proper CLI)."""
+
+import argparse
+import sys
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="raft_tpu",
+        description="Frequency-domain FOWT analysis (TPU-native RAFT)",
+    )
+    p.add_argument("design", help="design YAML/pickle path")
+    p.add_argument("--plot", action="store_true",
+                   help="save geometry + response-PSD figures")
+    p.add_argument("--ballast", type=int, default=0, choices=[0, 1, 2],
+                   help="ballast trim mode (1=fill levels, 2=densities)")
+    p.add_argument("--precision", choices=["float32", "float64"],
+                   default=None, help="device working precision")
+    p.add_argument("--bem", action="store_true",
+                   help="run the native BEM solver on potMod members")
+    args = p.parse_args(argv)
+
+    from raft_tpu.model import run_raft
+
+    run_raft(
+        args.design, plot=int(args.plot), ballast=args.ballast,
+        precision=args.precision, run_native_bem=args.bem,
+    )
+
+
+if __name__ == "__main__":
+    main()
+    sys.exit(0)

@@ -217,10 +217,19 @@ def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81):
         X = 1j * omega * rho * (phiT * jnp.asarray(pa.area, f)[None]) @ vmj.T
         return A, B, X
 
+    # the dense complex LU has no TPU lowering (and the Green-function
+    # tables want f64 headroom), so the whole solve is pinned to the CPU
+    # backend: committed CPU inputs make jit compile and run there even
+    # when the default backend is a TPU
+    cpu = jax.devices("cpu")[0]
+    Rh, zz, ex, ey, S0j, K0j = jax.device_put(
+        (Rh, zz, ex, ey, S0j, K0j), cpu
+    )
     fn = jax.jit(one_omega)
     A_all, B_all, X_all = [], [], []
     for om in np.asarray(omegas, float):
-        A, B, X = fn(jnp.asarray(om, f), Rh, zz, ex, ey, S0j, K0j)
+        A, B, X = fn(jax.device_put(jnp.asarray(om, f), cpu),
+                     Rh, zz, ex, ey, S0j, K0j)
         A_all.append(np.asarray(A))
         B_all.append(np.asarray(B))
         X_all.append(np.asarray(X))

@@ -108,6 +108,7 @@ def solve_dynamics(
     nIter=15,
     tol=0.01,
     refine=1,
+    checkable=False,
 ):
     """Fixed-point dynamics solve for one case (vmap over cases in the Model).
 
@@ -156,9 +157,19 @@ def solve_dynamics(
         # refined re-solve below reproduces exactly that solve
         return (i + 1, XiNext, XiLast, Xi, conv)
 
-    i, _, XiPoint, Xi, converged = jax.lax.while_loop(
-        cond, body, (jnp.array(0), XiLast, XiLast, Xi0, jnp.array(False))
-    )
+    init = (jnp.array(0), XiLast, XiLast, Xi0, jnp.array(False))
+    if checkable:
+        # scan-based fixed-trip-count variant with the same freeze
+        # semantics: jax.experimental.checkify supports scan but not this
+        # while_loop, so the NaN-checking debug pipeline
+        # (raft_tpu.validate.checked_pipeline) requests this path
+        def scan_body(state, _):
+            state = jax.lax.cond(cond(state), body, lambda s: s, state)
+            return state, None
+        state, _ = jax.lax.scan(scan_body, init, None, length=nIter + 1)
+        i, _, XiPoint, Xi, converged = state
+    else:
+        i, _, XiPoint, Xi, converged = jax.lax.while_loop(cond, body, init)
     # one refined re-solve at the final drag-linearization point recovers
     # the full f32+refinement accuracy for the returned amplitudes without
     # paying the refinement inside every fixed-point iteration

@@ -54,7 +54,8 @@ _RAD2DEG = 57.29577951308232
 _SPECTRUM_CODES = {"still": 0, "none": 0, "unit": 1, "JONSWAP": 2}
 
 
-def make_case_dynamics(w, k, depth, rho, g, XiStart, nIter, dtype, cdtype):
+def make_case_dynamics(w, k, depth, rho, g, XiStart, nIter, dtype, cdtype,
+                       checkable=False):
     """Build the single-case device function
     ``fn(nodes, zeta[nw], beta, C_lin[6,6], M_lin[nw,6,6], B_lin[nw,6,6],
     F_add_r[nw,6], F_add_i[nw,6]) -> (Xi_r[6,nw], Xi_i[6,nw], iters, conv)``.
@@ -89,7 +90,7 @@ def make_case_dynamics(w, k, depth, rho, g, XiStart, nIter, dtype, cdtype):
             Fi = jnp.imag(F_iner) + F_add_i
             xr, xi, iters, conv = solve_dynamics(
                 nodes, u, w, dw, rho, M_lin, B_lin, C_lin, Fr, Fi,
-                XiStart, nIter=nIter,
+                XiStart, nIter=nIter, checkable=checkable,
             )
         return xr, xi, iters, conv
 
@@ -988,7 +989,7 @@ class Model:
     adjustBallastDensity = adjust_ballast_density
 
 
-def run_raft(input_file, plot=0, ballast=0, **kwargs):
+def run_raft(input_file, plot=0, ballast=0, run_native_bem=False, **kwargs):
     """Set up and run the full analysis from a YAML/pickle design
     (reference raft/raft_model.py:1092-1135)."""
     design = load_design(input_file)
@@ -996,10 +997,23 @@ def run_raft(input_file, plot=0, ballast=0, **kwargs):
     model = Model(design, **kwargs)
     print(" --- analyzing unloaded ---")
     model.analyze_unloaded(ballast=ballast)
+    if run_native_bem:
+        print(" --- running native BEM solver ---")
+        model.run_bem()
     print(" --- analyzing cases ---")
     model.analyze_cases()
     model.solve_eigen()
     model.calc_outputs()
+    if plot:
+        import matplotlib.pyplot as plt
+
+        fig, _ = model.plot()
+        fig.savefig("raft_tpu_geometry.png", dpi=120)
+        plt.close(fig)
+        fig, _ = model.plot_responses()
+        fig.savefig("raft_tpu_responses.png", dpi=120)
+        plt.close(fig)
+        print("saved raft_tpu_geometry.png, raft_tpu_responses.png")
     return model
 
 

@@ -1,0 +1,165 @@
+"""Input validation and device-side numeric checking.
+
+The reference's equivalents are scattered inline guards (SURVEY.md §5):
+NaN checks on BEM output (reference raft/raft_fowt.py:409-420), matrix
+diagonal viability (raft_model.py:419-426), station-count checks
+(raft_member.py:58-59), YAML shape validation in getFromDict
+(helpers.py:456-516).  Here they are one subsystem:
+
+ - ``validate_design(design)``: host-side structural validation of the
+   design dict, returning a list of problem strings (raise_on_error=True
+   turns them into one ValueError);
+ - ``checked_pipeline(model)``: the case pipeline wrapped in
+   ``jax.experimental.checkify`` float checks, so device-side NaN/Inf in
+   the solve surfaces as a Python error with a location instead of
+   silently propagating into the response statistics.
+"""
+
+import numpy as np
+
+
+def _numeric(problems, label, value, cast=float):
+    """Cast a design value, recording (instead of raising) on failure."""
+    try:
+        return cast(value)
+    except (TypeError, ValueError):
+        problems.append(f"{label}: not numeric: {value!r}")
+        return None
+
+
+def _check_member(mem, i, problems):
+    name = mem.get("name", f"member {i}")
+    try:
+        stations = np.atleast_1d(np.asarray(mem.get("stations", []), float))
+    except (TypeError, ValueError):
+        problems.append(f"{name}: stations are not numeric")
+        return
+    if stations.size < 2:
+        problems.append(f"{name}: needs >= 2 stations, got {stations.size}")
+        return
+    if not (np.diff(stations) >= 0).all():
+        problems.append(f"{name}: stations must be non-decreasing")
+    n = stations.size
+    shape = str(mem.get("shape", "circ"))
+    if shape.startswith("circ") and np.ndim(mem.get("d", 0.0)) == 1 \
+            and len(np.atleast_1d(mem["d"])) not in (1, n):
+        problems.append(
+            f"{name}: {len(np.atleast_1d(mem['d']))} diameters for "
+            f"{n} stations"
+        )
+    t = mem.get("t", None)
+    if t is not None and np.ndim(t) == 1 and len(t) not in (1, n):
+        problems.append(f"{name}: {len(t)} thicknesses for {n} stations")
+    for key in ("l_fill", "rho_fill"):
+        v = mem.get(key)
+        if v is not None and np.ndim(v) == 1 and len(v) not in (1, n - 1):
+            problems.append(
+                f"{name}: {key} has {len(v)} entries for {n - 1} sections"
+            )
+    caps = mem.get("cap_stations")
+    if caps is not None:
+        for key in ("cap_t", "cap_d_in"):
+            v = np.atleast_1d(mem.get(key, []))
+            if len(v) not in (1, len(np.atleast_1d(caps))):
+                problems.append(
+                    f"{name}: {key} length does not match cap_stations"
+                )
+
+
+def validate_design(design, raise_on_error=True):
+    """Structural validation of a design dict before Model construction."""
+    problems = []
+    for key in ("site", "turbine", "platform", "mooring"):
+        if key not in design or design[key] is None:
+            problems.append(f"missing top-level section '{key}'")
+    site = design.get("site") or {}
+    if "water_depth" not in site:
+        problems.append("site.water_depth is required")
+    else:
+        depth = _numeric(problems, "site.water_depth", site["water_depth"])
+        if depth is not None and depth <= 0:
+            problems.append("site.water_depth must be positive")
+
+    platform = design.get("platform") or {}
+    members = platform.get("members") or []
+    if not members:
+        problems.append("platform.members is empty")
+    for i, mem in enumerate(members):
+        _check_member(mem, i, problems)
+    turbine = design.get("turbine") or {}
+    if "tower" in turbine and turbine["tower"]:
+        _check_member(turbine["tower"], "tower", problems)
+
+    cases = design.get("cases")
+    if cases:
+        keys = cases.get("keys", [])
+        for j, row in enumerate(cases.get("data", [])):
+            if len(row) != len(keys):
+                problems.append(
+                    f"cases.data row {j} has {len(row)} entries for "
+                    f"{len(keys)} keys"
+                )
+            else:
+                from raft_tpu.model import _SPECTRUM_CODES
+
+                case = dict(zip(keys, row))
+                spec = str(case.get("wave_spectrum", "unit"))
+                if spec not in _SPECTRUM_CODES:
+                    problems.append(
+                        f"cases.data row {j}: unknown wave_spectrum '{spec}'"
+                    )
+                period = _numeric(
+                    problems, f"cases.data row {j} wave_period",
+                    case.get("wave_period", 1.0),
+                )
+                if period is not None and period <= 0:
+                    problems.append(
+                        f"cases.data row {j}: wave_period must be positive"
+                    )
+
+    mooring = design.get("mooring") or {}
+    point_names = {p.get("name") for p in mooring.get("points", [])}
+    for ln in mooring.get("lines", []):
+        for end in ("endA", "endB"):
+            if ln.get(end) not in point_names:
+                problems.append(
+                    f"mooring line {ln.get('name')}: {end} "
+                    f"'{ln.get(end)}' is not a defined point"
+                )
+
+    if problems and raise_on_error:
+        raise ValueError(
+            "design validation failed:\n  - " + "\n  - ".join(problems)
+        )
+    return problems
+
+
+def checked_pipeline(model):
+    """The model's case pipeline wrapped in checkify float checks: calling
+    the returned function raises on any device-side NaN/Inf with the
+    failing operation's location (the TPU-native version of the
+    reference's post-hoc NaN guards, raft/raft_fowt.py:409-420)."""
+    import jax
+    from jax.experimental import checkify
+
+    from raft_tpu.model import make_case_dynamics
+
+    # checkify cannot wrap a vmapped while_loop; wrap the single-case
+    # function and vmap the checked version instead (vmap-of-checkify)
+    one_case = make_case_dynamics(
+        model.w, model.k, model.depth, model.rho_water, model.g,
+        model.XiStart, model.nIter, model.dtype, model.cdtype,
+        checkable=True,
+    )
+    nodes = model.nodes.astype(model.dtype)
+    checked = checkify.checkify(
+        lambda *a: one_case(nodes, *a), errors=checkify.float_checks
+    )
+    jitted = jax.jit(jax.vmap(checked))
+
+    def run(*args):
+        err, out = jitted(*args)
+        checkify.check_error(err)
+        return out
+
+    return run

@@ -121,6 +121,41 @@ def line_profile(anchor, fairlead, HF, VF, L, EA, w, n=40):
     return pts
 
 
+# --------------------------------------------------------------------- rotor
+
+def rotor_wireframe(rotor, hub_pos, azimuth0=0.0):
+    """Blade outline segments for the rotor at ``hub_pos``
+    (the reference draws blade surfaces at raft_rotor.py:492-548; here each
+    blade is its pitch axis plus leading/trailing edge chord outline)."""
+    g = rotor.geom
+    r = np.asarray(g["r"], float)
+    chord = np.asarray(g["chord"], float)
+    precurve = np.asarray(g["precurve"], float)
+    presweep = np.asarray(g["presweep"], float)
+    cone, tilt = g["precone"], g["tilt"]
+    lines = []
+    for ib in range(g["B"]):
+        az = azimuth0 + 2 * np.pi * ib / g["B"]
+        # blade-frame coordinates: x downwind (precurve), z spanwise
+        xb = precurve * np.cos(cone) - r * np.sin(cone)
+        zb = r * np.cos(cone) + precurve * np.sin(cone)
+        yb = presweep
+        for off in (-0.25, 0.75):  # leading/trailing edge at quarter chord
+            ye = yb + off * chord
+            # rotate about the shaft (x) axis by azimuth, then tilt about y
+            Y = ye * np.cos(az) - zb * np.sin(az)
+            Z = ye * np.sin(az) + zb * np.cos(az)
+            X = xb * np.cos(tilt) + Z * np.sin(tilt)
+            Zt = -xb * np.sin(tilt) + Z * np.cos(tilt)
+            pts = np.stack(
+                [hub_pos[0] + X, hub_pos[1] + Y, hub_pos[2] + Zt], axis=1
+            )
+            lines.extend(
+                np.stack([p0, p1]) for p0, p1 in zip(pts[:-1], pts[1:])
+            )
+    return lines
+
+
 # ------------------------------------------------------------------- figures
 
 def plot_model(model, ax=None, color="k", nodes=False, station_plot=None):
@@ -138,6 +173,9 @@ def plot_model(model, ax=None, color="k", nodes=False, station_plot=None):
     segs = []
     for mem in model.members:
         segs.extend(member_wireframe(mem))
+    if getattr(model, "rotor", None) is not None:
+        hub = np.array([-model.rotor.overhang, 0.0, model.hHub])
+        segs.extend(rotor_wireframe(model.rotor, hub))
     ax.add_collection3d(
         Line3DCollection(segs, colors=color, linewidths=0.5, alpha=0.8)
     )

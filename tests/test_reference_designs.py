@@ -1,0 +1,109 @@
+"""End-to-end regression on the reference's shipped designs
+(/root/reference/designs — read-only inputs): full pipeline runs, eigen
+frequencies against published OC3-Hywind values, and the WAMIT-import
+path on the OC4/MARIN semi golden file."""
+
+import os
+
+import numpy as np
+import pytest
+
+from raft_tpu.model import Model
+from raft_tpu.io.schema import load_design
+
+DESIGNS = "/root/reference/designs"
+MARIN1 = "/root/reference/tests/marin_semi.1"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(DESIGNS), reason="reference designs not mounted"
+)
+
+
+@pytest.fixture(scope="module")
+def oc3():
+    m = Model(load_design(os.path.join(DESIGNS, "OC3spar.yaml")))
+    m.analyze_unloaded()
+    return m
+
+
+def test_oc3_eigen_frequencies(oc3):
+    """Published OC3-Hywind rigid-body modes: surge/sway ~0.008 Hz,
+    heave ~0.032 Hz, roll/pitch ~0.034 Hz; yaw is set by the design's
+    yaw_stiffness entry (reference designs/OC3spar.yaml:1072)."""
+    fns, modes = oc3.solve_eigen(display=0)
+    np.testing.assert_allclose(fns[0], 0.0080, atol=0.0005)
+    np.testing.assert_allclose(fns[1], 0.0080, atol=0.0005)
+    np.testing.assert_allclose(fns[2], 0.0325, atol=0.002)
+    np.testing.assert_allclose(fns[3], 0.0338, atol=0.002)
+    np.testing.assert_allclose(fns[4], 0.0338, atol=0.002)
+    # mode shapes: a deep spar's roll/pitch modes are pendulum modes
+    # (waterline translation dominates the normalized eigenvector — the
+    # reason the reference claims rotational DOFs first in its greedy sort,
+    # raft_model.py:434-449); every mode must still carry its own-DOF
+    # content and heave/yaw must be pure
+    for i in range(6):
+        assert abs(modes[i, i]) > 1e-3, f"mode {i} lost its {i}-DOF content"
+    assert abs(modes[2, 2]) > 0.99
+    assert abs(modes[5, 5]) > 0.99
+
+
+def test_oc3_full_case_run(oc3):
+    oc3.analyze_cases()
+    r = oc3.calc_outputs()
+    rao = r["response"]["surge RAO"]
+    assert np.isfinite(rao).all()
+    # surge RAO physics on the unit-spectrum case (JONSWAP cases carry zero
+    # amplitude in their spectral tails, where the RAO reports 0): the peak
+    # sits at the surge resonance (~0.008 Hz) and the response dies off at
+    # high frequency
+    from raft_tpu.io.schema import cases_as_dicts
+
+    iunit = [c["wave_spectrum"] for c in cases_as_dicts(oc3.design)].index(
+        "unit"
+    )
+    f = r["response"]["frequencies"]
+    f_peak = f[int(np.argmax(rao[iunit]))]
+    assert abs(f_peak - 0.008) < 0.005
+    assert rao[iunit, -1] < 0.1 * rao[iunit].max()
+    cm = r["case_metrics"]
+    assert (cm["surge_std"] > 0).all()
+    assert (cm["Tmoor_avg"] != 0).any()
+
+
+def test_oc4semi_with_wamit_import():
+    if not os.path.exists(MARIN1):
+        pytest.skip("marin_semi.1 not mounted")
+    m = Model(load_design(os.path.join(DESIGNS, "OC4semi.yaml")))
+    m.analyze_unloaded()
+    # the .3 golden blob is missing from the mirror; import radiation data
+    # only (the reference treats A/B and X independently,
+    # raft_fowt.py:486-495)
+    m.import_bem(MARIN1)
+    assert m.bem_coeffs.A.shape[1:] == (6, 6)
+    m.analyze_cases()
+    Xi = m.Xi
+    assert np.isfinite(Xi).all()
+    # BEM added mass raised the total surge inertia: rerun without import
+    m2 = Model(load_design(os.path.join(DESIGNS, "OC4semi.yaml")))
+    m2.analyze_unloaded()
+    m2.analyze_cases()
+    assert not np.allclose(np.abs(Xi), np.abs(m2.Xi), rtol=1e-3)
+
+
+def test_volturnus_strip_run():
+    design = load_design(os.path.join(DESIGNS, "VolturnUS-S.yaml"))
+    design["turbine"]["aeroServoMod"] = 0  # aero covered by test_parity
+    m = Model(design)
+    m.analyze_unloaded()
+    m.analyze_cases()
+    fns, _ = m.solve_eigen(display=0)
+    # published VolturnUS-S example modes (reference docs/usage.rst:457-467):
+    # surge/sway 0.0081, heave 0.0506, roll/pitch 0.0381, yaw 0.0127 Hz.
+    # The published example runs with potential-flow added mass; this
+    # strip-theory-only run underestimates heave added mass of the large
+    # columns, so heave sits high (0.060 vs 0.051) — the widest tolerance
+    # below reflects that known modeling difference, the others are tight.
+    np.testing.assert_allclose(fns[:2], 0.0081, atol=0.001)
+    np.testing.assert_allclose(fns[2], 0.0506, atol=0.011)
+    np.testing.assert_allclose(fns[3:5], 0.0381, atol=0.003)
+    np.testing.assert_allclose(fns[5], 0.0127, atol=0.002)

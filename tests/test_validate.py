@@ -1,0 +1,76 @@
+"""Validation-subsystem tests (raft_tpu/validate.py): host-side design
+checks and the checkify-wrapped device pipeline (SURVEY.md §5)."""
+
+import copy
+
+import numpy as np
+import pytest
+
+from raft_tpu.designs import demo_semi
+from raft_tpu.validate import checked_pipeline, validate_design
+
+
+def test_valid_design_passes():
+    assert validate_design(demo_semi()) == []
+
+
+def test_missing_sections_and_bad_depth():
+    bad = {"site": {"water_depth": -5.0}}
+    problems = validate_design(bad, raise_on_error=False)
+    assert any("turbine" in p for p in problems)
+    assert any("water_depth must be positive" in p for p in problems)
+    with pytest.raises(ValueError, match="design validation failed"):
+        validate_design(bad)
+
+
+def test_member_shape_mismatches_flagged():
+    d = demo_semi()
+    d["platform"]["members"][0]["stations"] = [0.0]
+    d["platform"]["members"][1]["t"] = [0.04, 0.04, 0.04]
+    problems = validate_design(d, raise_on_error=False)
+    assert any(">= 2 stations" in p for p in problems)
+    assert any("thicknesses" in p for p in problems)
+
+
+def test_case_table_checked():
+    d = demo_semi()
+    d["cases"]["data"][0] = d["cases"]["data"][0][:-1]          # short row
+    d["cases"]["data"][1][5] = "PiersonMoskowitz"               # bad spectrum
+    problems = validate_design(d, raise_on_error=False)
+    assert any("row 0 has" in p for p in problems)
+    assert any("unknown wave_spectrum" in p for p in problems)
+
+
+def test_non_numeric_values_reported_not_raised():
+    d = demo_semi()
+    d["site"]["water_depth"] = "deep"
+    d["cases"]["data"][0][6] = "twelve"         # wave_period
+    d["platform"]["members"][0]["stations"] = ["a", "b"]
+    problems = validate_design(d, raise_on_error=False)
+    assert any("site.water_depth: not numeric" in p for p in problems)
+    assert any("wave_period: not numeric" in p for p in problems)
+    assert any("stations are not numeric" in p for p in problems)
+
+
+def test_mooring_endpoints_checked():
+    d = demo_semi()
+    d["mooring"]["lines"][0]["endA"] = "nonexistent"
+    problems = validate_design(d, raise_on_error=False)
+    assert any("is not a defined point" in p for p in problems)
+
+
+def test_checked_pipeline_clean_run_and_nan_detection():
+    from raft_tpu.model import Model
+
+    m = Model(demo_semi(n_cases=1))
+    m.analyze_unloaded()
+    args, _ = m.prepare_case_inputs(verbose=False)
+    run = checked_pipeline(m)
+    out = run(*args)
+    assert np.isfinite(np.asarray(out[0])).all()
+
+    # poison the stiffness matrix -> NaN must surface as a checkify error
+    bad = list(args)
+    bad[2] = np.full_like(bad[2], np.nan)
+    with pytest.raises(Exception, match="nan"):
+        run(*bad)

@@ -60,6 +60,33 @@ def test_plot_model_smoke(analyzed_model):
     plt.close(fig)
 
 
+def test_rotor_wireframe():
+    import os
+
+    path = "/root/reference/designs/VolturnUS-S.yaml"
+    if not os.path.exists(path):
+        pytest.skip("reference design mount not present")
+    from raft_tpu.io.schema import load_design
+    from raft_tpu.viz import rotor_wireframe
+    from raft_tpu.aero import Rotor
+
+    design = load_design(path)
+    cfg = dict(design["turbine"])
+    cfg["rho_air"] = design["site"]["rho_air"]
+    cfg["mu_air"] = design["site"]["mu_air"]
+    cfg["shearExp"] = design["site"]["shearExp"]
+    rotor = Rotor(cfg, np.linspace(0.1, 1.0, 4))
+    segs = rotor_wireframe(rotor, np.array([0.0, 0.0, 150.0]))
+    arr = np.stack(segs)
+    assert np.isfinite(arr).all()
+    # 3 blades x 2 edges x (n_span-1) segments
+    n_span = len(np.asarray(rotor.geom["r"]))
+    assert len(segs) == 3 * 2 * (n_span - 1)
+    # blade tips reach roughly Rtip from the hub
+    d = np.linalg.norm(arr.reshape(-1, 3) - [0.0, 0.0, 150.0], axis=1)
+    assert d.max() > 0.9 * rotor.geom["Rtip"]
+
+
 def test_plot_responses_smoke(analyzed_model):
     fig, axes = analyzed_model.plot_responses()
     assert len(axes) == 6
