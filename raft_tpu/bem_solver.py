@@ -144,15 +144,26 @@ def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81):
 
     f = jnp.float32
     c = jnp.complex64
-    x = jnp.asarray(pa.cen, f)
-    nrm = jnp.asarray(pa.nrm, f)
-    y = jnp.asarray(pa.qpts, f)
-    w_q = jnp.asarray(pa.qwts, f)
-    S0j = jnp.asarray(S0, f)
-    K0j = jnp.asarray(K0, f)
-    vmj = jnp.asarray(vmodes, f)
-    Ft = jnp.asarray(F_tab, f)
-    F1t = jnp.asarray(F1_tab, f)
+    # every staged array is committed to the CPU backend up front: the
+    # dense complex LU has no TPU lowering, and building the [N,N,Q]
+    # pairwise geometry on an accelerator default-backend would waste HBM
+    # and transfer time before the inevitable CPU solve
+    import jax as _jax
+
+    cpu = _jax.devices("cpu")[0]
+
+    def on_cpu(a):
+        return _jax.device_put(jnp.asarray(a, f), cpu)
+
+    x = on_cpu(pa.cen)
+    nrm = on_cpu(pa.nrm)
+    y = on_cpu(pa.qpts)
+    w_q = on_cpu(pa.qwts)
+    S0j = on_cpu(S0)
+    K0j = on_cpu(K0)
+    vmj = on_cpu(vmodes)
+    Ft = on_cpu(F_tab)
+    F1t = on_cpu(F1_tab)
 
     # static pairwise geometry for the wave term (collocation x quad points);
     # passed as jit arguments (not captured constants) so XLA does not try to
@@ -217,18 +228,12 @@ def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81):
         X = 1j * omega * rho * (phiT * jnp.asarray(pa.area, f)[None]) @ vmj.T
         return A, B, X
 
-    # the dense complex LU has no TPU lowering (and the Green-function
-    # tables want f64 headroom), so the whole solve is pinned to the CPU
-    # backend: committed CPU inputs make jit compile and run there even
-    # when the default backend is a TPU
-    cpu = jax.devices("cpu")[0]
-    Rh, zz, ex, ey, S0j, K0j = jax.device_put(
-        (Rh, zz, ex, ey, S0j, K0j), cpu
-    )
+    # inputs are committed to CPU above, so jit compiles and runs there
+    # even when the default backend is a TPU
     fn = jax.jit(one_omega)
     A_all, B_all, X_all = [], [], []
     for om in np.asarray(omegas, float):
-        A, B, X = fn(jax.device_put(jnp.asarray(om, f), cpu),
+        A, B, X = fn(jax.device_put(np.asarray(om, np.float32), cpu),
                      Rh, zz, ex, ey, S0j, K0j)
         A_all.append(np.asarray(A))
         B_all.append(np.asarray(B))

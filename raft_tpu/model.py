@@ -367,20 +367,27 @@ class Model:
             )
         )
 
-    def case_pipeline_fn(self):
+    def case_pipeline_fn(self, checkable=False, wrap=None):
         """The (un-jitted) batched device function for the case dynamics:
         (zeta[nc,nw], beta[nc], C_lin[nc,6,6], M_lin[nc,nw,6,6],
         B_lin[nc,nw,6,6], F_add_r[nc,nw,6], F_add_i[nc,nw,6])
         -> (Xi_r[nc,6,nw], Xi_i[nc,6,nw], iters[nc], conv[nc]).
 
         Exposed separately so the driver entry point and the multichip dryrun
-        can jit it with explicit shardings."""
+        can jit it with explicit shardings.  ``wrap`` is applied to the
+        single-case closure before the vmap (the checkify hook used by
+        raft_tpu.validate.checked_pipeline, which also sets ``checkable``
+        for the scan-based fixed point)."""
         one_case = make_case_dynamics(
             self.w, self.k, self.depth, self.rho_water, self.g,
             self.XiStart, self.nIter, self.dtype, self.cdtype,
+            checkable=checkable,
         )
         nodes = self.nodes.astype(self.dtype)
-        return jax.vmap(lambda *a: one_case(nodes, *a))
+        fn = lambda *a: one_case(nodes, *a)  # noqa: E731
+        if wrap is not None:
+            fn = wrap(fn)
+        return jax.vmap(fn)
 
     def _build_pipeline(self):
         """The single jitted device graph: [case] -> Xi, F_iner."""

@@ -105,9 +105,38 @@ def default_collect(model, point, Xi):
 
 
 def make_sweep_mesh(devices=None):
-    """1-D 'design' mesh over all (or the given) local devices."""
+    """1-D 'design' mesh over all (or the given) devices — after
+    :func:`initialize_distributed` on every host, this spans the whole
+    multi-host pool (DCN between hosts, ICI within a slice)."""
     devs = list(devices) if devices is not None else jax.devices()
     return Mesh(np.array(devs), ("design",))
+
+
+def initialize_distributed(coordinator=None, num_processes=None,
+                           process_id=None):
+    """Join a multi-host JAX pool so sweeps span all hosts' devices.
+
+    Call once per host process before any other JAX use; afterwards
+    ``jax.devices()`` lists every chip in the pool and
+    :func:`make_sweep_mesh` shards the design axis across all of them.
+    Parameters default to the cloud-TPU/SLURM auto-detection built into
+    ``jax.distributed.initialize``; pass them explicitly on bare clusters
+    (coordinator = "host0:port").
+
+    The reference has no distributed path at all (SURVEY.md §2.4) — its
+    243-point sweep is a serial Python loop (parametersweep.py:56-100).
+    """
+    import jax as _jax
+
+    kwargs = {}
+    if coordinator is not None:
+        kwargs["coordinator_address"] = coordinator
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    _jax.distributed.initialize(**kwargs)
+    return _jax.process_index(), _jax.process_count()
 
 
 def run_sweep(

@@ -87,8 +87,11 @@ def validate_design(design, raise_on_error=True):
     for i, mem in enumerate(members):
         _check_member(mem, i, problems)
     turbine = design.get("turbine") or {}
-    if "tower" in turbine and turbine["tower"]:
-        _check_member(turbine["tower"], "tower", problems)
+    if turbine:
+        if not turbine.get("tower"):
+            problems.append("turbine.tower is required")
+        else:
+            _check_member(turbine["tower"], "tower", problems)
 
     cases = design.get("cases")
     if cases:
@@ -142,20 +145,12 @@ def checked_pipeline(model):
     import jax
     from jax.experimental import checkify
 
-    from raft_tpu.model import make_case_dynamics
-
-    # checkify cannot wrap a vmapped while_loop; wrap the single-case
-    # function and vmap the checked version instead (vmap-of-checkify)
-    one_case = make_case_dynamics(
-        model.w, model.k, model.depth, model.rho_water, model.g,
-        model.XiStart, model.nIter, model.dtype, model.cdtype,
+    # checkify cannot wrap a vmapped while_loop: the Model builds its
+    # pipeline as vmap-of-checkify-of-(scan-based fixed point) when asked
+    jitted = jax.jit(model.case_pipeline_fn(
         checkable=True,
-    )
-    nodes = model.nodes.astype(model.dtype)
-    checked = checkify.checkify(
-        lambda *a: one_case(nodes, *a), errors=checkify.float_checks
-    )
-    jitted = jax.jit(jax.vmap(checked))
+        wrap=lambda f: checkify.checkify(f, errors=checkify.float_checks),
+    ))
 
     def run(*args):
         err, out = jitted(*args)

@@ -1,0 +1,44 @@
+"""CLI smoke test: ``python -m raft_tpu`` end to end on a written design
+YAML (the reference's __main__ path, raft/raft_model.py:1140-1147)."""
+
+import os
+import subprocess
+import sys
+
+import yaml
+
+
+def test_cli_runs_full_analysis(tmp_path):
+    from raft_tpu.designs import deep_spar
+
+    def plain(obj):
+        """numpy scalars/arrays -> YAML-safe Python types."""
+        import numpy as np
+
+        if isinstance(obj, dict):
+            return {k: plain(v) for k, v in obj.items()}
+        if isinstance(obj, (list, tuple)):
+            return [plain(v) for v in obj]
+        if isinstance(obj, np.generic):
+            return obj.item()
+        if isinstance(obj, np.ndarray):
+            return obj.tolist()
+        return obj
+
+    design = plain(deep_spar(n_cases=1))
+    path = str(tmp_path / "spar.yaml")
+    with open(path, "w") as f:
+        yaml.safe_dump(design, f)
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    env["JAX_PLATFORMS"] = "cpu"   # subprocess runs headless on CPU
+    out = subprocess.run(
+        [sys.executable, "-m", "raft_tpu", path, "--precision", "float64"],
+        capture_output=True, text=True, timeout=560, env=env,
+        cwd=str(tmp_path),
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "Natural frequencies" in out.stdout
+    assert "analyzing cases" in out.stdout

@@ -90,6 +90,35 @@ def test_oc4semi_with_wamit_import():
     assert not np.allclose(np.abs(Xi), np.abs(m2.Xi), rtol=1e-3)
 
 
+def test_oc4semi_native_bem_vs_marin_wamit():
+    """Native panel solver vs the MARIN/WAMIT golden coefficients for the
+    OC4 semi (reference tests/marin_semi.1, the truth data used at
+    reference tests/verification.py:240-254): multi-column geometry with
+    tapered base columns, honoring the design's own per-member potMod
+    flags.  Measured agreement ~2-5%; asserted at 8%."""
+    if not os.path.exists(MARIN1):
+        pytest.skip("marin_semi.1 not mounted")
+    from raft_tpu.bem import read_wamit_1
+
+    w_ref, A_ref, B_ref, _, _ = read_wamit_1(MARIN1, rho=1025.0)
+    d = load_design(os.path.join(DESIGNS, "OC4semi.yaml"))
+    d["turbine"]["aeroServoMod"] = 0
+    d["platform"]["potModMaster"] = 0   # honor per-member potMod flags
+    m = Model(d)
+    assert [mem.potMod for mem in m.members].count(True) == 4
+    coeffs = m.run_bem(nw_bem=3, dz_max=3.0, da_max=3.0)
+    for k, wv in enumerate(coeffs.w):
+        i = int(np.argmin(np.abs(w_ref - wv)))
+        for dof in (0, 2):
+            ref = A_ref[i, dof, dof]
+            assert abs(coeffs.A[k, dof, dof] - ref) / ref < 0.08, (
+                f"A{dof}{dof} at w={wv:.2f}"
+            )
+        refB = B_ref[i, 0, 0]
+        if refB > 1e5:
+            assert abs(coeffs.B[k, 0, 0] - refB) / refB < 0.25
+
+
 def test_volturnus_strip_run():
     design = load_design(os.path.join(DESIGNS, "VolturnUS-S.yaml"))
     design["turbine"]["aeroServoMod"] = 0  # aero covered by test_parity
@@ -99,10 +128,11 @@ def test_volturnus_strip_run():
     fns, _ = m.solve_eigen(display=0)
     # published VolturnUS-S example modes (reference docs/usage.rst:457-467):
     # surge/sway 0.0081, heave 0.0506, roll/pitch 0.0381, yaw 0.0127 Hz.
-    # The published example runs with potential-flow added mass; this
-    # strip-theory-only run underestimates heave added mass of the large
-    # columns, so heave sits high (0.060 vs 0.051) — the widest tolerance
-    # below reflects that known modeling difference, the others are tight.
+    # Heave sits high here (0.060 vs 0.051): our strip formulas mirror the
+    # reference's line-for-line (raft_fowt.py:517-591) and the native BEM
+    # matches the MARIN golden data (test above), so the docs table likely
+    # comes from a configuration with potential-flow added mass included;
+    # the wide heave tolerance reflects that, the others are tight.
     np.testing.assert_allclose(fns[:2], 0.0081, atol=0.001)
     np.testing.assert_allclose(fns[2], 0.0506, atol=0.011)
     np.testing.assert_allclose(fns[3:5], 0.0381, atol=0.003)

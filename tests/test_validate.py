@@ -41,6 +41,13 @@ def test_case_table_checked():
     assert any("unknown wave_spectrum" in p for p in problems)
 
 
+def test_missing_tower_flagged():
+    d = demo_semi()
+    del d["turbine"]["tower"]
+    problems = validate_design(d, raise_on_error=False)
+    assert any("turbine.tower is required" in p for p in problems)
+
+
 def test_non_numeric_values_reported_not_raised():
     d = demo_semi()
     d["site"]["water_depth"] = "deep"
