@@ -147,13 +147,12 @@ def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81):
     # every staged array is committed to the CPU backend up front: the
     # dense complex LU has no TPU lowering, and building the [N,N,Q]
     # pairwise geometry on an accelerator default-backend would waste HBM
-    # and transfer time before the inevitable CPU solve
-    import jax as _jax
-
-    cpu = _jax.devices("cpu")[0]
+    # and transfer time before the inevitable CPU solve (np.asarray first,
+    # so nothing ever materializes on the accelerator)
+    cpu = jax.devices("cpu")[0]
 
     def on_cpu(a):
-        return _jax.device_put(jnp.asarray(a, f), cpu)
+        return jax.device_put(np.asarray(a, np.float32), cpu)
 
     x = on_cpu(pa.cen)
     nrm = on_cpu(pa.nrm)

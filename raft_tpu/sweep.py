@@ -126,8 +126,6 @@ def initialize_distributed(coordinator=None, num_processes=None,
     The reference has no distributed path at all (SURVEY.md §2.4) — its
     243-point sweep is a serial Python loop (parametersweep.py:56-100).
     """
-    import jax as _jax
-
     kwargs = {}
     if coordinator is not None:
         kwargs["coordinator_address"] = coordinator
@@ -135,8 +133,19 @@ def initialize_distributed(coordinator=None, num_processes=None,
         kwargs["num_processes"] = num_processes
     if process_id is not None:
         kwargs["process_id"] = process_id
-    _jax.distributed.initialize(**kwargs)
-    return _jax.process_index(), _jax.process_count()
+    jax.distributed.initialize(**kwargs)
+    return jax.process_index(), jax.process_count()
+
+
+def _fetch(x):
+    """Device array -> host NumPy, valid in multi-process runs too: a
+    globally sharded result is not fully addressable on one host, so it is
+    allgathered first (every host then holds the full sweep results)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(x)
 
 
 def run_sweep(
@@ -232,11 +241,12 @@ def run_sweep(
 
         dev_in = jax.device_put((nodes_b,) + args_b, sharding)
         xr, xi, iters, conv = pipeline(*dev_in)
-        xr, xi = np.asarray(xr, np.float64), np.asarray(xi, np.float64)
+        xr = _fetch(xr).astype(np.float64)
+        xi = _fetch(xi).astype(np.float64)
         Xi = xr + 1j * xi  # [n_dev, ncase, 6, nw]
 
         res = {"Xi_r": xr[:n_real], "Xi_i": xi[:n_real],
-               "converged": np.asarray(conv)[:n_real]}
+               "converged": _fetch(conv)[:n_real]}
         per_design_metrics = [
             collect(models[i], chunk_pts[i], Xi[i]) for i in range(n_real)
         ]
@@ -245,7 +255,9 @@ def run_sweep(
         for name in chunk_pts[0]:
             res[f"param_{name}"] = np.array([pt[name] for pt in chunk_pts])
 
-        if ck_path:
+        if ck_path and jax.process_index() == 0:
+            # one writer in multi-process runs (every host holds the full
+            # allgathered results, so checkpoints stay restartable anywhere)
             np.savez(ck_path, **res)
         if verbose:
             print(f"sweep chunk {k}: solved {n_real} designs on {n_dev} devices")

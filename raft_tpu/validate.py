@@ -86,8 +86,8 @@ def validate_design(design, raise_on_error=True):
         problems.append("platform.members is empty")
     for i, mem in enumerate(members):
         _check_member(mem, i, problems)
-    turbine = design.get("turbine") or {}
-    if turbine:
+    turbine = design.get("turbine")
+    if isinstance(turbine, dict):  # section present (even empty) -> needs tower
         if not turbine.get("tower"):
             problems.append("turbine.tower is required")
         else:

@@ -46,6 +46,10 @@ def test_missing_tower_flagged():
     del d["turbine"]["tower"]
     problems = validate_design(d, raise_on_error=False)
     assert any("turbine.tower is required" in p for p in problems)
+    # an empty turbine section must be flagged too, not just a missing key
+    d["turbine"] = {}
+    problems = validate_design(d, raise_on_error=False)
+    assert any("turbine.tower is required" in p for p in problems)
 
 
 def test_non_numeric_values_reported_not_raised():
