@@ -742,7 +742,15 @@ class Model:
             p["F_lines0"] = self.F_moor0
             p["C_lines0"] = self.C_moor0
             p["M support structure"] = st.M_struc_subCM
-            p["A support structure"] = self._A_morison
+            A_support = self._A_morison.copy()
+            if self.bem_coeffs is not None:
+                # reference adds the highest-frequency BEM added mass
+                # (raft_model.py:697: A_BEM[:,:,-1])
+                from raft_tpu.bem import interp_to_grid
+
+                A_bem, _, _ = interp_to_grid(self.bem_coeffs, self.w)
+                A_support = A_support + A_bem[-1]
+            p["A support structure"] = A_support
             p["C support structure"] = st.C_struc_sub + st.C_hydro + self.C_moor0
 
         if hasattr(self, "Xi"):

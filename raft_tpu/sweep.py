@@ -137,6 +137,40 @@ def initialize_distributed(coordinator=None, num_processes=None,
     return jax.process_index(), jax.process_count()
 
 
+def _load_checkpoint(ck_path):
+    """Load a chunk checkpoint if it exists; returns None to recompute.
+
+    Multi-process coherent: the exists/recompute decision is taken on
+    process 0 and broadcast, so every host makes the same choice
+    (recomputing a chunk runs global collectives that need all processes).
+    Multi-host checkpointing requires ``out_dir`` on a filesystem shared
+    by all hosts — a host that cannot see a checkpoint process 0 decided
+    to load gets a clear error instead of a collective hang.
+    """
+    if ck_path is None:
+        return None
+    if jax.process_count() == 1:
+        if not os.path.exists(ck_path):
+            return None
+        with np.load(ck_path, allow_pickle=False) as zf:
+            return {key: zf[key] for key in zf.files}
+
+    from jax.experimental import multihost_utils
+
+    exists = os.path.exists(ck_path) if jax.process_index() == 0 else False
+    exists = bool(multihost_utils.broadcast_one_to_all(np.array(exists)))
+    if not exists:
+        return None
+    if not os.path.exists(ck_path):
+        raise RuntimeError(
+            f"sweep checkpoint {ck_path} exists on process 0 but not on "
+            f"process {jax.process_index()}: multi-host sweeps need "
+            "out_dir on a shared filesystem"
+        )
+    with np.load(ck_path, allow_pickle=False) as zf:
+        return {key: zf[key] for key in zf.files}
+
+
 def _fetch(x):
     """Device array -> host NumPy, valid in multi-process runs too: a
     globally sharded result is not fully addressable on one host, so it is
@@ -200,9 +234,9 @@ def run_sweep(
         chunk_pts = points[k0 : k0 + n_dev]
         n_real = len(chunk_pts)
 
-        if ck_path and os.path.exists(ck_path):
-            with np.load(ck_path, allow_pickle=False) as zf:
-                chunk_results.append({key: zf[key] for key in zf.files})
+        loaded = _load_checkpoint(ck_path)
+        if loaded is not None:
+            chunk_results.append(loaded)
             if verbose:
                 print(f"sweep chunk {k}: loaded checkpoint ({n_real} designs)")
             continue

@@ -87,7 +87,9 @@ def validate_design(design, raise_on_error=True):
     for i, mem in enumerate(members):
         _check_member(mem, i, problems)
     turbine = design.get("turbine")
-    if isinstance(turbine, dict):  # section present (even empty) -> needs tower
+    if turbine is not None and not isinstance(turbine, dict):
+        problems.append("turbine must be a mapping")
+    elif isinstance(turbine, dict):  # present (even empty) -> needs tower
         if not turbine.get("tower"):
             problems.append("turbine.tower is required")
         else:

@@ -50,6 +50,10 @@ def test_missing_tower_flagged():
     d["turbine"] = {}
     problems = validate_design(d, raise_on_error=False)
     assert any("turbine.tower is required" in p for p in problems)
+    # and a non-mapping section is its own problem, not silently skipped
+    d["turbine"] = "IEA-15MW.yaml"
+    problems = validate_design(d, raise_on_error=False)
+    assert any("turbine must be a mapping" in p for p in problems)
 
 
 def test_non_numeric_values_reported_not_raised():
