@@ -523,7 +523,32 @@ class Model:
     def analyze_cases(self, display=0, runPyHAMS=False, meshDir=None):
         """Run all load cases: per-case statics (aero means + mooring
         equilibrium), batched dynamics solve, and response metrics
-        (reference raft/raft_model.py:149-309)."""
+        (reference raft/raft_model.py:149-309).
+
+        runPyHAMS=True triggers the potential-flow solve on potMod members
+        before the case batch, like the reference's calcBEM call
+        (raft_model.py:235-236) — here via the native panel solver; an
+        external HAMS/WAMIT output can be loaded with import_bem instead.
+        """
+        if runPyHAMS and any(m.potMod for m in self.members):
+            if self.bem_coeffs is None:
+                # solve at every distinct case wave heading so off-axis
+                # cases get their own excitation column (interp_to_grid
+                # selects the nearest tabulated heading per case)
+                headings = tuple(sorted({
+                    float(c.get("wave_heading", 0.0))
+                    for c in cases_as_dicts(self.design)
+                }))
+                if meshDir:  # also write the HAMS/WAMIT tree there
+                    self.preprocess_hams(mesh_dir=meshDir, headings=headings)
+                else:
+                    self.run_bem(headings=headings)
+            elif meshDir:
+                print(
+                    "analyze_cases: BEM coefficients already loaded; "
+                    "meshDir ignored — call preprocess_hams() directly to "
+                    "write the HAMS/WAMIT tree"
+                )
         args, aux = self.prepare_case_inputs()
         cases = aux["cases"]
         ncase = aux["ncase"]

@@ -1,0 +1,64 @@
+"""analyze_cases(runPyHAMS=True) parity: the flag triggers the native
+potential-flow solve on potMod members before the case batch (the
+reference's calcBEM hook, raft/raft_model.py:235-236), and with meshDir it
+also writes the HAMS/WAMIT interop tree."""
+
+import os
+
+import numpy as np
+
+from raft_tpu.designs import deep_spar
+from raft_tpu.model import Model
+
+
+def _design():
+    d = deep_spar(n_cases=1, nw_settings=(0.05, 0.5))
+    d["platform"]["members"][0]["potMod"] = True
+    d["platform"]["dz_BEM"] = 8.0
+    d["platform"]["da_BEM"] = 8.0
+    return d
+
+
+def test_runpyhams_triggers_native_bem(tmp_path):
+    m = Model(_design())
+    m.analyze_unloaded()
+    assert m.bem_coeffs is None
+    mesh_dir = str(tmp_path / "BEM")
+    m.analyze_cases(runPyHAMS=True, meshDir=mesh_dir)
+    assert m.bem_coeffs is not None
+    assert os.path.exists(
+        os.path.join(mesh_dir, "Output", "Wamit_format", "Buoy.1")
+    )
+    assert np.isfinite(m.Xi).all()
+
+
+def test_runpyhams_solves_case_headings(tmp_path):
+    d = _design()
+    # two cases at distinct headings -> both must be tabulated
+    row = list(d["cases"]["data"][0])
+    keys = d["cases"]["keys"]
+    row2 = list(row)
+    row2[keys.index("wave_heading")] = 90.0
+    d["cases"]["data"] = [row, row2]
+    m = Model(d)
+    m.analyze_unloaded()
+    m.analyze_cases(runPyHAMS=True)
+    np.testing.assert_allclose(np.sort(m.bem_coeffs.headings), [0.0, 90.0])
+
+
+def test_runpyhams_warns_when_meshdir_skipped(tmp_path, capsys):
+    m = Model(_design())
+    m.analyze_unloaded()
+    m.run_bem()
+    assert m.bem_coeffs is not None
+    m.analyze_cases(runPyHAMS=True, meshDir=str(tmp_path / "BEM"))
+    assert "meshDir ignored" in capsys.readouterr().out
+
+
+def test_runpyhams_noop_without_potmod_members():
+    d = _design()
+    d["platform"]["members"][0]["potMod"] = False
+    m = Model(d)
+    m.analyze_unloaded()
+    m.analyze_cases(runPyHAMS=True)
+    assert m.bem_coeffs is None
