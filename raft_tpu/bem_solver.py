@@ -49,14 +49,27 @@ class PanelArrays:
         return len(self.area)
 
 
-def panel_arrays(panels):
+def panel_arrays(panels, quad="gauss"):
     """Build PanelArrays from [npan,4,3] vertex panels with 2x2 Gauss
     quadrature on the bilinear patch (exact for planar quads; robust for the
-    clip-degenerate triangles)."""
+    clip-degenerate triangles).
+
+    quad="centroid" builds single-point (centroid x area) quadrature.
+    solve_bem uses it only for the smooth per-frequency wave term (the
+    near-singular Rankine assembly always keeps the 2x2 Gauss points):
+    ~2.4x faster assembly for design-loop preview solves at some accuracy
+    cost (measured <= ~5% max added-mass error on the OC4 semi vs MARIN
+    data before the Rankine part was exempted).
+    """
     from raft_tpu.mesh import panel_geometry
 
     p = np.asarray(panels, float)
     cen, nrm, area = panel_geometry(p)
+    if quad == "centroid":
+        return PanelArrays(cen=cen, nrm=nrm, area=area,
+                           qpts=cen[:, None, :], qwts=area[:, None])
+    if quad != "gauss":
+        raise ValueError(f"unknown quad {quad!r} (use 'gauss' or 'centroid')")
     a, b, c, d = p[:, 0], p[:, 1], p[:, 2], p[:, 3]
     qpts = np.empty((len(p), 4, 3))
     qwts = np.empty((len(p), 4))
@@ -126,7 +139,8 @@ def _radiation_normals(pa):
     return np.concatenate([pa.nrm.T, rxn.T], axis=0)  # [6, N]
 
 
-def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81):
+def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81,
+              quad="gauss"):
     """Radiation + diffraction solve over frequencies.
 
     panels : [npan,4,3] wetted-hull panels (outward normals)
@@ -137,8 +151,11 @@ def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81):
     import jax
     import jax.numpy as jnp
 
-    pa = panel_arrays(panels)
+    pa = panel_arrays(panels)        # 2x2 Gauss for the singular Rankine part
     S0, K0 = _rankine(pa)
+    # the per-frequency wave term is smooth: "centroid" swaps only its
+    # quadrature for a ~2.4x faster assembly loop
+    pa_wave = pa if quad == "gauss" else panel_arrays(panels, quad=quad)
     F_tab, F1_tab = greens.load_tables()
     vmodes = _radiation_normals(pa)                     # [6, N]
 
@@ -156,8 +173,8 @@ def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81):
 
     x = on_cpu(pa.cen)
     nrm = on_cpu(pa.nrm)
-    y = on_cpu(pa.qpts)
-    w_q = on_cpu(pa.qwts)
+    y = on_cpu(pa_wave.qpts)
+    w_q = on_cpu(pa_wave.qwts)
     S0j = on_cpu(S0)
     K0j = on_cpu(K0)
     vmj = on_cpu(vmodes)
@@ -256,7 +273,8 @@ def max_resolved_omega(panel_size, g=9.81, panels_per_wavelength=7.0):
 
 
 def coeffs_from_members(members, omegas, headings_deg=(0.0,), rho=1025.0,
-                        g=9.81, dz_max=0.0, da_max=0.0, panels=None):
+                        g=9.81, dz_max=0.0, da_max=0.0, panels=None,
+                        quad="gauss"):
     """Mesh all potMod members, run the native solver, return a HydroCoeffs
     set (same container the WAMIT-file import path produces, so the Model
     pipeline is agnostic to where coefficients came from).
@@ -280,7 +298,7 @@ def coeffs_from_members(members, omegas, headings_deg=(0.0,), rho=1025.0,
     w_cap = max_resolved_omega(size, g=g)
     w_solve = np.unique(np.minimum(omegas, w_cap))
     betas = np.deg2rad(np.asarray(headings_deg, float))
-    out = solve_bem(panels, w_solve, betas=betas, rho=rho, g=g)
+    out = solve_bem(panels, w_solve, betas=betas, rho=rho, g=g, quad=quad)
     return HydroCoeffs(
         w=out["w"], A=out["A"], B=out["B"],
         headings=np.asarray(headings_deg, float), X=out["X"],

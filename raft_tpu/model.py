@@ -54,6 +54,24 @@ _RAD2DEG = 57.29577951308232
 _SPECTRUM_CODES = {"still": 0, "none": 0, "unit": 1, "JONSWAP": 2}
 
 
+def _uniform_heading_grid(headings, resolution=1e-6):
+    """Smallest uniform grid (in degrees) containing every requested
+    heading — the representation the HAMS control-file schedule can
+    describe (min/step/count).  {0, 30, 90} -> (0, 30, 60, 90)."""
+    import math
+
+    hs = sorted({round(float(h) / resolution) for h in headings})
+    if len(hs) <= 1:
+        return (hs[0] * resolution,) if hs else (0.0,)
+    step = 0
+    for d in np.diff(hs):
+        step = math.gcd(step, int(d))
+    return tuple(
+        (hs[0] + i * step) * resolution
+        for i in range((hs[-1] - hs[0]) // step + 1)
+    )
+
+
 def make_case_dynamics(w, k, depth, rho, g, XiStart, nIter, dtype, cdtype,
                        checkable=False):
     """Build the single-case device function
@@ -218,7 +236,7 @@ class Model:
         return self.bem_coeffs
 
     def run_bem(self, headings=(0.0,), nw_bem=24, dz_max=None, da_max=None,
-                panels=None):
+                panels=None, quad="gauss"):
         """Run the NATIVE radiation/diffraction panel solver on all potMod
         members (the reference's calcBEM path, raft/raft_fowt.py:318-423,
         with the external Fortran HAMS subprocess replaced by the TPU-native
@@ -243,7 +261,7 @@ class Model:
         self.bem_coeffs = coeffs_from_members(
             [m for m in self.members if m.potMod], w_bem,
             headings_deg=headings, rho=self.rho_water, g=self.g,
-            dz_max=dz, da_max=da, panels=panels,
+            dz_max=dz, da_max=da, panels=panels, quad=quad,
         )
         return self.bem_coeffs
 
@@ -534,17 +552,22 @@ class Model:
             if self.bem_coeffs is None:
                 # solve at every distinct case wave heading so off-axis
                 # cases get their own excitation column (interp_to_grid
-                # selects the nearest tabulated heading per case)
-                headings = tuple(sorted({
+                # selects the nearest tabulated heading per case); the set
+                # is expanded to a uniform grid because the HAMS control
+                # file format (and preprocess_hams) describes headings as
+                # min/step/count
+                headings = _uniform_heading_grid(
                     float(c.get("wave_heading", 0.0))
                     for c in cases_as_dicts(self.design)
-                }))
+                )
                 if meshDir:  # also write the HAMS/WAMIT tree there
                     self.preprocess_hams(mesh_dir=meshDir, headings=headings)
                 else:
                     self.run_bem(headings=headings)
             elif meshDir:
-                print(
+                from raft_tpu.utils.profiling import logger
+
+                logger.warning(
                     "analyze_cases: BEM coefficients already loaded; "
                     "meshDir ignored — call preprocess_hams() directly to "
                     "write the HAMS/WAMIT tree"

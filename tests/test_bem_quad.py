@@ -1,0 +1,38 @@
+"""Quadrature-option tests for the panel solver: the centroid preview mode
+must stay within loosened tolerance of the Gauss default (and much faster
+assembly is its reason to exist)."""
+
+import numpy as np
+
+from raft_tpu import bem_solver, mesh
+
+
+def _spar_panels():
+    return mesh.clip_waterplane(
+        mesh.mesh_member([0, 108, 116, 130], [9.4, 9.4, 6.5, 6.5],
+                         np.array([0.0, 0.0, -120.0]),
+                         np.array([0.0, 0.0, 10.0]), 4.0, 3.0)
+    )
+
+
+def test_centroid_panel_arrays_shape():
+    panels = _spar_panels()
+    pa = bem_solver.panel_arrays(panels, quad="centroid")
+    assert pa.qpts.shape == (pa.n, 1, 3)
+    np.testing.assert_allclose(pa.qwts[:, 0], pa.area)
+    pa4 = bem_solver.panel_arrays(panels)
+    assert pa4.qpts.shape == (pa4.n, 4, 3)
+    np.testing.assert_allclose(pa4.qwts.sum(axis=1), pa4.area, rtol=1e-12)
+
+
+def test_centroid_quad_tracks_gauss():
+    panels = _spar_panels()
+    out_g = bem_solver.solve_bem(panels, [0.8], rho=1025.0, g=9.81)
+    out_c = bem_solver.solve_bem(panels, [0.8], rho=1025.0, g=9.81,
+                                 quad="centroid")
+    for dof in (0, 2, 4):
+        g = out_g["A"][0][dof, dof]
+        c = out_c["A"][0][dof, dof]
+        assert abs(c - g) / abs(g) < 0.10, f"A{dof}{dof}"
+    Xg, Xc = out_g["X"][0][0], out_c["X"][0][0]
+    assert abs(abs(Xc[0]) - abs(Xg[0])) / abs(Xg[0]) < 0.10

@@ -46,13 +46,27 @@ def test_runpyhams_solves_case_headings(tmp_path):
     np.testing.assert_allclose(np.sort(m.bem_coeffs.headings), [0.0, 90.0])
 
 
-def test_runpyhams_warns_when_meshdir_skipped(tmp_path, capsys):
+def test_runpyhams_warns_when_meshdir_skipped(tmp_path, caplog):
+    import logging
+
     m = Model(_design())
     m.analyze_unloaded()
     m.run_bem()
     assert m.bem_coeffs is not None
-    m.analyze_cases(runPyHAMS=True, meshDir=str(tmp_path / "BEM"))
-    assert "meshDir ignored" in capsys.readouterr().out
+    with caplog.at_level(logging.WARNING, logger="raft_tpu"):
+        m.analyze_cases(runPyHAMS=True, meshDir=str(tmp_path / "BEM"))
+    assert "meshDir ignored" in caplog.text
+
+
+def test_uniform_heading_grid():
+    from raft_tpu.model import _uniform_heading_grid
+
+    assert _uniform_heading_grid([0.0, 30.0, 90.0]) == (0.0, 30.0, 60.0, 90.0)
+    assert _uniform_heading_grid([45.0]) == (45.0,)
+    assert _uniform_heading_grid([]) == (0.0,)
+    np.testing.assert_allclose(
+        _uniform_heading_grid([0.0, 22.5, 45.0]), [0.0, 22.5, 45.0]
+    )
 
 
 def test_runpyhams_noop_without_potmod_members():
