@@ -99,7 +99,7 @@ def main():
     rao_np = np.abs(Xi_np) / np.where(mask, np.abs(zeta), np.inf)[:, None, :]
     rao_err = float(np.max(np.abs(rao_jax - rao_np)))
 
-    print(json.dumps({
+    out = {
         "metric": "VolturnUS-S RAO-solve wall-clock (128 w x 12 cases)",
         "value": round(t_jax, 6),
         "unit": "s",
@@ -113,7 +113,19 @@ def main():
                          "amortized in-graph solve cost",
         "rao_linf_err": rao_err,
         "backend": jax.default_backend(),
-    }))
+    }
+
+    # ---- north-star sweep benchmark: 256-design draft x ballast sweep
+    # (BASELINE.json configs[3]; full serial-NumPy baseline measured, no
+    # extrapolation).  Guarded so the headline metric always prints. ----
+    try:
+        import bench_sweep
+
+        out.update(bench_sweep.run(verbose=False))
+    except Exception as exc:  # pragma: no cover - defensive for the driver
+        out["sweep_error"] = f"{type(exc).__name__}: {exc}"
+
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

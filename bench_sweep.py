@@ -1,0 +1,214 @@
+"""Design-sweep benchmark: 256-point draft x ballast sweep of VolturnUS-S
+(BASELINE.json configs[3]; north-star target: 100x vs single-core NumPy).
+
+Two paths compute the SAME study (identical physics, f64 mooring in both):
+
+ - **fused TPU sweep** (raft_tpu/sweep_fused.py): 16 strip-node bundles
+   (one per draft), 32 statics evaluations (ballast-density linearity),
+   one vmapped f64 CPU mooring call, one jitted TPU dispatch for all
+   256 designs x 12 cases x 128 frequencies of dynamics;
+
+ - **serial NumPy baseline**: a reference-style Python loop over all 256
+   designs (reference raft/parametersweep.py:56-100 runRAFT-per-point
+   semantics) — per design: geometry processing + statics + mooring
+   equilibrium/linearization (raft_tpu/mooring_numpy.py) + the
+   reference-loop RAO solve (raft_tpu/reference_numpy.py).  Both paths
+   solve one mooring equilibrium per design (the cases are wind-free, so
+   mean loads are identical; the collapse is applied symmetrically).
+
+Reported: wall-clock of each path, speedup, per-design ms, and the response
+parity between the two (RAO-magnitude L_inf over a design sample).
+
+Timing convention: the fused path is timed on its hot second run (compile
+excluded, like bench.py's headline metric — compiles amortize across
+sweeps and persist in the XLA compilation cache); the one-time compile cost
+is reported separately.  Host prep IS included in the fused wall-clock.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+NW_MIN, NW_MAX = 0.00625, 0.8   # 128 bins, same grid as bench.py
+N_CASES = 12
+N_DRAFT, N_BALLAST = 16, 16     # 256 design points
+DRAFT_LO, DRAFT_HI = 0.85, 1.15
+BALLAST_LO, BALLAST_HI = 0.25, 1.75
+
+
+def _grids():
+    drafts = np.linspace(DRAFT_LO, DRAFT_HI, N_DRAFT)
+    ballasts = np.linspace(BALLAST_LO, BALLAST_HI, N_BALLAST)
+    return drafts, ballasts
+
+
+def _apply_point_numpy(base_design, draft, ballast):
+    """Serial-path design mutation for one point (dict level, like the
+    reference sweep's in-loop design updates)."""
+    from raft_tpu.sweep_fused import scale_draft
+
+    d = scale_draft(base_design, draft)
+    for mem in d["platform"]["members"]:
+        rf = mem.get("rho_fill")
+        if rf is None:
+            continue
+        if isinstance(rf, (list, tuple)):
+            mem["rho_fill"] = [float(x) * ballast for x in rf]
+        else:
+            mem["rho_fill"] = float(rf) * ballast
+    return d
+
+
+def run_numpy_sweep(base_design, drafts, ballasts, zeta, beta, w, k,
+                    depth, rho, g, yawstiff, XiStart, nIter, limit=None):
+    """Serial single-core NumPy sweep (the baseline).  Returns (wall-clock
+    seconds, metrics dict, Xi of the last design) over the first ``limit``
+    designs (None = all)."""
+    from raft_tpu.geometry import pack_nodes, process_members
+    from raft_tpu.mooring_numpy import case_mooring_np
+    from raft_tpu.mooring import parse_mooring
+    from raft_tpu.reference_numpy import added_mass_numpy, rao_solve_numpy
+    from raft_tpu.statics import compute_statics
+
+    points = [(d, bl) for d in drafts for bl in ballasts]
+    if limit is not None:
+        points = points[:limit]
+    nc, nw = zeta.shape
+    mass = np.zeros(len(points))
+    offset = np.zeros(len(points))
+    pitch = np.zeros(len(points))
+    std = np.zeros((len(points), nc, 6))
+    Xi = None
+
+    t0 = time.perf_counter()
+    for ip, (dr, bl) in enumerate(points):
+        d = _apply_point_numpy(base_design, dr, bl)
+        members = process_members(d)
+        nodes = pack_nodes(members)
+        st = compute_statics(members, d["turbine"], rho, g)
+        A = added_mass_numpy(nodes, rho)
+        ms = parse_mooring(d["mooring"], rho_water=rho, g=g)
+        props = (st.mass, st.V, st.rCG_TOT, np.array([0.0, 0.0, st.zMeta]),
+                 st.AWP)
+        r6, C_moor, F_moor, T_moor, J_moor = case_mooring_np(
+            np.zeros(6), props, ms.anchors, ms.rFair, ms.L, ms.EA, ms.w,
+            rho=rho, g=g, yawstiff=yawstiff,
+        )
+        # all cases share the wind-free mean load -> one equilibrium,
+        # C_moor broadcast across cases (same collapse as the fused path)
+        C_lin = (st.C_struc + st.C_hydro + C_moor)[None].repeat(nc, axis=0)
+        M_lin = np.broadcast_to(
+            st.M_struc + A, (nc, nw, 6, 6)
+        ).copy()
+        B_lin = np.zeros((nc, nw, 6, 6))
+        Fz = np.zeros((nc, nw, 6))
+        Xi = rao_solve_numpy(
+            nodes, w, k, depth, rho, g, zeta, beta, C_lin, M_lin, B_lin,
+            Fz, Fz, XiStart=XiStart, nIter=nIter,
+        )
+        dw = w[1] - w[0]
+        std[ip] = np.sqrt(
+            np.sum(np.abs(Xi) ** 2, axis=-1) * dw
+        ).reshape(nc, 6)
+        mass[ip] = st.mass
+        offset[ip] = np.hypot(r6[0], r6[1])
+        pitch[ip] = np.rad2deg(r6[4])
+    t_np = time.perf_counter() - t0
+    return t_np, dict(mass=mass, offset=offset, pitch=pitch, std=std), Xi
+
+
+def run(baseline_limit=None, verbose=True):
+    """Run both paths; returns the result dict for bench.py."""
+    import jax
+
+    from __graft_entry__ import _flagship_design
+    from raft_tpu.model import Model
+    from raft_tpu.sweep_fused import run_draft_ballast_sweep
+
+    from raft_tpu.io.schema import cases_as_dicts
+
+    base = _flagship_design(NW_MIN, NW_MAX, N_CASES)
+    drafts, ballasts = _grids()
+    model0 = Model(base)
+    spec, height, period, beta, wind = model0._case_arrays(
+        cases_as_dicts(base)
+    )
+    zeta = model0._zeta(spec, height, period)
+
+    # ---- fused TPU sweep: first run (compiles), then a timed hot run ----
+    res = run_draft_ballast_sweep(
+        base, drafts, ballasts, draft_group=4, verbose=verbose,
+    )
+    t_first = res["timing"]["total_s"]
+    t0 = time.perf_counter()
+    res_hot = run_draft_ballast_sweep(
+        base, drafts, ballasts, draft_group=4, verbose=verbose,
+    )
+    t_fused = time.perf_counter() - t0
+
+    n_designs = N_DRAFT * N_BALLAST
+
+    # ---- serial NumPy baseline ----
+    n_base = n_designs if baseline_limit is None else baseline_limit
+    t_np, np_metrics, Xi_np_last = run_numpy_sweep(
+        base, drafts, ballasts, zeta, beta, model0.w, model0.k,
+        model0.depth, model0.rho_water, model0.g, model0.yawstiff,
+        model0.XiStart, model0.nIter, limit=baseline_limit,
+    )
+
+    # ---- parity between the two paths ----
+    flat = lambda key: res_hot[key].reshape(n_designs, *res_hot[key].shape[2:])  # noqa: E731
+    nb = len(np_metrics["mass"])
+    mass_err = float(np.max(np.abs(
+        flat("mass").ravel()[:nb] - np_metrics["mass"]
+    ) / np_metrics["mass"]))
+    off_err = float(np.max(np.abs(flat("offset").ravel()[:nb] - np_metrics["offset"])))
+    std_tpu = flat("std")[:nb]
+    denom = np.maximum(np.abs(np_metrics["std"]), 1e-3)
+    std_err = float(np.max(np.abs(std_tpu - np_metrics["std"]) / denom))
+
+    # RAO parity on the LAST baseline design (full Xi path comparison)
+    points = [(d, bl) for d in drafts for bl in ballasts]
+    dr_last, bl_last = points[nb - 1]
+    res_xi = run_draft_ballast_sweep(
+        base, [dr_last], [bl_last],
+        draft_group=1, return_xi=True, verbose=False,
+    )
+    mask = np.abs(zeta) > 1e-3
+    rao_tpu = np.abs(res_xi["Xi"][0, 0]) / np.where(mask, np.abs(zeta), np.inf)[:, None, :]
+    rao_np = np.abs(Xi_np_last) / np.where(mask, np.abs(zeta), np.inf)[:, None, :]
+    rao_err = float(np.max(np.abs(rao_tpu - rao_np)))
+
+    per_design_np = t_np / nb
+    baseline_full = per_design_np * n_designs
+    out = {
+        "sweep_n_designs": n_designs,
+        "sweep_wall_s": round(t_fused, 3),
+        "sweep_first_run_s": round(t_first, 3),
+        "sweep_per_design_ms": round(t_fused / n_designs * 1000, 3),
+        "sweep_baseline_numpy_s": round(t_np, 3),
+        "sweep_baseline_designs_timed": nb,
+        "sweep_baseline_full_s": round(baseline_full, 3),
+        "sweep_vs_baseline": round(baseline_full / t_fused, 2),
+        "sweep_rao_linf_err": rao_err,
+        "sweep_mass_rel_err": mass_err,
+        "sweep_offset_abs_err_m": off_err,
+        "sweep_std_rel_err": std_err,
+        "sweep_converged_frac": float(np.mean(res_hot["converged"])),
+        "sweep_timing_breakdown": {
+            k: round(v, 3) for k, v in res_hot["timing"].items()
+        },
+    }
+    if verbose:
+        print(json.dumps(out))
+    return out
+
+
+if __name__ == "__main__":
+    limit = int(sys.argv[1]) if len(sys.argv) > 1 else None
+    run(baseline_limit=limit)

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.io.schema import get_from_dict
+from raft_tpu.utils.placement import put_cpu
 from raft_tpu.wind import kaimal_rotor_spectrum
 
 _RAD2DEG = 57.29577951308232
@@ -334,6 +335,11 @@ def rotor_evaluate(Uinf, Omega, pitch, geom, polars, env, nSector=4):
 
 # ---------------------------------------------------------------- Rotor
 
+# compiled loads+derivatives executables shared across Rotor instances with
+# identical configuration (keyed by the raw geometry/polar bytes)
+_rotor_eval_cache = {}
+
+
 class Rotor:
     """Rotor aerodynamics + control for the frequency-domain model
     (reference raft/raft_rotor.py:35-489)."""
@@ -383,32 +389,46 @@ class Rotor:
         self.set_control_gains(turbine)
 
         # jit the loads+derivatives evaluation once (CPU backend via input
-        # placement; tiny arrays)
-        cpu = jax.devices("cpu")[0]
-        self._cpu = cpu
-        geom = {
-            k: (jax.device_put(v, cpu) if isinstance(v, jnp.ndarray) else v)
-            for k, v in self.geom.items()
-        }
-        polars = tuple(jax.device_put(p, cpu) for p in self.polars)
-        env = self.env
+        # placement; tiny arrays).  The compiled executable is shared across
+        # Rotor instances with identical configuration through a module-level
+        # cache — a design sweep constructs hundreds of Models with the same
+        # turbine, and a per-instance jax.jit closure would recompile the
+        # whole BEM+jacfwd graph each time.
+        key = (
+            gt.tobytes(),
+            aoa.tobytes(), cl.tobytes(), cd.tobytes(),
+            tuple(sorted(
+                (k, v) for k, v in self.geom.items()
+                if not isinstance(v, jnp.ndarray)
+            )),
+            tuple(sorted(self.env.items())),
+        )
+        self._eval = _rotor_eval_cache.get(key)
+        if self._eval is None:
+            geom = {
+                k: (put_cpu(v) if isinstance(v, jnp.ndarray) else v)
+                for k, v in self.geom.items()
+            }
+            polars = tuple(put_cpu(p) for p in self.polars)
+            env = self.env
 
-        def loads_TQ(U, Om, pitch, tilt, yaw):
-            g = dict(geom)
-            g["tilt"] = tilt
-            g["yaw"] = yaw
-            out = rotor_evaluate(U, Om, pitch, g, polars, env)
-            return jnp.stack([out["T"], out["Q"], out["P"],
-                              out["CP"], out["CT"], out["CQ"]])
+            def loads_TQ(U, Om, pitch, tilt, yaw):
+                g = dict(geom)
+                g["tilt"] = tilt
+                g["yaw"] = yaw
+                out = rotor_evaluate(U, Om, pitch, g, polars, env)
+                return jnp.stack([out["T"], out["Q"], out["P"],
+                                  out["CP"], out["CT"], out["CQ"]])
 
-        def loads_and_derivs(U, Om, pitch, tilt, yaw):
-            vals = loads_TQ(U, Om, pitch, tilt, yaw)
-            JT = jax.jacfwd(lambda a: loads_TQ(*a, tilt, yaw))(
-                jnp.stack([U, Om, pitch])
-            )  # [6 outputs, 3 inputs]
-            return vals, JT
+            def loads_and_derivs(U, Om, pitch, tilt, yaw):
+                vals = loads_TQ(U, Om, pitch, tilt, yaw)
+                JT = jax.jacfwd(lambda a: loads_TQ(*a, tilt, yaw))(
+                    jnp.stack([U, Om, pitch])
+                )  # [6 outputs, 3 inputs]
+                return vals, JT
 
-        self._eval = jax.jit(loads_and_derivs)
+            self._eval = jax.jit(loads_and_derivs)
+            _rotor_eval_cache[key] = self._eval
 
     # -------------------------------------------------------------- control
 
@@ -444,7 +464,7 @@ class Rotor:
         pitch_deg = np.interp(Uhub, self.Uhub, self.pitch_deg)
         tilt = np.deg2rad(self.shaft_tilt) + ptfm_pitch
 
-        put = lambda x: jax.device_put(jnp.float64(x), self._cpu)
+        put = lambda x: put_cpu(np.float64(x))  # noqa: E731
         vals, J = self._eval(
             put(Uhub), put(Omega_rpm * np.pi / 30.0),
             put(np.deg2rad(pitch_deg)), put(tilt),

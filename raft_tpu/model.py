@@ -20,6 +20,7 @@ Architecture (TPU-first, not a port):
 """
 
 import os
+from functools import lru_cache
 
 import numpy as np
 
@@ -35,12 +36,13 @@ from raft_tpu.hydro import (
 from raft_tpu.dynamics import solve_dynamics
 from raft_tpu.io.schema import cases_as_dicts, get_from_dict, load_design
 from raft_tpu.mooring import (
-    case_mooring,
-    coupled_stiffness,
+    case_mooring_batch_fn,
     line_forces,
     parse_mooring,
+    unloaded_mooring_fn,
 )
 from raft_tpu.statics import compute_statics, member_inertia
+from raft_tpu.utils.placement import put_cpu
 from raft_tpu.utils.profiling import timer
 from raft_tpu.utils.frames import (
     transform_force,
@@ -52,6 +54,16 @@ from raft_tpu.waves import wave_kinematics, wave_number
 _RAD2DEG = 57.29577951308232
 
 _SPECTRUM_CODES = {"still": 0, "none": 0, "unit": 1, "JONSWAP": 2}
+
+
+@lru_cache(maxsize=32)
+def _wave_numbers_cached(w_bytes, nw, depth, g):
+    """Dispersion solve for a frequency grid, cached across Model instances
+    (a design sweep re-solves the identical grid hundreds of times)."""
+    w = np.frombuffer(w_bytes, np.float64, count=nw)
+    k = np.asarray(wave_number(put_cpu(w), depth, g=g))
+    k.setflags(write=False)  # the cached array is shared across Models
+    return k
 
 
 def _uniform_heading_grid(headings, resolution=1e-6):
@@ -148,9 +160,8 @@ class Model:
         self.rho_water = get_from_dict(site, "rho_water", default=1025.0)
         self.g = get_from_dict(site, "g", default=9.81)
 
-        cpu = jax.devices("cpu")[0]
-        self.k = np.asarray(
-            wave_number(jax.device_put(self.w, cpu), self.depth, g=self.g)
+        self.k = _wave_numbers_cached(
+            self.w.tobytes(), self.nw, self.depth, self.g
         )
 
         # members + packed strip nodes
@@ -189,7 +200,6 @@ class Model:
         self._ICG_turbine = None
         self.results = {}
         self._pipeline = None
-        self._moor_case_fn = None
         self.bem_coeffs = None
 
     # ------------------------------------------------------------------
@@ -201,8 +211,9 @@ class Model:
         equilibrium offsets (reference raft/raft_model.py:109-146)."""
         z6 = jnp.zeros(6, dtype=jnp.float64)
         arr = self._moor_arrays
-        self.C_moor0 = np.asarray(coupled_stiffness(z6, *arr))
-        self.F_moor0 = np.asarray(line_forces(z6, *arr)[0])
+        C0, F0 = unloaded_mooring_fn()(z6, *arr)
+        self.C_moor0 = np.asarray(C0)
+        self.F_moor0 = np.asarray(F0)
 
         if ballast == 1:
             self.adjust_ballast(heave_tol=heave_tol)
@@ -266,40 +277,31 @@ class Model:
         return self.bem_coeffs
 
     def _added_mass_f64(self):
-        cpu = jax.devices("cpu")[0]
-        nodes64 = jax.device_put(self.nodes.astype(np.float64), cpu)
+        nodes64 = put_cpu(self.nodes.astype(np.float64))
         return added_mass_morison(nodes64, self.rho_water)
 
     def _body_props(self):
         st = self.statics
         return (
-            st.mass,
-            st.V,
-            jnp.asarray(st.rCG_TOT),
-            jnp.asarray([0.0, 0.0, st.zMeta]),
-            st.AWP,
+            np.float64(st.mass),
+            np.float64(st.V),
+            np.asarray(st.rCG_TOT, np.float64),
+            np.array([0.0, 0.0, st.zMeta]),
+            np.float64(st.AWP),
         )
 
     def _mooring_and_offsets(self, F_aero0):
         """Mean offsets + linearized mooring for a batch of mean-load
-        vectors [ncase, 6] (reference raft/raft_model.py:332-392), through a
-        single jitted vmapped executable (compiled once per Model)."""
+        vectors [ncase, 6] (reference raft/raft_model.py:332-392), through
+        the module-level cached jitted executable (mooring.
+        case_mooring_batch_fn — one compile serves every Model with the
+        same physics scalars and array shapes)."""
         F_aero0 = np.atleast_2d(F_aero0)
-        if self._moor_case_fn is None:
-            arr = self._moor_arrays
-
-            def one(f6, m, v, rCG, rM, AWP):
-                return case_mooring(
-                    f6, m, v, rCG, rM, AWP, *arr,
-                    rho=self.rho_water, g=self.g, yawstiff=self.yawstiff,
-                )
-
-            self._moor_case_fn = jax.jit(
-                jax.vmap(one, in_axes=(0, None, None, None, None, None))
-            )
-        cpu = jax.devices("cpu")[0]
-        args = jax.device_put((jnp.asarray(F_aero0),) + self._body_props(), cpu)
-        out = self._moor_case_fn(*args)
+        fn = case_mooring_batch_fn(self.rho_water, self.g, self.yawstiff)
+        args = put_cpu(
+            (np.asarray(F_aero0, np.float64),) + self._body_props()
+        ) + self._moor_arrays
+        out = fn(*args)
         return tuple(np.asarray(o) for o in out)
 
     # ------------------------------------------------------------------

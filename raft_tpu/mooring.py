@@ -19,6 +19,7 @@ is MoorPy's default for lines parsed from YAML), solved by damped Newton in
 """
 
 from dataclasses import dataclass
+from functools import lru_cache
 
 import jax
 import jax.numpy as jnp
@@ -54,17 +55,15 @@ class MooringSystem:
         eager op downstream execute on CPU.  Pass ``device=None`` to leave
         placement to the caller (e.g. inside a jitted pipeline).
         """
-        out = (
-            jnp.asarray(self.anchors, dtype),
-            jnp.asarray(self.rFair, dtype),
-            jnp.asarray(self.L, dtype),
-            jnp.asarray(self.EA, dtype),
-            jnp.asarray(self.w, dtype),
-        )
+        np_dtype = np.dtype(dtype.dtype if hasattr(dtype, "dtype") else dtype)
+        src = (self.anchors, self.rFair, self.L, self.EA, self.w)
         if device == "cpu":
-            cpu = jax.devices("cpu")[0]
-            out = tuple(jax.device_put(a, cpu) for a in out)
-        return out
+            from raft_tpu.utils.placement import put_cpu
+
+            # place from the NumPy source: device_put of an existing jax
+            # array goes through a ~100 ms/call path on plugin backends
+            return tuple(put_cpu(np.asarray(a, np_dtype)) for a in src)
+        return tuple(jnp.asarray(a, dtype) for a in src)
 
 
 def parse_mooring(mooring, rho_water=1025.0, g=9.81):
@@ -139,14 +138,22 @@ def _profile(H, V, L, EA, w):
     return jnp.where(suspended, xs, xt), jnp.where(suspended, zs, zt)
 
 
-def catenary_solve(XF, ZF, L, EA, w, iters=60):
+def catenary_solve(XF, ZF, L, EA, w, iters=60, tol=1e-11):
     """Solve one line for fairlead tension components (HF, VF) such that the
     catenary spans horizontal distance XF and vertical distance ZF.
 
-    Damped Newton in (log HF, VF) — log keeps HF positive; 60 full Newton
-    steps converge to machine precision from the MoorPy-style initial guess
-    well before the cap.  Differentiable (fixed iteration count, so jacfwd
-    propagates cleanly through the converged fixed point).
+    Damped Newton in (log HF, VF) — log keeps HF positive — from the
+    MoorPy-style initial guess, iterated to a relative-residual tolerance
+    inside a ``while_loop`` (cap ``iters``).
+
+    Differentiation is *implicit* via ``lax.custom_root``: tangents come
+    from one 2x2 linear solve of the profile equations at the converged
+    point (implicit function theorem) rather than unrolling the Newton
+    iterations.  That makes every consumer — the equilibrium Jacobian, the
+    autodiff stiffness ``C_moor``, the tension Jacobian ``J_moor`` — both
+    much cheaper to trace/compile and far better conditioned in float32,
+    which is what lets the design-sweep driver run the whole mooring stage
+    on the TPU.
     """
     # guard XF -> 0 (fairlead directly above anchor, e.g. a vertical tendon):
     # treat as a tiny horizontal span so the solve stays finite; HF then
@@ -158,27 +165,60 @@ def catenary_solve(XF, ZF, L, EA, w, iters=60):
     H0 = jnp.maximum(jnp.abs(0.5 * w * XF / lam0), 10.0)
     V0 = 0.5 * w * (ZF / jnp.tanh(lam0) + L)
     W = w * L
+    scale = jnp.maximum(jnp.abs(XF), jnp.abs(ZF))
+    tol = jnp.asarray(tol, XF.dtype) + 30 * jnp.finfo(XF.dtype).eps
 
     def resid(p):
+        # residual as a function of the unknowns only; XF/ZF/L/EA/w enter
+        # by closure, so custom_root's implicit derivative covers them
         H = jnp.exp(p[0])
         V = p[1]
         x, z = _profile(H, V, L, EA, w)
         return jnp.stack([x - XF, z - ZF])
 
-    jac = jax.jacfwd(resid)
+    def solve(f, p0):
+        jac = jax.jacfwd(f)
 
-    def body(_, p):
-        f = resid(p)
-        J = jac(p)
+        def step(p):
+            r = f(p)
+            J = jac(p)
+            det = J[0, 0] * J[1, 1] - J[0, 1] * J[1, 0]
+            det = jnp.where(jnp.abs(det) < 1e-30, 1e-30, det)
+            du = (J[1, 1] * r[0] - J[0, 1] * r[1]) / det
+            dv = (-J[1, 0] * r[0] + J[0, 0] * r[1]) / det
+            du = jnp.clip(du, -1.5, 1.5)
+            dv = jnp.clip(
+                dv, -0.5 * (jnp.abs(p[1]) + W), 0.5 * (jnp.abs(p[1]) + W)
+            )
+            return p - jnp.stack([du, dv]), jnp.max(jnp.abs(r)) / scale
+
+        def cond(state):
+            i, p, err = state
+            return (i < iters) & (err > tol)
+
+        def body(state):
+            i, p, _ = state
+            p, err = step(p)
+            return i + 1, p, err
+
+        _, p, _ = jax.lax.while_loop(
+            cond, body, (jnp.array(0), p0, jnp.asarray(jnp.inf, XF.dtype))
+        )
+        return p
+
+    def tangent_solve(g, y):
+        # g is the residual linearized at the solution; solve the 2x2 system
+        J = jax.jacfwd(g)(jnp.zeros_like(y))
         det = J[0, 0] * J[1, 1] - J[0, 1] * J[1, 0]
         det = jnp.where(jnp.abs(det) < 1e-30, 1e-30, det)
-        du = (J[1, 1] * f[0] - J[0, 1] * f[1]) / det
-        dv = (-J[1, 0] * f[0] + J[0, 0] * f[1]) / det
-        du = jnp.clip(du, -1.5, 1.5)
-        dv = jnp.clip(dv, -0.5 * (jnp.abs(p[1]) + W), 0.5 * (jnp.abs(p[1]) + W))
-        return p - jnp.stack([du, dv])
+        return jnp.stack([
+            (J[1, 1] * y[0] - J[0, 1] * y[1]) / det,
+            (-J[1, 0] * y[0] + J[0, 0] * y[1]) / det,
+        ])
 
-    p = jax.lax.fori_loop(0, iters, body, jnp.stack([jnp.log(H0), V0]))
+    p = jax.lax.custom_root(
+        resid, jnp.stack([jnp.log(H0), V0]), solve, tangent_solve
+    )
     return jnp.exp(p[0]), p[1]
 
 
@@ -228,11 +268,16 @@ def body_hydrostatic_force(r6, m, v, rCG, rM, AWP, rho=1025.0, g=9.81):
 
 def solve_equilibrium(
     f6_ext, body_props, anchors, rFair, L, EA, w, rho=1025.0, g=9.81,
-    iters=40, r6_init=None,
+    iters=40, r6_init=None, step_tol=1e-8,
 ):
     """Find the body pose r6 where mooring + hydrostatics + external mean
     loads balance (the reference's ms.solveEquilibrium3 call,
-    raft/raft_model.py:347).  Damped Newton with the exact autodiff Jacobian.
+    raft/raft_model.py:347).  Damped Newton with the exact autodiff
+    Jacobian, iterated inside a ``while_loop`` until the Newton step is
+    below ``step_tol`` (translations: m, rotations: rad) or ``iters`` is
+    reached — nothing differentiates *through* this loop
+    (:func:`case_mooring` linearizes at the converged pose), so the
+    data-dependent trip count is free.
 
     body_props : (m, v, rCG[3], rM[3], AWP)
     Returns r6[6].
@@ -247,19 +292,28 @@ def solve_equilibrium(
     jac = jax.jacfwd(total_force)
     # derive constants from an operand so eager placement follows the system
     # arrays (committed to CPU by MooringSystem.arrays())
-    step_cap = jnp.zeros_like(L, shape=(6,)) + jnp.array(
-        [10.0, 10.0, 10.0, 0.1, 0.1, 0.1]
+    step_cap = jnp.zeros_like(L, shape=(6,)) + jnp.asarray(
+        [10.0, 10.0, 10.0, 0.1, 0.1, 0.1], L.dtype
     )
+    tol = jnp.asarray(step_tol, L.dtype) + 100 * jnp.finfo(L.dtype).eps
 
-    def body_fn(_, r6):
+    def cond(state):
+        i, r6, err = state
+        return (i < iters) & (err > tol)
+
+    def body_fn(state):
+        i, r6, _ = state
         F = total_force(r6)
         J = jac(r6)
         dx = jnp.linalg.solve(J, -F)
         dx = jnp.clip(dx, -step_cap, step_cap)
-        return r6 + dx
+        return i + 1, r6 + dx, jnp.max(jnp.abs(dx))
 
     r0 = jnp.zeros_like(L, shape=(6,)) if r6_init is None else jnp.asarray(r6_init)
-    return jax.lax.fori_loop(0, iters, body_fn, r0)
+    _, r6, _ = jax.lax.while_loop(
+        cond, body_fn, (jnp.array(0), r0, jnp.asarray(jnp.inf, L.dtype))
+    )
+    return r6
 
 
 def coupled_stiffness(r6, anchors, rFair, L, EA, w):
@@ -303,3 +357,59 @@ def case_mooring(f6_ext, m, v, rCG, rM, AWP, anchors, rFair, L, EA, w,
     T_moor = line_tensions(r6, anchors, rFair, L, EA, w)
     J_moor = tension_jacobian(r6, anchors, rFair, L, EA, w)
     return r6, C_moor, F_moor, T_moor, J_moor
+
+
+# ---------------- cached jitted entry points ----------------
+#
+# jit caches executables on the *function object*, so a `jax.jit` taken on a
+# fresh closure inside each Model instance recompiles the whole
+# autodiff-through-catenary graph per model (~10 s on CPU).  Repeated model
+# construction — the design-sweep inner loop — must instead reuse one
+# compiled executable, so the jitted wrappers live here at module level,
+# keyed only by the (hashable) physics scalars; array shapes are handled by
+# jit's own cache.
+
+def _case_mooring_flat(rho, g, yawstiff):
+    """Positional-argument :func:`case_mooring` wrapper shared by the
+    cached batch entry points below."""
+
+    def one(f6, m, v, rCG, rM, AWP, anchors, rFair, L, EA, w):
+        return case_mooring(
+            f6, m, v, rCG, rM, AWP, anchors, rFair, L, EA, w,
+            rho=rho, g=g, yawstiff=yawstiff,
+        )
+
+    return one
+
+
+@lru_cache(maxsize=None)
+def case_mooring_batch_fn(rho, g, yawstiff):
+    """Jitted :func:`case_mooring`, vmapped over the case axis of ``f6_ext``
+    (body properties and line arrays are shared across cases)."""
+    one = _case_mooring_flat(rho, g, yawstiff)
+    return jax.jit(jax.vmap(one, in_axes=(0,) + (None,) * 10))
+
+
+@lru_cache(maxsize=None)
+def case_mooring_design_batch_fn(rho, g, yawstiff):
+    """Jitted :func:`case_mooring` vmapped over designs *and* cases:
+    f6_ext[nd, nc, 6], body props [nd,...], line arrays [nd, nL, ...] —
+    the sweep driver's batched mooring equilibrium (one compile serves the
+    whole sweep)."""
+    one = _case_mooring_flat(rho, g, yawstiff)
+    per_design = jax.vmap(one, in_axes=(0,) + (None,) * 10)
+    return jax.jit(jax.vmap(per_design))
+
+
+@lru_cache(maxsize=None)
+def unloaded_mooring_fn():
+    """Jitted (C_moor0, F_moor0) at a given pose — the undisplaced
+    linearization consumed by analyze_unloaded (reference
+    raft/raft_model.py:117-118)."""
+
+    def f(r6, anchors, rFair, L, EA, w):
+        C0 = coupled_stiffness(r6, anchors, rFair, L, EA, w)
+        F0 = line_forces(r6, anchors, rFair, L, EA, w)[0]
+        return C0, F0
+
+    return jax.jit(f)

@@ -1,0 +1,199 @@
+"""Serial single-core NumPy mooring solver — the performance-baseline twin
+of :mod:`raft_tpu.mooring`.
+
+This reproduces, in plain NumPy with Python loops over lines, the MoorPy
+call pattern the reference consumes (reference raft/raft_model.py:332-378:
+``ms.solveEquilibrium3`` then ``ms.getCoupledStiffness(..., tensions=True)``),
+the same way :mod:`raft_tpu.reference_numpy` reproduces the reference's
+dynamics loops.  It exists so the design-sweep benchmark can measure an
+honest end-to-end serial-NumPy baseline (statics + mooring + dynamics per
+design) without any JAX machinery in the timed path, and it doubles as an
+independent f64 oracle for the JAX mooring solver (tests/test_mooring.py).
+
+Formulation identical to raft_tpu.mooring (elastic catenary, frictionless
+seabed, damped Newton in (log HF, VF)); the body stiffness is obtained by
+central finite differencing of the net line force like MoorPy does
+(MoorPy getCoupledStiffness is FD-based — SURVEY.md §2.2 row 1).
+"""
+
+import numpy as np
+
+
+def _profile_np(H, V, L, EA, w):
+    """Fairlead excursion (x, z) for tension components (H, V) — NumPy twin
+    of mooring._profile."""
+    W = w * L
+    VA = V - W
+    vh = V / H
+    vah = VA / H
+    if VA >= 0.0:  # fully suspended
+        x = H / w * (np.arcsinh(vh) - np.arcsinh(vah)) + H * L / EA
+        z = (
+            H / w * (np.sqrt(1 + vh**2) - np.sqrt(1 + vah**2))
+            + (V * L - 0.5 * w * L**2) / EA
+        )
+    else:  # seabed contact
+        LB = min(max(L - V / w, 0.0), L)
+        x = LB + H / w * np.arcsinh(vh) + H * L / EA
+        z = H / w * (np.sqrt(1 + vh**2) - 1.0) + V**2 / (2 * EA * w)
+    return x, z
+
+
+def catenary_solve_np(XF, ZF, L, EA, w, tol=1e-10, max_iter=60):
+    """Newton solve for one line's fairlead tensions (HF, VF)."""
+    XF = max(XF, 1e-6 * L)
+    d = np.hypot(XF, ZF)
+    slack = 3.0 * max((L**2 - ZF**2) / XF**2 - 1.0, 1e-8)
+    lam0 = 0.25 if L <= d else np.sqrt(slack)
+    H = max(abs(0.5 * w * XF / lam0), 10.0)
+    V = 0.5 * w * (ZF / np.tanh(lam0) + L)
+    W = w * L
+    scale = max(abs(XF), abs(ZF))
+    u = np.log(H)
+    for _ in range(max_iter):
+        H = np.exp(u)
+        x, z = _profile_np(H, V, L, EA, w)
+        r = np.array([x - XF, z - ZF])
+        if np.max(np.abs(r)) < tol * scale:
+            break
+        # Jacobian wrt (log H, V) by central differences of the profile
+        eps_u, eps_v = 1e-7, 1e-7 * (abs(V) + W)
+        xp, zp = _profile_np(np.exp(u + eps_u), V, L, EA, w)
+        xm, zm = _profile_np(np.exp(u - eps_u), V, L, EA, w)
+        J00, J10 = (xp - xm) / (2 * eps_u), (zp - zm) / (2 * eps_u)
+        xp, zp = _profile_np(H, V + eps_v, L, EA, w)
+        xm, zm = _profile_np(H, V - eps_v, L, EA, w)
+        J01, J11 = (xp - xm) / (2 * eps_v), (zp - zm) / (2 * eps_v)
+        det = J00 * J11 - J01 * J10
+        if abs(det) < 1e-30:
+            det = 1e-30
+        du = (J11 * r[0] - J01 * r[1]) / det
+        dv = (-J10 * r[0] + J00 * r[1]) / det
+        du = np.clip(du, -1.5, 1.5)
+        dv = np.clip(dv, -0.5 * (abs(V) + W), 0.5 * (abs(V) + W))
+        u -= du
+        V -= dv
+    return np.exp(u), V
+
+
+def _rotmat(r4, r5, r6):
+    c4, s4 = np.cos(r4), np.sin(r4)
+    c5, s5 = np.cos(r5), np.sin(r5)
+    c6, s6 = np.cos(r6), np.sin(r6)
+    Rx = np.array([[1, 0, 0], [0, c4, -s4], [0, s4, c4]])
+    Ry = np.array([[c5, 0, s5], [0, 1, 0], [-s5, 0, c5]])
+    Rz = np.array([[c6, -s6, 0], [s6, c6, 0], [0, 0, 1]])
+    return Rz @ Ry @ Rx
+
+
+def line_forces_np(r6, anchors, rFair, L, EA, w):
+    """Net 6-DOF mooring reaction at body pose r6 plus per-line (HF, VF) —
+    serial loop over lines."""
+    R = _rotmat(r6[3], r6[4], r6[5])
+    f6 = np.zeros(6)
+    HFs = np.zeros(len(L))
+    VFs = np.zeros(len(L))
+    for i in range(len(L)):
+        arm = R @ rFair[i]
+        p = r6[:3] + arm
+        dxy = p[:2] - anchors[i, :2]
+        XF = np.hypot(dxy[0], dxy[1])
+        ZF = p[2] - anchors[i, 2]
+        HF, VF = catenary_solve_np(XF, ZF, L[i], EA[i], w[i])
+        u = dxy / max(XF, 1e-9)
+        F3 = np.array([-HF * u[0], -HF * u[1], -VF])
+        f6[:3] += F3
+        f6[3:] += np.cross(arm, F3)
+        HFs[i], VFs[i] = HF, VF
+    return f6, HFs, VFs
+
+
+def line_tensions_np(r6, anchors, rFair, L, EA, w):
+    _, HF, VF = line_forces_np(r6, anchors, rFair, L, EA, w)
+    W = w * L
+    TB = np.hypot(HF, VF)
+    TA = np.where(VF >= W, np.hypot(HF, VF - W), HF)
+    return np.concatenate([TA, TB])
+
+
+def body_force_np(r6, m, v, rCG, rM, AWP, rho, g):
+    R = _rotmat(r6[3], r6[4], r6[5])
+    f6 = np.zeros(6)
+    aG = R @ np.asarray(rCG)
+    aB = R @ np.asarray(rM)
+    Fg = np.array([0.0, 0.0, -m * g])
+    Fb = np.array([0.0, 0.0, rho * v * g])
+    f6[:3] = Fg + Fb
+    f6[3:] = np.cross(aG, Fg) + np.cross(aB, Fb)
+    f6[2] -= rho * g * AWP * r6[2]
+    return f6
+
+
+def solve_equilibrium_np(
+    f6_ext, body_props, anchors, rFair, L, EA, w, rho=1025.0, g=9.81,
+    tol=1e-8, max_iter=40,
+):
+    """Damped-Newton rigid-body equilibrium (ms.solveEquilibrium3 twin)."""
+    m, v, rCG, rM, AWP = body_props
+
+    def total(r6):
+        f = line_forces_np(r6, anchors, rFair, L, EA, w)[0]
+        return f + body_force_np(r6, m, v, rCG, rM, AWP, rho, g) + f6_ext
+
+    r6 = np.zeros(6)
+    step_cap = np.array([10.0, 10.0, 10.0, 0.1, 0.1, 0.1])
+    h = np.array([1e-4, 1e-4, 1e-4, 1e-6, 1e-6, 1e-6])
+    for _ in range(max_iter):
+        F = total(r6)
+        J = np.zeros((6, 6))
+        for j in range(6):
+            e = np.zeros(6)
+            e[j] = h[j]
+            J[:, j] = (total(r6 + e) - total(r6 - e)) / (2 * h[j])
+        dx = np.linalg.solve(J, -F)
+        dx = np.clip(dx, -step_cap, step_cap)
+        r6 = r6 + dx
+        if np.max(np.abs(dx)) < tol:
+            break
+    return r6
+
+
+def coupled_stiffness_np(r6, anchors, rFair, L, EA, w):
+    """C = -d f6_lines / d r6 by central differences (MoorPy-style)."""
+    h = np.array([1e-4, 1e-4, 1e-4, 1e-6, 1e-6, 1e-6])
+    C = np.zeros((6, 6))
+    for j in range(6):
+        e = np.zeros(6)
+        e[j] = h[j]
+        fp = line_forces_np(r6 + e, anchors, rFair, L, EA, w)[0]
+        fm = line_forces_np(r6 - e, anchors, rFair, L, EA, w)[0]
+        C[:, j] = -(fp - fm) / (2 * h[j])
+    return C
+
+
+def tension_jacobian_np(r6, anchors, rFair, L, EA, w):
+    h = np.array([1e-4, 1e-4, 1e-4, 1e-6, 1e-6, 1e-6])
+    nL = len(L)
+    J = np.zeros((2 * nL, 6))
+    for j in range(6):
+        e = np.zeros(6)
+        e[j] = h[j]
+        tp = line_tensions_np(r6 + e, anchors, rFair, L, EA, w)
+        tm = line_tensions_np(r6 - e, anchors, rFair, L, EA, w)
+        J[:, j] = (tp - tm) / (2 * h[j])
+    return J
+
+
+def case_mooring_np(f6_ext, body_props, anchors, rFair, L, EA, w,
+                    rho=1025.0, g=9.81, yawstiff=0.0):
+    """Serial twin of mooring.case_mooring: equilibrium + linearization
+    (reference calcMooringAndOffsets, raft/raft_model.py:332-392)."""
+    r6 = solve_equilibrium_np(
+        f6_ext, body_props, anchors, rFair, L, EA, w, rho=rho, g=g
+    )
+    C = coupled_stiffness_np(r6, anchors, rFair, L, EA, w)
+    C[5, 5] += yawstiff
+    F = line_forces_np(r6, anchors, rFair, L, EA, w)[0]
+    T = line_tensions_np(r6, anchors, rFair, L, EA, w)
+    J = tension_jacobian_np(r6, anchors, rFair, L, EA, w)
+    return r6, C, F, T, J

@@ -137,3 +137,19 @@ def rao_solve_numpy(
         Xi_all[iCase] = Xi
 
     return Xi_all
+
+
+def added_mass_numpy(nodes, rho):
+    """Constant Morison added-mass matrix A[6,6] with a reference-style
+    per-node Python loop (raft/raft_fowt.py:541-545, :570-573) — the NumPy
+    baseline twin of raft_tpu.hydro.added_mass_morison."""
+    A = np.zeros((6, 6))
+    N = nodes.r.shape[0]
+    for n in range(N):
+        if nodes.strip_mask[n]:
+            Am = rho * nodes.v_side[n] * (
+                nodes.Ca_p1[n] * nodes.p1Mat[n]
+                + nodes.Ca_p2[n] * nodes.p2Mat[n]
+            ) + rho * nodes.v_end[n] * nodes.Ca_End[n] * nodes.qMat[n]
+            A += _translate_matrix_3to6(Am, nodes.r[n])
+    return A

@@ -1,0 +1,350 @@
+"""Fused draft x ballast design sweep — the whole 256-point parameter study
+in a handful of device dispatches.
+
+The reference's parameter sweep is a serial Python loop that rebuilds and
+re-analyzes a full model per design point (reference
+raft/parametersweep.py:56-100: nested loops, runRAFT per point, no
+batching).  The generic sharded driver in :mod:`raft_tpu.sweep` already
+vmaps the *dynamics* over designs, but it still pays host-side model
+construction per point, which dominates a 256-point sweep.
+
+This module exploits the sweep structure itself (BASELINE.json configs[3]:
+a draft x ballast study of VolturnUS-S):
+
+ - **geometry** only varies along the draft axis -> one strip-node bundle
+   per draft value (16 bundles for a 16x16 grid), not per design;
+ - **ballast density scaling is exactly linear in the statics**: every
+   mass/CG/stiffness entry is affine in rho_fill (verified to float
+   rounding), so two `compute_statics` evaluations per draft (fill scale 0
+   and 1) give every ballast point by linear combination — 32 statics
+   evaluations cover all 256 designs;
+ - **mooring**: all designs x cases solved in ONE vmapped f64 CPU call
+   (implicit-diff catenary, mooring.case_mooring_design_batch_fn);
+ - **dynamics**: all designs x cases x frequencies in ONE jitted TPU
+   dispatch — `lax.map` over draft groups (bounds live memory) around
+   `vmap` over (draft-in-group, ballast, case), with response statistics
+   reduced in-graph so only [nd, nc, 6] statistics come back over the
+   wire (the full Xi transfer is optional).
+
+Result: the sweep costs seconds where the serial loop costs minutes — the
+benchmark pairing this with the single-core NumPy baseline lives in
+bench_sweep.py at the repo root.
+"""
+
+import copy
+import dataclasses
+import time
+from functools import lru_cache
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.geometry import pack_nodes, process_members
+from raft_tpu.hydro import added_mass_morison
+from raft_tpu.io.schema import cases_as_dicts
+from raft_tpu.model import Model, make_case_dynamics
+from raft_tpu.mooring import case_mooring_design_batch_fn, parse_mooring
+from raft_tpu.statics import compute_statics
+from raft_tpu.sweep import pad_and_stack_nodes
+from raft_tpu.utils.placement import put_cpu
+
+_am_f64 = jax.jit(added_mass_morison)
+
+
+def scale_draft(design, s):
+    """Deep-copied design with every platform member's submerged endpoint
+    depths scaled by ``s`` (the draft axis of the sweep: keels move from
+    z to s*z, pontoons/heave plates track proportionally; above-water
+    geometry and mooring fairleads stay fixed, like the reference sweep's
+    draft loop, reference raft/parametersweep.py:71-76)."""
+    d = copy.deepcopy(design)
+    for mem in d["platform"]["members"]:
+        for key in ("rA", "rB"):
+            v = [float(x) for x in mem[key]]
+            if v[2] < 0.0:
+                v[2] = v[2] * float(s)
+            mem[key] = v
+    return d
+
+
+def _scale_fill(member, s):
+    """Member copy with ballast density scaled by ``s`` (shape-preserving)."""
+    rf = member.rho_fill
+    rf = rf * s if np.isscalar(rf) else np.asarray(rf) * s
+    return dataclasses.replace(member, rho_fill=rf)
+
+
+@dataclasses.dataclass
+class _DraftVariant:
+    """Host-side preprocessing of one draft value."""
+
+    nodes: object            # HydroNodes (f64)
+    moor: tuple              # mooring line arrays (numpy f64)
+    A_morison: np.ndarray    # [6, 6] f64
+    # statics at ballast scale 0 and 1 (everything else by linearity)
+    m0: float
+    m1: float
+    mCG0: np.ndarray         # mass * rCG at scale 0 [3]
+    mCG1: np.ndarray
+    M0: np.ndarray           # M_struc at scale 0 [6, 6]
+    M1: np.ndarray
+    C0: np.ndarray           # C_struc at scale 0 [6, 6]
+    C1: np.ndarray
+    C_hydro: np.ndarray      # [6, 6] (ballast-independent)
+    V: float
+    AWP: float
+    zMeta: float
+
+
+def _prepare_draft(base_design, s, rho_water, g):
+    d = scale_draft(base_design, s)
+    members = process_members(d)
+    nodes = pack_nodes(members)
+    turbine = d["turbine"]
+    S1 = compute_statics(members, turbine, rho_water, g)
+    S0 = compute_statics(
+        [_scale_fill(m, 0.0) for m in members], turbine, rho_water, g
+    )
+    ms = parse_mooring(d["mooring"], rho_water=rho_water, g=g)
+    moor = (ms.anchors, ms.rFair, ms.L, ms.EA, ms.w)
+    A = np.asarray(_am_f64(put_cpu(nodes.astype(np.float64)), rho_water))
+    return _DraftVariant(
+        nodes=nodes, moor=moor, A_morison=A,
+        m0=S0.mass, m1=S1.mass,
+        mCG0=S0.mass * S0.rCG_TOT, mCG1=S1.mass * S1.rCG_TOT,
+        M0=S0.M_struc, M1=S1.M_struc,
+        C0=S0.C_struc, C1=S1.C_struc,
+        C_hydro=S1.C_hydro, V=S1.V, AWP=S1.AWP, zMeta=S1.zMeta,
+    )
+
+
+def _ballast_combine(v, b):
+    """Statics for the full ballast axis of one draft variant by linear
+    combination (b : [nB] ballast density scales).
+
+    Returns dict of arrays with leading nB axis.
+    """
+    b = np.asarray(b, np.float64)
+    mass = v.m0 + b * (v.m1 - v.m0)                       # [nB]
+    mCG = v.mCG0[None] + b[:, None] * (v.mCG1 - v.mCG0)   # [nB, 3]
+    rCG = mCG / mass[:, None]
+    M_struc = v.M0[None] + b[:, None, None] * (v.M1 - v.M0)
+    C_struc = v.C0[None] + b[:, None, None] * (v.C1 - v.C0)
+    return dict(mass=mass, rCG=rCG, M_struc=M_struc, C_struc=C_struc)
+
+
+def _dynamics_pipeline(model0, return_xi):
+    """Jitted sweep dynamics for ``model0``'s configuration, cached so
+    repeated sweeps (and the benchmark's hot re-run) reuse one executable."""
+    return _dynamics_pipeline_cached(
+        model0.w.tobytes(), np.asarray(model0.k).tobytes(), model0.nw,
+        float(model0.depth), float(model0.rho_water), float(model0.g),
+        float(model0.XiStart), int(model0.nIter),
+        np.dtype(model0.dtype).name, np.dtype(model0.cdtype).name,
+        bool(return_xi),
+    )
+
+
+@lru_cache(maxsize=16)
+def _dynamics_pipeline_cached(w_bytes, k_bytes, nw, depth, rho, g,
+                              XiStart, nIter, dtype_name, cdtype_name,
+                              return_xi):
+    """Build the jitted sweep pipeline: lax.map over draft groups, vmap
+    over (draft-in-group, ballast, case)."""
+    dtype = np.dtype(dtype_name).type
+    cdtype = np.dtype(cdtype_name).type
+    w = np.frombuffer(w_bytes, np.float64, count=nw)
+    k = np.frombuffer(k_bytes, np.float64, count=nw)
+    dw = float(w[1] - w[0])
+    one_case = make_case_dynamics(
+        w, k, depth, rho, g, XiStart, nIter, dtype, cdtype,
+    )
+
+    def per_design(nodes, zeta, beta, C_case, M0):
+        M_lin = jnp.broadcast_to(M0[None], (nw, 6, 6))
+        B_lin = jnp.zeros((nw, 6, 6), dtype)
+        Fz = jnp.zeros((nw, 6), dtype)
+
+        def fn(z, b, C):
+            return one_case(nodes, z, b, C, M_lin, B_lin, Fz, Fz)
+
+        xr, xi, iters, conv = jax.vmap(fn)(zeta, beta, C_case)  # [nc, ...]
+        std = jnp.sqrt(jnp.sum(xr * xr + xi * xi, axis=-1) * dw)  # [nc, 6]
+        if return_xi:
+            return std, iters, conv, xr, xi
+        return std, iters, conv
+
+    # [gd, nB] design axes inside a group; nodes shared along ballast
+    per_draft = jax.vmap(per_design, in_axes=(None, None, None, 0, 0))
+    per_group = jax.vmap(per_draft, in_axes=(0, None, None, 0, 0))
+
+    def pipeline(nodes_g, zeta, beta, C_g, M0_g):
+        def step(xs):
+            nodes, C, M0 = xs
+            return per_group(nodes, zeta, beta, C, M0)
+
+        return jax.lax.map(step, (nodes_g, C_g, M0_g))
+
+    return jax.jit(pipeline)
+
+
+def run_draft_ballast_sweep(
+    base_design,
+    draft_scales,
+    ballast_scales,
+    precision=None,
+    draft_group=4,
+    return_xi=False,
+    verbose=True,
+):
+    """Run the fused draft x ballast sweep.
+
+    Parameters
+    ----------
+    base_design : dict
+        VolturnUS-S-style design (must have a cases table; aero enters only
+        through precomputed means, so for the benchmark configuration the
+        cases are wind-free like the headline RAO metric).
+    draft_scales : [nD] multipliers on submerged member depths.
+    ballast_scales : [nB] multipliers on ballast fill density.
+    draft_group : drafts per lax.map step (bounds device memory:
+        gd * nB * nc wave-kinematics lanes live at once).
+    return_xi : also return the full complex response amplitudes
+        [nD, nB, nc, 6, nw] (extra device->host transfer).
+
+    Returns dict with metrics [nD, nB, ...], timing breakdown, and the
+    mooring/statics intermediates the benchmark asserts against.
+    """
+    t_start = time.perf_counter()
+    model0 = Model(base_design, precision=precision)
+    nD, nB = len(draft_scales), len(ballast_scales)
+    nd = nD * nB
+    if nD % draft_group:
+        raise ValueError("len(draft_scales) must be divisible by draft_group")
+
+    spec, height, period, beta, wind = model0._case_arrays(
+        cases_as_dicts(base_design)
+    )
+    if np.any(wind > 0.0):
+        raise ValueError(
+            "fused sweep expects wind-free cases (aero means enter the "
+            "mooring stage as external loads; wire F_aero0 here when "
+            "sweeping wind cases)"
+        )
+    zeta = model0._zeta(spec, height, period)              # [nc, nw] f64
+    nc = zeta.shape[0]
+
+    # ---- host prep: one variant per draft, ballast by linearity ----
+    t0 = time.perf_counter()
+    variants = [
+        _prepare_draft(base_design, s, model0.rho_water, model0.g)
+        for s in draft_scales
+    ]
+    b = np.asarray(ballast_scales, np.float64)
+    comb = [_ballast_combine(v, b) for v in variants]
+    t_host = time.perf_counter() - t0
+
+    # ---- mooring: all designs x cases in one f64 CPU call ----
+    t0 = time.perf_counter()
+    moor_fn = case_mooring_design_batch_fn(
+        model0.rho_water, model0.g, model0.yawstiff
+    )
+    rep = lambda a: np.repeat(np.asarray(a, np.float64), nB, axis=0)  # noqa: E731
+    mass_all = np.concatenate([c["mass"] for c in comb])              # [nd]
+    rCG_all = np.concatenate([c["rCG"] for c in comb])                # [nd, 3]
+    V_all = rep([v.V for v in variants])
+    AWP_all = rep([v.AWP for v in variants])
+    rM_all = np.stack(
+        [np.array([0.0, 0.0, v.zMeta]) for v in variants for _ in range(nB)]
+    )
+    moor_all = tuple(
+        rep(np.stack([v.moor[i] for v in variants])) for i in range(5)
+    )
+    # wind-free cases all share zero mean load, so one equilibrium per
+    # design suffices; results broadcast across the case axis (the NumPy
+    # baseline in bench_sweep.py applies the same collapse, so the timed
+    # comparison stays symmetric)
+    F0 = np.zeros((nd, 1, 6))
+    out = moor_fn(*put_cpu((F0, mass_all, V_all, rCG_all, rM_all, AWP_all))
+                  , *put_cpu(moor_all))
+    bcast = lambda a: np.broadcast_to(  # noqa: E731
+        np.asarray(a), (a.shape[0], nc) + a.shape[2:]
+    ).copy()
+    r6, C_moor, F_moor, T_moor, J_moor = (bcast(np.asarray(o)) for o in out)
+    t_moor = time.perf_counter() - t0
+
+    # ---- dynamics: one jitted TPU dispatch ----
+    dtype = model0.dtype
+    G = nD // draft_group
+    nodes_all = pad_and_stack_nodes([v.nodes.astype(dtype) for v in variants])
+    shp = lambda a: a.reshape((G, draft_group) + a.shape[1:])  # noqa: E731
+    nodes_g = jax.tree.map(shp, nodes_all)
+    C_lin = (
+        np.stack([c["C_struc"] for c in comb])[:, :, None]
+        + np.stack([v.C_hydro for v in variants])[:, None, None]
+        + C_moor.reshape(nD, nB, nc, 6, 6)
+    )                                                          # [nD, nB, nc, 6, 6]
+    M0_all = (
+        np.stack([c["M_struc"] for c in comb])
+        + np.stack([v.A_morison for v in variants])[:, None]
+    )                                                          # [nD, nB, 6, 6]
+
+    pipeline = _dynamics_pipeline(model0, return_xi)
+    dev_args = (
+        jax.device_put(nodes_g),
+        jnp.asarray(zeta.astype(dtype)),
+        jnp.asarray(np.asarray(beta, dtype)),
+        jnp.asarray(shp(C_lin.astype(dtype))),
+        jnp.asarray(shp(M0_all.astype(dtype))),
+    )
+    t0 = time.perf_counter()
+    dyn = pipeline(*dev_args)
+    jax.block_until_ready(dyn)
+    t_dyn_first = time.perf_counter() - t0  # includes compile on first call
+    std = np.asarray(dyn[0], np.float64).reshape(nd, nc, 6)
+    iters = np.asarray(dyn[1]).reshape(nd, nc)
+    conv = np.asarray(dyn[2]).reshape(nd, nc)
+
+    # ---- metrics (reference parametersweep getOutputs semantics,
+    # reference raft/parametersweep.py:9-21) ----
+    offset = np.hypot(r6[:, 0, 0], r6[:, 0, 1])
+    pitch = np.rad2deg(r6[:, 0, 4])
+    res = {
+        "draft_scales": np.asarray(draft_scales, float),
+        "ballast_scales": b,
+        "mass": mass_all.reshape(nD, nB),
+        "displacement": (model0.rho_water * V_all).reshape(nD, nB),
+        "GMT": (rM_all[:, 2] - rCG_all[:, 2]).reshape(nD, nB),
+        "offset": offset.reshape(nD, nB),
+        "pitch_deg": pitch.reshape(nD, nB),
+        "surge_std": std[:, :, 0].reshape(nD, nB, nc),
+        "heave_std": std[:, :, 2].reshape(nD, nB, nc),
+        "pitch_std_deg": np.rad2deg(std[:, :, 4]).reshape(nD, nB, nc),
+        "std": std.reshape(nD, nB, nc, 6),
+        "converged": conv.reshape(nD, nB, nc),
+        "iters": iters.reshape(nD, nB, nc),
+        "Xi0": r6.reshape(nD, nB, nc, 6),
+        "T_moor": T_moor.reshape((nD, nB) + T_moor.shape[1:]),
+        "timing": {
+            "host_prep_s": t_host,
+            "mooring_s": t_moor,
+            "dynamics_first_s": t_dyn_first,
+            "total_s": time.perf_counter() - t_start,
+        },
+    }
+    if return_xi:
+        xr = np.asarray(dyn[3], np.float64).reshape(nd, nc, 6, model0.nw)
+        xi = np.asarray(dyn[4], np.float64).reshape(nd, nc, 6, model0.nw)
+        res["Xi"] = (xr + 1j * xi).reshape(nD, nB, nc, 6, model0.nw)
+    if verbose:
+        tm = res["timing"]
+        print(
+            f"fused sweep {nD}x{nB}: host {tm['host_prep_s']:.2f}s, "
+            f"mooring {tm['mooring_s']:.2f}s, dynamics(first) "
+            f"{tm['dynamics_first_s']:.2f}s, total {tm['total_s']:.2f}s"
+        )
+    return res
+
+

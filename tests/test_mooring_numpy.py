@@ -1,0 +1,65 @@
+"""mooring_numpy (serial baseline twin) vs the JAX mooring solver.
+
+The NumPy path is the performance baseline for the sweep benchmark and an
+independent f64 oracle: same catenary formulation, independently coded
+(FD Jacobians vs implicit autodiff), so agreement here cross-validates
+both implementations.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from raft_tpu.designs import demo_semi
+from raft_tpu.model import Model
+from raft_tpu.mooring import case_mooring
+from raft_tpu.mooring_numpy import case_mooring_np, catenary_solve_np
+
+
+def test_catenary_matches_jax():
+    from raft_tpu.mooring import catenary_solve
+
+    for XF, ZF, L, EA, w in [
+        (800.0, 186.0, 835.0, 7.5e8, 3000.0),   # taut-ish
+        (700.0, 186.0, 835.0, 7.5e8, 3000.0),   # seabed contact
+        (50.0, 300.0, 320.0, 5.0e8, 2000.0),    # steep
+    ]:
+        H_np, V_np = catenary_solve_np(XF, ZF, L, EA, w)
+        H_j, V_j = catenary_solve(
+            jnp.float64(XF), jnp.float64(ZF), jnp.float64(L),
+            jnp.float64(EA), jnp.float64(w),
+        )
+        assert float(H_j) == pytest.approx(H_np, rel=1e-7)
+        assert float(V_j) == pytest.approx(V_np, rel=1e-7)
+
+
+def test_case_mooring_matches_jax():
+    design = demo_semi()
+    design["settings"] = {"min_freq": 0.02, "max_freq": 0.2}
+    m = Model(design)
+    m.analyze_unloaded()
+    st = m.statics
+    props = (st.mass, st.V, st.rCG_TOT, np.array([0.0, 0.0, st.zMeta]), st.AWP)
+    ms = m.ms
+    f6 = np.array([5e5, 0.0, 0.0, 0.0, 2e6, 0.0])
+
+    r6_np, C_np, F_np, T_np, J_np = case_mooring_np(
+        f6, props, ms.anchors, ms.rFair, ms.L, ms.EA, ms.w,
+        rho=m.rho_water, g=m.g, yawstiff=m.yawstiff,
+    )
+    out = case_mooring(
+        jnp.asarray(f6), *[jnp.asarray(np.asarray(p, np.float64)) for p in props],
+        *m._moor_arrays, rho=m.rho_water, g=m.g, yawstiff=m.yawstiff,
+    )
+    r6_j, C_j, F_j, T_j, J_j = (np.asarray(o) for o in out)
+
+    np.testing.assert_allclose(r6_np, r6_j, rtol=1e-6, atol=1e-9)
+    np.testing.assert_allclose(F_np, F_j, rtol=1e-5, atol=1.0)
+    np.testing.assert_allclose(T_np, T_j, rtol=1e-6)
+    # FD stiffness vs exact autodiff: FD noise dominates small entries
+    scale = np.max(np.abs(C_j))
+    np.testing.assert_allclose(C_np, C_j, atol=2e-4 * scale)
+    np.testing.assert_allclose(
+        J_np, J_j, atol=2e-4 * np.max(np.abs(J_j))
+    )

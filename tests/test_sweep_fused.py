@@ -1,0 +1,104 @@
+"""Fused draft x ballast sweep: parity against the direct per-design Model
+path, and against the serial NumPy baseline twin (bench_sweep semantics)."""
+
+import copy
+
+import numpy as np
+import pytest
+
+import jax
+
+from raft_tpu.designs import demo_semi
+from raft_tpu.model import Model
+from raft_tpu.sweep_fused import (
+    run_draft_ballast_sweep,
+    scale_draft,
+)
+
+
+def _base_design(n_cases=3):
+    design = demo_semi()
+    design["settings"] = {
+        "min_freq": 0.02, "max_freq": 0.6, "XiStart": 0.1, "nIter": 15,
+    }
+    design["turbine"]["aeroServoMod"] = 0
+    keys = design["cases"]["keys"]
+    row = dict(zip(keys, design["cases"]["data"][0]))
+    rows = []
+    for i in range(n_cases):
+        r = dict(row)
+        r["wind_speed"] = 0.0
+        r["wave_spectrum"] = "JONSWAP"
+        r["wave_height"] = 3.0 + i
+        r["wave_period"] = 8.0 + i
+        rows.append([r[k] for k in keys])
+    design["cases"]["data"] = rows
+    return design
+
+
+def _apply_point(design, draft, ballast):
+    d = scale_draft(design, draft)
+    for mem in d["platform"]["members"]:
+        rf = mem.get("rho_fill")
+        if rf is None:
+            continue
+        if isinstance(rf, (list, tuple)):
+            mem["rho_fill"] = [float(x) * ballast for x in rf]
+        else:
+            mem["rho_fill"] = float(rf) * ballast
+    return d
+
+
+def test_fused_sweep_matches_direct_model():
+    """Every fused-sweep shortcut (ballast linearity, shared node bundles,
+    batched mooring, in-graph statistics) must reproduce the plain
+    Model-per-design path exactly."""
+    base = _base_design()
+    drafts = [0.9, 1.1]
+    ballasts = [0.5, 1.5]
+    res = run_draft_ballast_sweep(
+        base, drafts, ballasts, draft_group=1, return_xi=True, verbose=False,
+    )
+    assert res["converged"].all()
+
+    for (iD, iB) in [(0, 1), (1, 0)]:
+        d = _apply_point(base, drafts[iD], ballasts[iB])
+        m = Model(d)
+        m.analyze_unloaded()
+        args, aux = m.prepare_case_inputs(verbose=False)
+        out = jax.jit(m.case_pipeline_fn())(*(jax.numpy.asarray(a) for a in args))
+        Xi_direct = np.asarray(out[0], np.float64) + 1j * np.asarray(out[1], np.float64)
+
+        assert res["mass"][iD, iB] == pytest.approx(m.statics.mass, rel=1e-12)
+        assert res["GMT"][iD, iB] == pytest.approx(
+            m.statics.zMeta - m.statics.rCG_TOT[2], rel=1e-9
+        )
+        np.testing.assert_allclose(
+            res["Xi0"][iD, iB, 0], aux["Xi0"][0], rtol=1e-8, atol=1e-12
+        )
+        np.testing.assert_allclose(
+            np.abs(res["Xi"][iD, iB]), np.abs(Xi_direct), rtol=2e-5, atol=1e-7
+        )
+
+
+def test_fused_sweep_rejects_wind_cases():
+    base = _base_design()
+    keys = base["cases"]["keys"]
+    rows = [dict(zip(keys, r)) for r in base["cases"]["data"]]
+    rows[0]["wind_speed"] = 10.0
+    base["cases"]["data"] = [[r[k] for k in keys] for r in rows]
+    with pytest.raises(ValueError, match="wind-free"):
+        run_draft_ballast_sweep(base, [1.0], [1.0], draft_group=1, verbose=False)
+
+
+def test_scale_draft_only_touches_submerged_z():
+    base = _base_design()
+    d = scale_draft(base, 1.2)
+    for m0, m1 in zip(base["platform"]["members"], d["platform"]["members"]):
+        for key in ("rA", "rB"):
+            z0, z1 = float(m0[key][2]), float(m1[key][2])
+            if z0 < 0:
+                assert z1 == pytest.approx(1.2 * z0)
+            else:
+                assert z1 == z0
+            assert list(map(float, m0[key][:2])) == list(map(float, m1[key][:2]))
