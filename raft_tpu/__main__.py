@@ -18,6 +18,9 @@ def main(argv=None):
                    help="ballast trim mode (1=fill levels, 2=densities)")
     p.add_argument("--precision", choices=["float32", "float64"],
                    default=None, help="device working precision")
+    p.add_argument("--device", choices=["tpu", "cpu", "gpu"], default=None,
+                   help="backend for the batched case solve "
+                        "(default: JAX default backend)")
     p.add_argument("--bem", action="store_true",
                    help="run the native BEM solver on potMod members")
     args = p.parse_args(argv)
@@ -27,6 +30,7 @@ def main(argv=None):
     run_raft(
         args.design, plot=int(args.plot), ballast=args.ballast,
         precision=args.precision, run_native_bem=args.bem,
+        device=args.device,
     )
 
 

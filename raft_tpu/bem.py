@@ -163,7 +163,16 @@ def write_wamit_3(path, coeffs, rho=1025.0, g=9.81):
     """Write the `.3` excitation format (inverse of read_wamit_3)."""
     if coeffs.X is None:
         raise ValueError("coefficient set has no excitation data to write")
-    headings = np.atleast_1d(coeffs.headings)
+    if coeffs.headings is None:
+        if coeffs.X.ndim == 3 and coeffs.X.shape[1] == 1:
+            headings = np.array([0.0])
+        else:
+            raise ValueError(
+                "coefficient set has excitation data but no headings; "
+                "set coeffs.headings to the wave-heading array (deg)"
+            )
+    else:
+        headings = np.atleast_1d(coeffs.headings)
     with open(path, "w") as f:
         for iw, wi in enumerate(coeffs.w):
             T = 2.0 * np.pi / wi

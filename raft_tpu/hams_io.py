@@ -62,10 +62,15 @@ def write_control_file(mesh_dir, water_depth=50.0, inc_f_lim=1, i_f_type=3,
                        o_f_type=4, num_freqs=-100, min_freq=0.01,
                        d_freq=0.01, num_headings=1, min_heading=0.0,
                        d_heading=0.0, ref_center=(0.0, 0.0, 0.0),
-                       n_threads=4):
+                       n_threads=4, note=None):
     """Write ControlFile.in (frequency/heading schedule; negative
     Number_of_frequencies means an evenly spaced grid, HAMS convention —
-    the reference passes numFreqs=-nw, raft/raft_fowt.py:381-382)."""
+    the reference passes numFreqs=-nw, raft/raft_fowt.py:381-382).
+
+    ``note``, when given, is appended after the end-of-file marker (so
+    the fixed line layout an external HAMS parser expects is untouched) —
+    used to flag when the emitted Buoy.1/.3 deviate from this schedule
+    (e.g. mesh-resolution frequency clamping)."""
     path = os.path.join(mesh_dir, "ControlFile.in")
     with open(path, "w") as f:
         f.write("   --------------HAMS Control file---------------\n\n")
@@ -90,6 +95,8 @@ def write_control_file(mesh_dir, water_depth=50.0, inc_f_lim=1, i_f_type=3,
         f.write("    If_remove_irr_freq      0\n")
         f.write(f"    Number of threads       {n_threads}\n\n")
         f.write("    ----------End HAMS Control file---------------\n")
+        if note:
+            f.write(f"    NOTE: {note}\n")
     return path
 
 

@@ -42,7 +42,7 @@ from raft_tpu.mooring import (
     unloaded_mooring_fn,
 )
 from raft_tpu.statics import compute_statics, member_inertia
-from raft_tpu.utils.placement import put_cpu
+from raft_tpu.utils.placement import backend_sharding, put_cpu
 from raft_tpu.utils.profiling import timer
 from raft_tpu.utils.frames import (
     transform_force,
@@ -66,10 +66,18 @@ def _wave_numbers_cached(w_bytes, nw, depth, g):
     return k
 
 
-def _uniform_heading_grid(headings, resolution=1e-6):
+def _uniform_heading_grid(headings, resolution=1e-3, max_grid=73):
     """Smallest uniform grid (in degrees) containing every requested
     heading — the representation the HAMS control-file schedule can
-    describe (min/step/count).  {0, 30, 90} -> (0, 30, 60, 90)."""
+    describe (min/step/count).  {0, 30, 90} -> (0, 30, 60, 90).
+
+    Headings are snapped to ``resolution`` degrees first (float noise
+    like 22.500001 must not set the gcd step), and if the uniform grid
+    would still exceed ``max_grid`` entries (headings with a tiny common
+    step would otherwise multiply the diffraction RHS count without
+    bound), the exact requested set is returned instead — only the HAMS
+    control-file writer needs the min/step/count form, and it falls back
+    to a degenerate schedule for non-uniform sets."""
     import math
 
     hs = sorted({round(float(h) / resolution) for h in headings})
@@ -78,10 +86,10 @@ def _uniform_heading_grid(headings, resolution=1e-6):
     step = 0
     for d in np.diff(hs):
         step = math.gcd(step, int(d))
-    return tuple(
-        (hs[0] + i * step) * resolution
-        for i in range((hs[-1] - hs[0]) // step + 1)
-    )
+    n = (hs[-1] - hs[0]) // step + 1
+    if n > max_grid:
+        return tuple(h * resolution for h in hs)
+    return tuple((hs[0] + i * step) * resolution for i in range(n))
 
 
 def make_case_dynamics(w, k, depth, rho, g, XiStart, nIter, dtype, cdtype,
@@ -137,9 +145,16 @@ class Model:
     precision : 'float32' | 'float64' | None
         Working dtype of the device dynamics graph.  Default: f32 on TPU
         (no f64 solver support there), f64 elsewhere.
+    device : 'tpu' | 'cpu' | 'gpu' | None
+        Backend the batched case dynamics runs on (the north-star
+        ``device='tpu'`` switch).  None = JAX's default backend.  The
+        precision default follows the *selected* backend, so
+        ``Model(design, device='cpu')`` on a TPU host runs an f64 CPU
+        solve and ``device='tpu'`` runs the f32 TPU graph.  Host-side
+        stages (statics, mooring, rotor BEM) always run f64 on CPU.
     """
 
-    def __init__(self, design, nTurbines=1, precision=None):
+    def __init__(self, design, nTurbines=1, precision=None, device=None):
         if not isinstance(design, dict):
             design = load_design(design)
         self.design = design
@@ -189,9 +204,16 @@ class Model:
             rot_cfg["shearExp"] = site["shearExp"]
             self.rotor = Rotor(rot_cfg, self.w)
 
-        # precision policy
+        # device + precision policy
+        if device is not None:
+            device = str(device).lower()
+            self._sharding = backend_sharding(device)  # raises if absent
+        else:
+            self._sharding = None
+        self.device = device
+        backend = device or jax.default_backend()
         if precision is None:
-            precision = "float32" if jax.default_backend() == "tpu" else "float64"
+            precision = "float32" if backend == "tpu" else "float64"
         self.precision = precision
         self.dtype = np.float32 if precision == "float32" else np.float64
         self.cdtype = np.complex64 if precision == "float32" else np.complex128
@@ -247,7 +269,7 @@ class Model:
         return self.bem_coeffs
 
     def run_bem(self, headings=(0.0,), nw_bem=24, dz_max=None, da_max=None,
-                panels=None, quad="gauss"):
+                panels=None, quad="gauss", w_grid=None):
         """Run the NATIVE radiation/diffraction panel solver on all potMod
         members (the reference's calcBEM path, raft/raft_fowt.py:318-423,
         with the external Fortran HAMS subprocess replaced by the TPU-native
@@ -266,9 +288,12 @@ class Model:
             platform, "dz_BEM", default=3.0)
         da = da_max if da_max is not None else get_from_dict(
             platform, "da_BEM", default=2.0)
-        w_min = 2 * np.pi * get_from_dict(
-            platform, "min_freq_BEM", default=self.w[0] / 2 / np.pi)
-        w_bem = np.linspace(max(w_min, self.w[0]), self.w[-1], nw_bem)
+        if w_grid is not None:
+            w_bem = np.asarray(w_grid, float)
+        else:
+            w_min = 2 * np.pi * get_from_dict(
+                platform, "min_freq_BEM", default=self.w[0] / 2 / np.pi)
+            w_bem = np.linspace(max(w_min, self.w[0]), self.w[-1], nw_bem)
         self.bem_coeffs = coeffs_from_members(
             [m for m in self.members if m.potMod], w_bem,
             headings_deg=headings, rho=self.rho_water, g=self.g,
@@ -589,7 +614,16 @@ class Model:
             with timer("pipeline_compile"):
                 self._pipeline = self._build_pipeline()
         with timer("rao_solve"):
-            xr, xi, iters, conv = self._pipeline(*(jnp.asarray(a) for a in args))
+            if self._sharding is not None:
+                # committed inputs pin the jitted graph to the requested
+                # backend (jit follows input placement)
+                dev_args = tuple(
+                    jax.device_put(np.asarray(a), self._sharding)
+                    for a in args
+                )
+            else:
+                dev_args = tuple(jnp.asarray(a) for a in args)
+            xr, xi, iters, conv = self._pipeline(*dev_args)
             jax.block_until_ready(xr)
         Xi = np.asarray(xr, np.float64) + 1j * np.asarray(xi, np.float64)  # [case,6,nw]
         self.Xi = Xi
@@ -991,22 +1025,53 @@ class Model:
         if self.statics is None:
             self.analyze_unloaded()
         write_hydrostatic_file(mesh_dir, k_hydro=self.statics.C_hydro)
-        dw_hams = float(dw) if dw else get_from_dict(
-            platform, "dw_BEM", default=0.05)
-        w_max = max(float(wMax), float(self.w[-1]))
+        # solve, then write a control file describing the grid actually
+        # solved and emitted into Buoy.1/.3 (they used to advertise
+        # different schedules).  Default: the same run_bem grid the
+        # analyze_cases(runPyHAMS=True) path uses (min_freq_BEM-bounded
+        # nw_bem linspace) so adding meshDir never changes the physics;
+        # an explicit dw requests the reference's dw-spaced HAMS schedule
+        # (reference raft/raft_fowt.py:381-382).
+        if dw:
+            dw_hams = float(dw)
+            w_max = max(float(wMax), float(self.w[-1]))
+            n_sched = int(np.ceil(w_max / dw_hams))
+            w_sched = dw_hams * np.arange(1, n_sched + 1)
+            coeffs = self.run_bem(
+                headings=headings, dz_max=dz, da_max=da,
+                panels=panels, w_grid=w_sched,
+            )
+        else:
+            coeffs = self.run_bem(
+                headings=headings, nw_bem=nw_bem, dz_max=dz, da_max=da,
+                panels=panels,
+            )
+        wb = np.asarray(coeffs.w)
+        dwb = np.diff(wb)
+        note = None
+        if len(wb) > 1 and not np.allclose(dwb, dwb[0], rtol=1e-6):
+            # the solver clamped bins above the mesh-resolution cap, so
+            # the emitted grid is not uniform; the schedule below covers
+            # the uniform part and the note flags the deviation
+            note = (
+                f"frequencies above the mesh-resolution cap were clamped:"
+                f" Buoy.1/.3 contain {len(wb)} bins ending at"
+                f" {wb[-1]:.4f} rad/s"
+            )
+        dh = np.diff(np.asarray(headings, float))
+        if len(dh) > 1 and not np.allclose(dh, dh[0], atol=1e-9):
+            hnote = "heading set is non-uniform; see Buoy.3 for exact values"
+            note = f"{note}; {hnote}" if note else hnote
         write_control_file(
             mesh_dir, water_depth=self.depth,
-            num_freqs=-int(np.ceil(w_max / dw_hams)),
-            min_freq=dw_hams, d_freq=dw_hams,
+            num_freqs=-len(wb),
+            min_freq=float(wb[0]),
+            d_freq=float(dwb[0]) if len(wb) > 1 else 0.0,
             num_headings=len(headings),
             min_heading=float(headings[0]),
             d_heading=(float(headings[1] - headings[0])
                        if len(headings) > 1 else 0.0),
-        )
-
-        coeffs = self.run_bem(
-            headings=headings, nw_bem=nw_bem, dz_max=dz, da_max=da,
-            panels=panels,
+            note=note,
         )
         out = os.path.join(mesh_dir, "Output", "Wamit_format")
         write_wamit_1(os.path.join(out, "Buoy.1"), coeffs,

@@ -152,9 +152,11 @@ _RESPONSE_OUTPUTS = [
 class RAFT_OMDAO(_ComponentBase):
     """RAFT OpenMDAO wrapper (TPU-native backend).
 
-    Extra modeling option over the reference: ``device`` ('tpu'/'cpu' via
-    the Model ``precision`` policy) and ``run_native_BEM`` to use the
-    in-package panel solver where the reference shells out to HAMS.
+    Extra modeling options over the reference: ``device`` ('tpu' | 'cpu' |
+    'gpu' — selects the backend the batched case solve runs on, with the
+    precision default following that backend), ``precision``
+    ('float32' | 'float64'), and ``run_native_BEM`` to use the in-package
+    panel solver where the reference shells out to HAMS.
     """
 
     def initialize(self):
@@ -687,7 +689,11 @@ class RAFT_OMDAO(_ComponentBase):
                 pickle.dump(design, fh, protocol=pickle.HIGHEST_PROTOCOL)
             self.i_design += 1
 
-        model = Model(design, precision=modeling_opt.get("precision"))
+        model = Model(
+            design,
+            precision=modeling_opt.get("precision"),
+            device=modeling_opt.get("device"),
+        )
         model.analyze_unloaded(
             ballast=modeling_opt.get("trim_ballast", 0),
             heave_tol=modeling_opt.get("heave_tol", 1.0),

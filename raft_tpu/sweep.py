@@ -149,26 +149,38 @@ def _load_checkpoint(ck_path):
     """
     if ck_path is None:
         return None
-    if jax.process_count() == 1:
+
+    def _try_load():
+        # a checkpoint from an older (pre-atomic-write) run can be
+        # truncated; treat an unreadable file as absent
         if not os.path.exists(ck_path):
             return None
-        with np.load(ck_path, allow_pickle=False) as zf:
-            return {key: zf[key] for key in zf.files}
+        try:
+            with np.load(ck_path, allow_pickle=False) as zf:
+                return {key: zf[key] for key in zf.files}
+        except Exception:
+            return None
+
+    if jax.process_count() == 1:
+        return _try_load()
 
     from jax.experimental import multihost_utils
 
-    exists = os.path.exists(ck_path) if jax.process_index() == 0 else False
-    exists = bool(multihost_utils.broadcast_one_to_all(np.array(exists)))
-    if not exists:
+    data = _try_load() if jax.process_index() == 0 else None
+    ok = data is not None if jax.process_index() == 0 else False
+    ok = bool(multihost_utils.broadcast_one_to_all(np.array(ok)))
+    if not ok:
         return None
-    if not os.path.exists(ck_path):
+    if jax.process_index() == 0:
+        return data
+    data = _try_load()
+    if data is None:
         raise RuntimeError(
-            f"sweep checkpoint {ck_path} exists on process 0 but not on "
+            f"sweep checkpoint {ck_path} loads on process 0 but not on "
             f"process {jax.process_index()}: multi-host sweeps need "
             "out_dir on a shared filesystem"
         )
-    with np.load(ck_path, allow_pickle=False) as zf:
-        return {key: zf[key] for key in zf.files}
+    return data
 
 
 def _fetch(x):
@@ -291,8 +303,12 @@ def run_sweep(
 
         if ck_path and jax.process_index() == 0:
             # one writer in multi-process runs (every host holds the full
-            # allgathered results, so checkpoints stay restartable anywhere)
-            np.savez(ck_path, **res)
+            # allgathered results, so checkpoints stay restartable anywhere);
+            # write-then-rename so a crash mid-write never leaves a
+            # truncated chunk that would poison the restart
+            tmp_path = ck_path + ".tmp.npz"
+            np.savez(tmp_path, **res)
+            os.replace(tmp_path, ck_path)
         if verbose:
             print(f"sweep chunk {k}: solved {n_real} designs on {n_dev} devices")
         chunk_results.append(res)

@@ -21,3 +21,19 @@ def cpu_sharding():
 def put_cpu(x):
     """Commit array/pytree ``x`` to the host CPU backend (fast path)."""
     return jax.device_put(x, cpu_sharding())
+
+
+@lru_cache(maxsize=None)
+def backend_sharding(platform):
+    """SingleDeviceSharding for the first device of ``platform``
+    ('tpu' | 'cpu' | 'gpu'); raises with the available platforms listed
+    when the requested one is absent."""
+    try:
+        dev = jax.devices(platform)[0]
+    except RuntimeError as e:
+        avail = sorted({d.platform for d in jax.devices()})
+        raise RuntimeError(
+            f"device='{platform}' requested but no such backend is "
+            f"available (have: {avail})"
+        ) from e
+    return jax.sharding.SingleDeviceSharding(dev)

@@ -53,10 +53,11 @@ def test_derivatives_match_finite_differences(rotor):
     Om_rpm = np.interp(U, rotor.Uhub, rotor.Omega_rpm)
     pitch = np.interp(U, rotor.Uhub, rotor.pitch_deg)
 
-    import jax
     import jax.numpy as jnp
 
-    put = lambda x: jax.device_put(jnp.float64(x), rotor._cpu)
+    from raft_tpu.utils.placement import put_cpu
+
+    put = lambda x: put_cpu(jnp.float64(x))
     tilt = np.deg2rad(rotor.shaft_tilt)
 
     def TQ(U_, Om_radps, pitch_rad):

@@ -1,0 +1,58 @@
+"""The north-star ``device=`` switch (BASELINE.json: "device='tpu' switch
+on the OpenMDAO component"): Model(design, device=...) selects the backend
+the batched case solve runs on, RAFT_OMDAO forwards a ``device`` modeling
+option, and an unavailable backend fails with a clear error."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.designs import demo_semi
+from raft_tpu.model import Model
+
+
+def test_model_device_cpu_matches_default():
+    design = demo_semi(n_cases=1, nw_settings=(0.05, 0.5))
+    m_def = Model(design)
+    m_def.analyze_cases()
+    m_cpu = Model(design, device="cpu")
+    assert m_cpu.device == "cpu"
+    # on the CPU backend the precision default is f64
+    assert m_cpu.precision == "float64"
+    m_cpu.analyze_cases()
+    np.testing.assert_allclose(m_cpu.Xi, m_def.Xi, rtol=1e-10, atol=1e-12)
+    # the solve actually ran on the requested backend
+    assert m_cpu._sharding._device.platform == "cpu"
+
+
+def test_model_device_unavailable_raises():
+    design = demo_semi(n_cases=1, nw_settings=(0.05, 0.5))
+    with pytest.raises(RuntimeError, match="tpu"):
+        Model(design, device="tpu")  # tests force the CPU backend
+
+
+def test_device_precision_interaction():
+    design = demo_semi(n_cases=1, nw_settings=(0.05, 0.5))
+    m = Model(design, device="cpu", precision="float32")
+    assert m.precision == "float32"
+    assert m.dtype == np.float32
+
+
+def test_omdao_device_option_forwarded(monkeypatch):
+    import raft_tpu.model as model_mod
+    from tests.test_omdao import _build_component, _design, _set_inputs
+
+    captured = {}
+    real_model = model_mod.Model
+
+    class Spy(real_model):
+        def __init__(self, design, **kw):
+            captured.update(kw)
+            super().__init__(design, **kw)
+
+    monkeypatch.setattr(model_mod, "Model", Spy)
+    design = _design()
+    comp = _build_component(design)
+    comp.options["modeling_options"]["device"] = "cpu"
+    _set_inputs(comp, design)
+    comp.run()
+    assert captured.get("device") == "cpu"

@@ -62,6 +62,23 @@ def test_wamit3_roundtrip(tmp_path):
     np.testing.assert_allclose(X2, X, rtol=1e-5)
 
 
+def test_wamit3_headings_none(tmp_path):
+    # headings=None with a single excitation column defaults to 0 deg;
+    # with several columns it must raise a clear ValueError (ADVICE r1)
+    w = np.array([0.2, 0.5])
+    X1 = np.ones((2, 1, 6)) * (1 + 1j)
+    coeffs = HydroCoeffs(w=w, A=None, B=None, headings=None, X=X1)
+    p = str(tmp_path / "one.3")
+    write_wamit_3(p, coeffs)
+    _, h2, _ = read_wamit_3(p)
+    np.testing.assert_allclose(h2, [0.0])
+
+    X2 = np.ones((2, 3, 6)) * (1 + 1j)
+    bad = HydroCoeffs(w=w, A=None, B=None, headings=None, X=X2)
+    with pytest.raises(ValueError, match="headings"):
+        write_wamit_3(str(tmp_path / "bad.3"), bad)
+
+
 def test_preprocess_hams_end_to_end(tmp_path):
     from raft_tpu.designs import deep_spar
     from raft_tpu.model import Model

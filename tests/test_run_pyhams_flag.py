@@ -67,6 +67,16 @@ def test_uniform_heading_grid():
     np.testing.assert_allclose(
         _uniform_heading_grid([0.0, 22.5, 45.0]), [0.0, 22.5, 45.0]
     )
+    # float noise must not set the gcd step (22.500001 would otherwise
+    # expand to an enormous grid); snapped at millidegree resolution
+    np.testing.assert_allclose(
+        _uniform_heading_grid([0.0, 22.500000001, 45.0]), [0.0, 22.5, 45.0]
+    )
+    # a tiny common step falls back to the exact requested set instead of
+    # exploding the uniform grid (ADVICE round 1, medium)
+    out = _uniform_heading_grid([0.0, 17.3, 90.0])
+    np.testing.assert_allclose(out, [0.0, 17.3, 90.0])
+    assert len(_uniform_heading_grid([0.0, 0.001, 90.0])) == 3
 
 
 def test_runpyhams_noop_without_potmod_members():

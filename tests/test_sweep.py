@@ -95,6 +95,25 @@ def test_sweep_checkpoint_restart(sweep_results, monkeypatch):
     np.testing.assert_array_equal(res2["mass"], res["mass"])
 
 
+def test_sweep_truncated_checkpoint_recomputes(sweep_results):
+    base, points, out_dir, res = sweep_results
+    import glob
+    import os
+
+    # truncate the first chunk mid-file (as a crash mid-write would have
+    # left it before atomic os.replace); restart must recompute it, not
+    # crash inside np.load (ADVICE round 1)
+    ck = sorted(glob.glob(os.path.join(out_dir, "chunk_*.npz")))[0]
+    raw = open(ck, "rb").read()
+    with open(ck, "wb") as f:
+        f.write(raw[: len(raw) // 2])
+    res2 = run_sweep(base, points, _apply_point, out_dir=out_dir, verbose=False)
+    np.testing.assert_allclose(res2["mass"], res["mass"], rtol=1e-12)
+    # the recomputed chunk was re-checkpointed intact
+    with np.load(ck) as zf:
+        assert "Xi_r" in zf.files
+
+
 def test_pad_and_stack_nodes_inert_padding():
     base = demo_semi(n_cases=1)
     m1 = Model(base)
