@@ -263,7 +263,8 @@ def rotor_evaluate(Uinf, Omega, pitch, geom, polars, env, nSector=4):
     polars : (aoa_grid_deg, cl[n_span,naoa], cd, cm)
     env : dict with rho, mu
 
-    Returns dict with T, Q, P, CP, CT, CQ and per-azimuth distributed loads.
+    Returns dict with the hub loads T, Y, Z, Q, My, Mz, power P, and their
+    coefficients CT, CY, CZ, CQ, CMy, CMz, CP.
     """
     aoa_grid, cl_tab, cd_tab, _ = polars
     r = geom["r"]
@@ -300,8 +301,13 @@ def rotor_evaluate(Uinf, Omega, pitch, geom, polars, env, nSector=4):
 
     Np_all, Tp_all = jax.vmap(one_azimuth)(azimuths)   # [nSector, n_span]
 
-    # integrate to thrust/torque with zero-load extensions at hub and tip
-    # (CCBlade thrusttorque)
+    # integrate distributed loads to the full hub force/moment vector with
+    # zero-load extensions at hub and tip (CCBlade thrusttorque, extended
+    # to the 6 components CCBlade.evaluate reports: the azimuth-frame
+    # integrals are rotated into the hub frame per sector and averaged —
+    # shear/tilt/yaw make the sectors asymmetric, producing the side
+    # forces Y, Z and moments My, Mz the reference consumes into F_aero0,
+    # reference raft/raft_rotor.py:237-252, :350-351)
     rfull = jnp.concatenate(
         [jnp.array([geom["Rhub"]]), r, jnp.array([geom["Rtip"]])]
     )
@@ -309,27 +315,56 @@ def rotor_evaluate(Uinf, Omega, pitch, geom, polars, env, nSector=4):
     ps = geom["presweep"]
     pcfull = jnp.concatenate([pc[:1], pc, pc[-1:]])
     psfull = jnp.concatenate([ps[:1], ps, ps[-1:]])
-    _, _, z_az, cone, s = _define_curvature(rfull, pcfull, psfull, geom["precone"])
+    x_az, y_az, z_az, cone, s = _define_curvature(
+        rfull, pcfull, psfull, geom["precone"]
+    )
+    ccone, scone = jnp.cos(cone), jnp.sin(cone)
 
-    def integrate(loads):
-        lfull = jnp.concatenate([jnp.zeros(1), loads, jnp.zeros(1)])
-        thrust = jnp.trapezoid(lfull * jnp.cos(cone), s)
-        torque = jnp.trapezoid(lfull * z_az, s)
-        return thrust, torque
+    def hub_loads(Np, Tp, az):
+        Npf = jnp.concatenate([jnp.zeros(1), Np, jnp.zeros(1)])
+        Tpf = jnp.concatenate([jnp.zeros(1), Tp, jnp.zeros(1)])
+        # azimuth-frame integrals: x shared with the hub frame, z along
+        # the blade, tangential load along -y (blade motion direction;
+        # Vrot_y = +Omega z_az in _wind_components)
+        Fx = jnp.trapezoid(Npf * ccone, s)
+        Fy_a = -jnp.trapezoid(Tpf, s)
+        Fz_a = jnp.trapezoid(Npf * scone, s)
+        Q = jnp.trapezoid(Tpf * z_az, s)    # CCBlade's torque integral
+        My_a = jnp.trapezoid(Npf * (z_az * ccone - x_az * scone), s)
+        Mz_a = -jnp.trapezoid(Tpf * x_az + Npf * y_az * ccone, s)
+        # rotate azimuth frame -> hub frame (about the shared x axis;
+        # blade height = z_az cos(az) + y_az sin(az), _wind_components)
+        ca, sa = jnp.cos(az), jnp.sin(az)
+        return (
+            Fx,
+            ca * Fy_a - sa * Fz_a,
+            sa * Fy_a + ca * Fz_a,
+            Q,
+            ca * My_a - sa * Mz_a,
+            sa * My_a + ca * Mz_a,
+        )
 
-    T_az, Q_az = jax.vmap(lambda Np, Tp: (integrate(Np)[0], integrate(Tp)[1]))(
-        Np_all, Tp_all
+    T_az, Y_az, Z_az, Q_az, My_az, Mz_az = jax.vmap(hub_loads)(
+        Np_all, Tp_all, azimuths
     )
     T = B * jnp.mean(T_az)
+    Y = B * jnp.mean(Y_az)
+    Z = B * jnp.mean(Z_az)
     Q = B * jnp.mean(Q_az)
+    My = B * jnp.mean(My_az)
+    Mz = B * jnp.mean(Mz_az)
     P = Q * Omega
 
     q = 0.5 * env["rho"] * Uinf**2
     A = jnp.pi * geom["Rtip"] ** 2
     return {
         "T": T, "Q": Q, "P": P,
+        "Y": Y, "Z": Z, "My": My, "Mz": Mz,
         "CT": T / (q * A), "CQ": Q / (q * geom["Rtip"] * A),
         "CP": P / (q * Uinf * A),
+        "CY": Y / (q * A), "CZ": Z / (q * A),
+        "CMy": My / (q * geom["Rtip"] * A),
+        "CMz": Mz / (q * geom["Rtip"] * A),
     }
 
 
@@ -418,13 +453,15 @@ class Rotor:
                 g["yaw"] = yaw
                 out = rotor_evaluate(U, Om, pitch, g, polars, env)
                 return jnp.stack([out["T"], out["Q"], out["P"],
-                                  out["CP"], out["CT"], out["CQ"]])
+                                  out["CP"], out["CT"], out["CQ"],
+                                  out["Y"], out["Z"], out["My"],
+                                  out["Mz"]])
 
             def loads_and_derivs(U, Om, pitch, tilt, yaw):
                 vals = loads_TQ(U, Om, pitch, tilt, yaw)
                 JT = jax.jacfwd(lambda a: loads_TQ(*a, tilt, yaw))(
                     jnp.stack([U, Om, pitch])
-                )  # [6 outputs, 3 inputs]
+                )  # [10 outputs, 3 inputs]
                 return vals, JT
 
             self._eval = jax.jit(loads_and_derivs)
@@ -481,8 +518,7 @@ class Rotor:
 
         loads = dict(
             T=vals[0], Q=vals[1], P=vals[2], CP=vals[3], CT=vals[4], CQ=vals[5],
-            # side forces/moments not computed by this hub-loads model
-            Y=0.0, Z=0.0, My=0.0, Mz=0.0,
+            Y=vals[6], Z=vals[7], My=vals[8], Mz=vals[9],
         )
         derivs = dict(
             dT_dU=J[0, 0], dT_dOm=J[0, 1], dT_dPi=J[0, 2],

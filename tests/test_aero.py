@@ -105,6 +105,70 @@ def test_aero_servo_transfer_functions(rotor):
     assert np.abs(f2[0]) > np.abs(f2[-1])
 
 
+def test_side_loads_symmetry_and_shear(rotor):
+    """Hub side forces/moments (CCBlade's Y, Z, My, Mz, consumed into
+    F_aero0 at reference raft_rotor.py:350-351): symmetric inflow must
+    give ~zero side loads; shear+tilt makes the top of the disc work
+    harder, producing a positive hub pitching moment of the order of the
+    thrust asymmetry times the radius."""
+    import jax.numpy as jnp
+
+    from raft_tpu.aero import rotor_evaluate
+    from raft_tpu.utils.placement import put_cpu
+
+    U = 10.0
+    Om = np.interp(U, rotor.Uhub, rotor.Omega_rpm) * np.pi / 30.0
+    pitch = np.deg2rad(np.interp(U, rotor.Uhub, rotor.pitch_deg))
+
+    def eval_with(tilt, shear, nSector=8):
+        g = {k: (put_cpu(v) if isinstance(v, jnp.ndarray) else v)
+             for k, v in rotor.geom.items()}
+        g["tilt"] = float(tilt)
+        g["shearExp"] = float(shear)
+        polars = tuple(put_cpu(p) for p in rotor.polars)
+        out = rotor_evaluate(
+            put_cpu(jnp.float64(U)), put_cpu(jnp.float64(Om)),
+            put_cpu(jnp.float64(pitch)), g, polars, rotor.env,
+            nSector=nSector,
+        )
+        return {k: float(v) for k, v in out.items()}
+
+    # axisymmetric inflow: side loads vanish relative to the main loads
+    sym = eval_with(tilt=0.0, shear=0.0)
+    scale_F = abs(sym["T"])
+    scale_M = abs(sym["T"]) * rotor.R_rot
+    assert abs(sym["Y"]) < 1e-3 * scale_F
+    assert abs(sym["Z"]) < 1e-3 * scale_F
+    assert abs(sym["My"]) < 1e-3 * scale_M
+    assert abs(sym["Mz"]) < 1e-3 * scale_M
+
+    # shear alone: the top of the disc sees more wind -> positive hub
+    # pitching moment, well below the thrust-times-radius scale
+    sh = eval_with(tilt=0.0, shear=0.2)
+    assert sh["My"] > 0.0
+    assert 1e-4 * scale_M < abs(sh["My"]) < 0.2 * scale_M
+    # thrust barely changes (shear averages out to first order)
+    assert abs(sh["T"] - sym["T"]) < 0.05 * scale_F
+
+
+def test_side_loads_flow_into_F_aero0(rotor):
+    """run_bem now reports the side loads and
+    calc_aero_servo_contributions packs them into F_aero0 with the
+    reference's ordering [T, Y, Z, My, Q, Mz]
+    (reference raft_rotor.py:350-351)."""
+    loads, _ = rotor.run_bem(10.0)
+    # IEA-15MW has 6 deg shaft tilt + 0.12 shear: side loads are nonzero
+    assert loads["My"] != 0.0
+    assert abs(loads["My"]) < 0.3 * abs(loads["T"]) * rotor.R_rot
+    case = {"wind_speed": 10.0, "turbulence": "IB_NTM", "yaw_misalign": 0.0}
+    rotor.aeroServoMod = 1
+    F0, _, _, _ = rotor.calc_aero_servo_contributions(case)
+    np.testing.assert_allclose(
+        F0, [loads["T"], loads["Y"], loads["Z"], loads["My"], loads["Q"],
+             loads["Mz"]], rtol=1e-9,
+    )
+
+
 def test_kaimal_rotor_average_reduces_high_freq(rotor):
     from raft_tpu.wind import kaimal_rotor_spectrum
 
