@@ -125,7 +125,55 @@ def main():
     except Exception as exc:  # pragma: no cover - defensive for the driver
         out["sweep_error"] = f"{type(exc).__name__}: {exc}"
 
+    # ---- native BEM radiation/diffraction assembly+solve timing: the OC3
+    # spar mesh on the default backend (TPU here) vs CPU, warm numbers ----
+    try:
+        out.update(bench_bem())
+    except Exception as exc:  # pragma: no cover - defensive for the driver
+        out["bem_error"] = f"{type(exc).__name__}: {exc}"
+
     print(json.dumps(out))
+
+
+def bench_bem(nw=8):
+    import jax
+
+    from raft_tpu.bem_solver import solve_bem
+    from raft_tpu.designs import deep_spar
+    from raft_tpu.mesh import mesh_platform
+    from raft_tpu.model import Model
+
+    design = deep_spar(n_cases=1)
+    design["platform"]["members"][0]["potMod"] = True
+    m = Model(design)
+    # ~850 panels: above the TPU-vs-CPU crossover (~500 panels) while
+    # keeping the one-time compile ~20 s (cached persistently thereafter)
+    panels = mesh_platform(m.members, dz_max=2.5, da_max=2.5)
+    w = np.linspace(0.2, 1.2, nw)
+    backend = jax.default_backend()
+
+    def timed(bk):
+        solve_bem(panels, w, backend=bk)  # compile + warm
+        t0 = time.perf_counter()
+        out = solve_bem(panels, w, backend=bk)
+        return time.perf_counter() - t0, out
+
+    t_cpu, out_cpu = timed("cpu")
+    res = {
+        "bem_panels": len(panels),
+        "bem_nw": nw,
+        "bem_cpu_s": round(t_cpu, 3),
+        "bem_device_backend": backend,
+    }
+    if backend != "cpu":
+        t_dev, out_dev = timed(backend)
+        res["bem_device_s"] = round(t_dev, 3)
+        res["bem_device_vs_cpu"] = round(t_cpu / t_dev, 2)
+        res["bem_A_rel_err_device_vs_cpu"] = float(
+            np.abs(out_dev["A"] - out_cpu["A"]).max()
+            / np.abs(out_cpu["A"]).max()
+        )
+    return res
 
 
 if __name__ == "__main__":

@@ -32,6 +32,26 @@ from jax import config as _jax_config
 if not _os.environ.get("RAFT_TPU_NO_X64"):
     _jax_config.update("jax_enable_x64", True)
 
+# Persistent XLA compilation cache: TPU compiles of the case pipeline and the
+# BEM solver run tens of seconds to minutes; caching them on disk makes every
+# process after the first start warm (verified to work under the axon TPU
+# plugin).  Opt out with RAFT_TPU_NO_COMPILE_CACHE=1 or override the location
+# with RAFT_TPU_CACHE_DIR; an explicit user/env JAX cache config wins.
+if not _os.environ.get("RAFT_TPU_NO_COMPILE_CACHE"):
+    if _jax_config.jax_compilation_cache_dir is None and not _os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR"
+    ):
+        _cache = _os.environ.get("RAFT_TPU_CACHE_DIR") or _os.path.expanduser(
+            "~/.cache/raft_tpu_xla"
+        )
+        try:
+            _os.makedirs(_cache, exist_ok=True)
+            _jax_config.update("jax_compilation_cache_dir", _cache)
+            _jax_config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+            _jax_config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+        except OSError:  # read-only home: run without the on-disk cache
+            pass
+
 from raft_tpu.model import Model, run_raft  # noqa: E402,F401
 
 __version__ = "0.1.0"

@@ -196,8 +196,10 @@ def interp_to_grid(coeffs, w, beta=0.0):
     clamp to the nearest data (np.interp semantics).  NaNs raise, matching
     the reference's guards (raft_fowt.py:409-420).
 
-    beta : wave heading (deg) — the nearest heading in the data is used
-    (the reference supports only one heading; per-case selection here).
+    beta : wave heading (deg) — the excitation is linearly interpolated
+    between the two bracketing tabulated headings (clamped outside the
+    tabulated range; the reference supports only one heading,
+    per-case selection + interpolation are extensions here).
 
     Returns (A[nw,6,6], B[nw,6,6], X[nw,6] complex).
     """
@@ -224,10 +226,21 @@ def interp_to_grid(coeffs, w, beta=0.0):
             )
     X = np.zeros((nw, 6), complex)
     if coeffs.X is not None:
-        ih = int(np.argmin(np.abs(np.asarray(coeffs.headings) - beta)))
+        hs = np.asarray(coeffs.headings, float)
+        order = np.argsort(hs)
+        hs_s = hs[order]
+        if len(hs_s) == 1 or beta <= hs_s[0]:
+            Xh = coeffs.X[:, order[0], :]
+        elif beta >= hs_s[-1]:
+            Xh = coeffs.X[:, order[-1], :]
+        else:
+            j = int(np.searchsorted(hs_s, beta))
+            t = (beta - hs_s[j - 1]) / (hs_s[j] - hs_s[j - 1])
+            Xh = ((1.0 - t) * coeffs.X[:, order[j - 1], :]
+                  + t * coeffs.X[:, order[j], :])
         for i in range(6):
-            X[:, i] = np.interp(w, wB, coeffs.X[:, ih, i].real) + 1j * np.interp(
-                w, wB, coeffs.X[:, ih, i].imag
+            X[:, i] = np.interp(w, wB, Xh[:, i].real) + 1j * np.interp(
+                w, wB, Xh[:, i].imag
             )
     for name, arr in (("added mass", A), ("damping", B), ("excitation", X)):
         if np.isnan(arr).any():

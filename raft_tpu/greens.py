@@ -49,7 +49,7 @@ _TABLE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 A_MAX = 100.0
 NA = 1001
 B_MAX = 40.0
-Y_MIN, Y_MAX = np.log(1e-5), np.log(B_MAX)
+Y_MIN, Y_MAX = float(np.log(1e-5)), float(np.log(B_MAX))
 NY = 200
 
 
@@ -172,7 +172,10 @@ def interp_F_F1(a, b, F_tab, F1_tab):
     ia = jnp.clip(jnp.floor(ya).astype(jnp.int32), 0, NA - 2)
     fa = ya - ia
 
-    y = jnp.log(jnp.clip(-b, np.exp(Y_MIN), np.exp(Y_MAX)))
+    # Python-float bounds: np.exp returns a strong-typed f64 scalar that
+    # would silently promote the whole lookup (and the downstream solve)
+    # to f64 — which has no TPU lowering in the LU
+    y = jnp.log(jnp.clip(-b, float(np.exp(Y_MIN)), float(np.exp(Y_MAX))))
     yy = (y - Y_MIN) / (Y_MAX - Y_MIN) * (NY - 1)
     iy = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, NY - 2)
     fy = yy - iy

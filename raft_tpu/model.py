@@ -298,6 +298,7 @@ class Model:
             [m for m in self.members if m.potMod], w_bem,
             headings_deg=headings, rho=self.rho_water, g=self.g,
             dz_max=dz, da_max=da, panels=panels, quad=quad,
+            backend=self.device,
         )
         return self.bem_coeffs
 
@@ -579,10 +580,10 @@ class Model:
             if self.bem_coeffs is None:
                 # solve at every distinct case wave heading so off-axis
                 # cases get their own excitation column (interp_to_grid
-                # selects the nearest tabulated heading per case); the set
-                # is expanded to a uniform grid because the HAMS control
-                # file format (and preprocess_hams) describes headings as
-                # min/step/count
+                # interpolates between tabulated headings per case); the
+                # set is expanded to a uniform grid because the HAMS
+                # control file format (and preprocess_hams) describes
+                # headings as min/step/count
                 headings = _uniform_heading_grid(
                     float(c.get("wave_heading", 0.0))
                     for c in cases_as_dicts(self.design)

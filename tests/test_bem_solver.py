@@ -115,3 +115,21 @@ def test_model_run_bem_end_to_end():
     rao = results["response"]["surge RAO"]
     assert np.isfinite(rao).all()
     assert rao.max() > 0.1  # spar surge RAO approaches ~1 at low frequency
+
+
+def test_backend_param_and_panel_limit_fallback(caplog, monkeypatch):
+    """solve_bem(backend=...) places the solve on the requested backend;
+    meshes above TPU_PANEL_LIMIT fall back to CPU with a warning instead
+    of crashing the accelerator (observed v5e LU VMEM ceiling)."""
+    import logging
+
+    panels = spar_panels(12.0, 12.0)
+    out_default = bem_solver.solve_bem(panels, [0.5])
+    out_cpu = bem_solver.solve_bem(panels, [0.5], backend="cpu")
+    np.testing.assert_allclose(out_cpu["A"], out_default["A"], rtol=1e-6)
+
+    monkeypatch.setattr(bem_solver, "TPU_PANEL_LIMIT", 4)
+    with caplog.at_level(logging.WARNING, logger="raft_tpu"):
+        out_fb = bem_solver.solve_bem(panels, [0.5], backend="tpu")
+    assert "panel" in caplog.text and "CPU" in caplog.text
+    np.testing.assert_allclose(out_fb["A"], out_default["A"], rtol=1e-6)
