@@ -41,8 +41,18 @@ if not _os.environ.get("RAFT_TPU_NO_COMPILE_CACHE"):
     if _jax_config.jax_compilation_cache_dir is None and not _os.environ.get(
         "JAX_COMPILATION_CACHE_DIR"
     ):
+        # one cache dir per platform config: CPU executables AOT-compiled
+        # in a TPU-plugin process can carry machine features the plain
+        # CPU-only process doesn't accept (observed SIGILL warnings).
+        # Only a programmatic jax.config platform selection is trusted —
+        # the axon TPU plugin in this image ignores the JAX_PLATFORMS env
+        # var, so an env-only "cpu" process may still initialize the TPU
+        # backend and must not share the true-CPU cache dir.
+        _plat = (
+            getattr(_jax_config, "jax_platforms", None) or "default"
+        ).replace(",", "-")
         _cache = _os.environ.get("RAFT_TPU_CACHE_DIR") or _os.path.expanduser(
-            "~/.cache/raft_tpu_xla"
+            f"~/.cache/raft_tpu_xla_{_plat}"
         )
         try:
             _os.makedirs(_cache, exist_ok=True)

@@ -24,9 +24,11 @@ one-shot meshes (the graph specializes on the mesh shape; see solve_bem):
 Time convention matches the reference (e^{+i w t}; impedance
 Z = -w^2 M + i w B + C, reference raft/raft_model.py:585-590), so the wave
 term uses the conjugate (outgoing H0^(2)) branch of the tabulated kernel.
-Deep-water Green function (the reference's own BEM verification cases are
-deep-water spars; finite-depth strip-theory kinematics are handled exactly
-elsewhere, raft_tpu/waves.py).
+Finite water depth (the depth HAMS receives in its control file, reference
+raft/raft_fowt.py:367-381) is handled as deep water + John's finite-depth
+difference: a seabed-image Rankine term plus an exponentially-decaying
+pole-subtracted quadrature correction to the wave term
+(greens.finite_depth_correction) and the cosh-profile incident wave.
 """
 
 from dataclasses import dataclass
@@ -99,7 +101,7 @@ def panel_arrays(panels, quad="gauss"):
     return PanelArrays(cen=cen, nrm=nrm, area=area, qpts=qpts, qwts=qwts)
 
 
-def _rankine(pa, dtype=np.float64):
+def _rankine(pa, dtype=np.float64, depth=np.inf):
     """Frequency-independent Rankine + image influence matrices (host, once).
 
     S0[i,j] = int_j (1/r + 1/r') dS,   K0[i,j] = int_j d/dn_i (1/r + 1/r') dS
@@ -108,6 +110,11 @@ def _rankine(pa, dtype=np.float64):
     equivalent-disc closed form int 1/r dS = 2 sqrt(pi A), and the flat-panel
     self normal-gradient principal value is zero (the 1/2 jump term appears
     explicitly in the boundary condition).
+
+    At finite ``depth`` the seabed image 1/r2 (source mirrored across
+    z = -h) joins the static part — John's finite-depth Green function is
+    G = 1/r + 1/r2 + wave integral (the wave-term difference evaluated by
+    greens.finite_depth_correction cancels it again as nu*h grows).
     """
     x = pa.cen.astype(dtype)
     n = pa.nrm.astype(dtype)
@@ -115,25 +122,31 @@ def _rankine(pa, dtype=np.float64):
     w = pa.qwts.astype(dtype)
     N = pa.n
 
-    dx = x[:, None, None, :] - y[None, :, :, :]          # [N,N,Q,3]
-    r = np.sqrt(np.sum(dx * dx, axis=-1))
-    r = np.maximum(r, 1e-9)
-    S_r = np.sum(w[None] / r, axis=-1)
-    # d/dn_i (1/r) = -n_i . (x_i - y) / r^3
-    K_r = -np.sum(w[None] * np.einsum("ijqk,ik->ijq", dx, n) / r**3, axis=-1)
+    def img(yq):
+        dxi = x[:, None, None, :] - yq[None, :, :, :]     # [N,N,Q,3]
+        ri = np.maximum(np.sqrt(np.sum(dxi * dxi, axis=-1)), 1e-9)
+        S = np.sum(w[None] / ri, axis=-1)
+        K = -np.sum(
+            w[None] * np.einsum("ijqk,ik->ijq", dxi, n) / ri**3, axis=-1
+        )
+        return S, K
 
+    S_r, K_r = img(y)
     yi = y.copy()
     yi[:, :, 2] *= -1.0                                   # free-surface image
-    dxi = x[:, None, None, :] - yi[None, :, :, :]
-    ri = np.sqrt(np.sum(dxi * dxi, axis=-1))
-    ri = np.maximum(ri, 1e-9)
-    S_i = np.sum(w[None] / ri, axis=-1)
-    K_i = -np.sum(w[None] * np.einsum("ijqk,ik->ijq", dxi, n) / ri**3, axis=-1)
+    S_i, K_i = img(yi)
 
     idx = np.arange(N)
     S_r[idx, idx] = 2.0 * np.sqrt(np.pi * pa.area)
     K_r[idx, idx] = 0.0
-    return S_r + S_i, K_r + K_i
+    S0, K0 = S_r + S_i, K_r + K_i
+    if np.isfinite(depth):
+        yb = y.copy()
+        yb[:, :, 2] = -2.0 * depth - yb[:, :, 2]          # seabed image
+        S_b, K_b = img(yb)
+        S0 += S_b
+        K0 += K_b
+    return S0, K0
 
 
 def _radiation_normals(pa):
@@ -144,7 +157,7 @@ def _radiation_normals(pa):
 
 
 def _solve_all(omegas, betas, x, nrm, area, y, w_q, S0, K0, vmodes, Ft, F1t,
-               g, rho, real_block):
+               g, rho, real_block, depth, kmax_geom):
     """Device solve over all frequencies (jit target; see solve_bem).
 
     All inputs/outputs are real f32 (complex never crosses the host-device
@@ -176,9 +189,25 @@ def _solve_all(omegas, betas, x, nrm, area, y, w_q, S0, K0, vmodes, Ft, F1t,
     cosb = jnp.cos(betas)[:, None]                       # [nb,1]
     sinb = jnp.sin(betas)[:, None]
 
+    finite = bool(np.isfinite(depth))
+
     def one_omega(omega):
         nu = omega * omega / g
         Gw, dGw_dR, dGw_dz = greens.wave_term(nu, Rh, zz, Ft, F1t)
+        if finite:
+            # finite-depth wave-term difference (John's G minus the deep
+            # tabulated part; the seabed-image Rankine term is already in
+            # S0/K0 from _rankine)
+            k0 = greens.dispersion_k0(nu, depth)
+            dGc, dRc, dzc = greens.finite_depth_correction(
+                nu, k0, depth,
+                Rh, x[:, None, None, 2], y[None, :, :, 2], kmax_geom,
+            )
+            Gw = Gw + dGc
+            dGw_dR = dGw_dR + dRc
+            dGw_dz = dGw_dz + dzc
+        else:
+            k0 = nu
         # e^{+iwt} convention: conjugate branch (outgoing waves)
         Gw = jnp.conj(Gw)
         dGw_dR = jnp.conj(dGw_dR)
@@ -199,13 +228,26 @@ def _solve_all(omegas, betas, x, nrm, area, y, w_q, S0, K0, vmodes, Ft, F1t,
         # K'[1] = -1/2 fixes the jump sign; see tests/test_bem_solver.py)
         lhs = K / (4 * jnp.pi) - 0.5 * jnp.eye(N, dtype=c)
 
-        # radiation RHS (unit velocity) + diffraction RHS per heading
+        # radiation RHS (unit velocity) + diffraction RHS per heading;
+        # finite depth uses the cosh-profile incident wave at wavenumber k0
+        # (written in decaying exponentials; reduces to e^{nu z} as
+        # k0 h -> inf)
         kx = x[None, :, 0] * cosb + x[None, :, 1] * sinb          # [nb,N]
-        phiI = ((1j * g / omega) * jnp.exp(nu * x[None, :, 2])
-                * jnp.exp(-1j * nu * kx))
-        dphiIdn = (-1j * nu * cosb * phiI * nrm[None, :, 0]
-                   - 1j * nu * sinb * phiI * nrm[None, :, 1]
-                   + nu * phiI * nrm[None, :, 2])
+        if finite:
+            Eh = jnp.exp(-2.0 * k0 * depth)
+            e2z = jnp.exp(-2.0 * k0 * (x[None, :, 2] + depth))
+            amp = jnp.exp(k0 * x[None, :, 2]) / (1.0 + Eh)
+            phiI = ((1j * g / omega) * amp * (1.0 + e2z)
+                    * jnp.exp(-1j * k0 * kx))
+            phiIz = ((1j * g / omega) * k0 * amp * (1.0 - e2z)
+                     * jnp.exp(-1j * k0 * kx))
+        else:
+            phiI = ((1j * g / omega) * jnp.exp(nu * x[None, :, 2])
+                    * jnp.exp(-1j * nu * kx))
+            phiIz = nu * phiI
+        dphiIdn = (-1j * k0 * cosb * phiI * nrm[None, :, 0]
+                   - 1j * k0 * sinb * phiI * nrm[None, :, 1]
+                   + phiIz * nrm[None, :, 2])
 
         rhs = jnp.concatenate([vmodes.astype(c), -dphiIdn], axis=0)  # [6+nb,N]
         if real_block:
@@ -247,11 +289,15 @@ TPU_PANEL_LIMIT = 1500
 
 
 def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81,
-              quad="gauss", backend=None):
+              quad="gauss", backend=None, depth=np.inf):
     """Radiation + diffraction solve over frequencies.
 
     panels : [npan,4,3] wetted-hull panels (outward normals)
     omegas : [nw] rad/s;  betas : wave headings [rad]
+    depth : water depth [m] (np.inf = deep water).  Finite depth adds the
+        seabed-image Rankine term, the John wave-term correction
+        (greens.finite_depth_correction), and the cosh-profile incident
+        wave; it requires the hull to float clear of the seabed.
     backend : 'tpu' | 'cpu' | None — device the batched solve runs on.
         None = CPU: the solve specializes on the mesh shape, and a TPU
         compile of the [N,N,Q] assembly graph takes minutes per shape
@@ -267,6 +313,21 @@ def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81,
     global _solve_all_jit
 
     pa = panel_arrays(panels)        # 2x2 Gauss for the singular Rankine part
+    depth = float(depth)
+    # keel depth from panel VERTICES — centroids sit up to half a panel
+    # above the keel, which would under-estimate the decay-rate cutoff
+    # and let a near-bottom hull slip past the clearance guard
+    draft = float(-np.min(np.asarray(panels, float)[:, :, 2]))
+    if np.isfinite(depth):
+        if depth <= draft * 1.02:
+            raise ValueError(
+                f"solve_bem: water depth {depth} m does not clear the hull "
+                f"draft {draft} m (bottom-sitting structures are out of "
+                "scope for the finite-depth wave correction)"
+            )
+        kmax_geom = 15.0 / (depth - draft)
+    else:
+        kmax_geom = 0.0
     if backend == "tpu" and pa.n > TPU_PANEL_LIMIT:
         from raft_tpu.utils.profiling import logger
 
@@ -280,7 +341,7 @@ def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81,
     # the TPU LU lowering is real-only; CPU (and GPU) have complex LU,
     # which halves the solve flops and peak memory
     real_block = backend == "tpu"
-    S0, K0 = _rankine(pa)
+    S0, K0 = _rankine(pa, depth=depth)
     # the per-frequency wave term is smooth: "centroid" swaps only its
     # quadrature for a ~2.4x faster assembly loop
     pa_wave = pa if quad == "gauss" else panel_arrays(panels, quad=quad)
@@ -288,7 +349,9 @@ def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81,
     vmodes = _radiation_normals(pa)                     # [6, N]
 
     if _solve_all_jit is None:
-        _solve_all_jit = jax.jit(_solve_all, static_argnums=(12, 13, 14))
+        _solve_all_jit = jax.jit(
+            _solve_all, static_argnums=(12, 13, 14, 15, 16)
+        )
 
     from raft_tpu.utils.placement import backend_sharding
 
@@ -299,6 +362,7 @@ def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81,
         put(omegas), put(betas), put(pa.cen), put(pa.nrm), put(pa.area),
         put(pa_wave.qpts), put(pa_wave.qwts), put(S0), put(K0), put(vmodes),
         put(F_tab), put(F1_tab), float(g), float(rho), real_block,
+        depth, float(kmax_geom),
     )
     out = {
         "w": np.asarray(omegas, float),
@@ -320,7 +384,7 @@ def max_resolved_omega(panel_size, g=9.81, panels_per_wavelength=7.0):
 
 def coeffs_from_members(members, omegas, headings_deg=(0.0,), rho=1025.0,
                         g=9.81, dz_max=0.0, da_max=0.0, panels=None,
-                        quad="gauss", backend=None):
+                        quad="gauss", backend=None, depth=np.inf):
     """Mesh all potMod members, run the native solver, return a HydroCoeffs
     set (same container the WAMIT-file import path produces, so the Model
     pipeline is agnostic to where coefficients came from).
@@ -345,7 +409,7 @@ def coeffs_from_members(members, omegas, headings_deg=(0.0,), rho=1025.0,
     w_solve = np.unique(np.minimum(omegas, w_cap))
     betas = np.deg2rad(np.asarray(headings_deg, float))
     out = solve_bem(panels, w_solve, betas=betas, rho=rho, g=g, quad=quad,
-                    backend=backend)
+                    backend=backend, depth=depth)
     return HydroCoeffs(
         w=out["w"], A=out["A"], B=out["B"],
         headings=np.asarray(headings_deg, float), X=out["X"],

@@ -208,6 +208,161 @@ def interp_F_F1(a, b, F_tab, F1_tab):
     return F, F1
 
 
+def dispersion_k0(nu, h, iters=30):
+    """Finite-depth wavenumber k0 solving k tanh(kh) = nu — JAX, dtype
+    follows the input (the BEM graph is strictly f32 on TPU; waves.
+    wave_number canonicalizes to f64, which has no TPU lowering)."""
+    import jax
+    import jax.numpy as jnp
+
+    nu = jnp.asarray(nu)
+    k = jnp.maximum(nu, jnp.sqrt(nu / h))  # covers deep and shallow starts
+
+    def body(_, k):
+        t = jnp.tanh(jnp.clip(k * h, 1e-12, 50.0))
+        f = k * t - nu
+        df = t + k * h * (1.0 - t * t)
+        return jnp.maximum(k - f / df, nu)  # k0 >= nu always
+
+    return jax.lax.fori_loop(0, iters, body, k)
+
+
+# exact half-line remainder of the Gaussian pole subtraction with
+# sigma = a/3:  PV int_0^inf exp(-((k-a)/sigma)^2)/(k-a) dk = E1(9)/2
+_PV_TAIL = 6.158835e-06
+
+
+def finite_depth_correction(nu, k0, h, R, zi, zj, kmax_geom,
+                            n1=16, n2=32, n3=32):
+    """Finite-depth minus deep-water wave-term difference
+    Delta(Gw) = Gw_fd - Gw_deep and its R- and z-derivatives — JAX,
+    elementwise over pair arrays (R horizontal distance, zi collocation
+    z, zj source z; all <= 0), at wavenumber parameter nu = w^2/g and
+    water depth h.  The seabed-image Rankine term 1/r2 is NOT included
+    (the solver adds it with the static Rankine part).
+
+    Formulation (John's finite-depth Green function, Wehausen & Laitone
+    eq. 13.34, as used by the reference's external solver HAMS which
+    receives the depth at reference raft/raft_fowt.py:367-381):
+
+        Gw_fd = 2 PV int_0^inf f(k) J0(kR) dk + 2 pi i res(f, k0) J0(k0 R)
+        f(k)  = (k+nu) e^{-kh} cosh k(zi+h) cosh k(zj+h)
+                / (k sinh kh - nu cosh kh)
+
+    The difference kernel D(k) = 2[f(k) - f_deep(k)] (with
+    f_deep = (k+nu) e^{k(zi+zj)} / (2(k-nu)), whose integral generates
+    the free-surface image + deep wave term already tabulated) decays
+    like e^{-2k min(zi+h, zj+h, h)} — exponentially for a floating hull
+    above the seabed — so a short Gauss-Legendre quadrature with
+    analytic Gaussian pole subtraction at the two real poles nu and k0
+    evaluates it.  All exponentials are written in decaying form (no
+    cosh overflow).  Everything is real except the residue terms, which
+    are added analytically.
+
+    kmax_geom : static float — quadrature cutoff from the mesh geometry,
+        ~15 / (h - draft) (the slowest pair decay rate).
+    """
+    import jax.numpy as jnp
+
+    from raft_tpu.utils import bessel
+
+    dt = jnp.asarray(R).dtype
+    one = jnp.asarray(1.0, dt)
+
+    s = zi + zj                      # <= 0
+    e1f = lambda k: jnp.exp(-2.0 * k * (zi + h))     # noqa: E731
+    e2f = lambda k: jnp.exp(-2.0 * k * (zj + h))     # noqa: E731
+
+    def D_parts(k):
+        """Difference kernels (G, dR, dz) at scalar node k — real."""
+        E = jnp.exp(-2.0 * k * h)
+        e1 = e1f(k)
+        e2 = e2f(k)
+        den = (k - nu) - (k + nu) * E                # zero at k0
+        den = jnp.where(jnp.abs(den) > 1e-30, den, 1e-30)
+        knu = jnp.where(jnp.abs(k - nu) > 1e-30, k - nu, 1e-30)
+        eks = jnp.exp(k * s)
+        common = (k + nu) * eks / (den * knu)
+        DG = common * (knu * (e1 + e2 + e1 * e2) + (k + nu) * E)
+        Dz = k * common * (knu * (e2 - e1 - e1 * e2) + (k + nu) * E)
+        return DG, Dz
+
+    # ---- residues of the difference kernel at its two real poles ----
+    # at k0 the difference's residue equals the finite-depth kernel's
+    # (use (k0-nu) = (k0+nu)E0 to see it; this form stays stable as
+    # h -> inf where k0 -> nu and the two poles merge-and-cancel)
+    E0 = jnp.exp(-2.0 * k0 * h)
+    dden0 = 1.0 - E0 + 2.0 * h * (k0 + nu) * E0      # d(den)/dk at k0
+    e1_0, e2_0 = e1f(k0), e2f(k0)
+    ek0s = jnp.exp(k0 * s)
+    cG0 = (k0 + nu) * ek0s * (one + e1_0) * (one + e2_0) / dden0
+    cz0 = k0 * (k0 + nu) * ek0s * (one - e1_0) * (one + e2_0) / dden0
+    # residue of D at nu (deep-water pole of the subtracted kernel)
+    enus = jnp.exp(nu * s)
+    cG1 = -2.0 * nu * enus
+    cz1 = -2.0 * nu * nu * enus
+
+    # Bessel factors at the poles
+    J0k0, J1k0 = bessel.j0(k0 * R), bessel.j1(k0 * R)
+    J0nu, J1nu = bessel.j0(nu * R), bessel.j1(nu * R)
+
+    # ---- quadrature panels: [0, 2nu], [2nu, 4k0], [4k0, kmax] ----
+    x1, w1 = np.polynomial.legendre.leggauss(n1)
+    x2, w2 = np.polynomial.legendre.leggauss(n2)
+    x3, w3 = np.polynomial.legendre.leggauss(n3)
+    kmax = jnp.maximum(8.0 * k0, jnp.asarray(kmax_geom, dt))
+
+    def panel(a, b, x, w):
+        kk = 0.5 * (b - a) * (jnp.asarray(x, dt) + 1.0) + a
+        ww = 0.5 * (b - a) * jnp.asarray(w, dt)
+        return kk, ww
+
+    ka, wa = panel(jnp.asarray(0.0, dt), 2.0 * nu, x1, w1)
+    kb, wb = panel(2.0 * nu, 4.0 * k0, x2, w2)
+    kc, wc = panel(4.0 * k0, kmax, x3, w3)
+    knodes = jnp.concatenate([ka, kb, kc])
+    wnodes = jnp.concatenate([wa, wb, wc])
+
+    sig0 = k0 / 3.0
+    sig1 = nu / 3.0
+
+    def accum(carry, kw):
+        k, w = kw
+        DG, Dz = D_parts(k)
+        J0 = bessel.j0(k * R)
+        J1 = bessel.j1(k * R)
+        # Gaussian pole subtractions (exact tails added back below)
+        g0 = jnp.exp(-(((k - k0) / sig0) ** 2)) / (k - k0 + 1e-30)
+        g1 = jnp.exp(-(((k - nu) / sig1) ** 2)) / (k - nu + 1e-30)
+        iG = DG * J0 - cG0 * J0k0 * g0 - cG1 * J0nu * g1
+        iR = (DG * (-k * J1)
+              - cG0 * (-k0 * J1k0) * g0 - cG1 * (-nu * J1nu) * g1)
+        iz = Dz * J0 - cz0 * J0k0 * g0 - cz1 * J0nu * g1
+        aG, aR, az = carry
+        return (aG + w * iG, aR + w * iR, az + w * iz), None
+
+    import jax
+
+    zero = jnp.zeros_like(R + s)
+    (aG, aR, az), _ = jax.lax.scan(
+        accum, (zero, zero, zero),
+        (knodes, wnodes),
+    )
+    # exact half-line remainders of the Gaussian subtractions
+    tail = jnp.asarray(_PV_TAIL, dt)
+    aG = aG + tail * (cG0 * J0k0 + cG1 * J0nu)
+    aR = aR + tail * (cG0 * (-k0 * J1k0) + cG1 * (-nu * J1nu))
+    az = az + tail * (cz0 * J0k0 + cz1 * J0nu)
+
+    # ---- imaginary parts: pi * [res(2 f_fd, k0) J(k0) - res_deep J(nu)]
+    # (res(2 f_fd, k0) == cG0/cz0; res_deep == -cG1/-cz1)
+    pi = jnp.pi
+    dG = aG + 1j * pi * (cG0 * J0k0 + cG1 * J0nu)
+    dR_ = aR + 1j * pi * (cG0 * (-k0 * J1k0) + cG1 * (-nu * J1nu))
+    dz_ = az + 1j * pi * (cz0 * J0k0 + cz1 * J0nu)
+    return dG, dR_, dz_
+
+
 def wave_term(nu, R, zz, F_tab, F1_tab):
     """Gw and its R- and z-derivatives at wavenumber nu (= omega^2/g).
 

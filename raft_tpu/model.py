@@ -298,7 +298,7 @@ class Model:
             [m for m in self.members if m.potMod], w_bem,
             headings_deg=headings, rho=self.rho_water, g=self.g,
             dz_max=dz, da_max=da, panels=panels, quad=quad,
-            backend=self.device,
+            backend=self.device, depth=self.depth,
         )
         return self.bem_coeffs
 

@@ -90,3 +90,70 @@ def test_wave_term_derivative_consistency():
                        np.asarray(dR), rtol=0.05, atol=2e-3)
     assert np.allclose((np.asarray(Gwz1) - np.asarray(Gwz0)) / (2 * h),
                        np.asarray(dz), rtol=0.05, atol=2e-3)
+
+
+def test_finite_depth_correction_vs_quadrature():
+    """Delta(Gw) = Gw_fd - Gw_deep from the pole-subtracted quadrature vs
+    brute-force SciPy PV integration of the difference kernel (OC3 site:
+    nu*h ~ 0.33, where finite depth matters most), plus derivative
+    consistency and the deep limit Delta(Gw) -> -1/r2 (the correction
+    must cancel the frequency-independent seabed image as nu*h grows)."""
+    import jax.numpy as jnp
+    from scipy.integrate import quad
+    from scipy.special import j0 as J0_s
+
+    nu, h = 0.00102, 320.0
+    k0 = float(greens.dispersion_k0(jnp.float64(nu), h))
+    assert abs(k0 * np.tanh(k0 * h) - nu) < 1e-12
+
+    def D(k, zi, zj):
+        s = zi + zj
+        E = np.exp(-2 * k * h)
+        e1 = np.exp(-2 * k * (zi + h))
+        e2 = np.exp(-2 * k * (zj + h))
+        den = (k - nu) - (k + nu) * E
+        return ((k + nu) * np.exp(k * s)
+                * ((k - nu) * (e1 + e2 + e1 * e2) + (k + nu) * E)
+                / (den * (k - nu)))
+
+    def brute(R, zi, zj):
+        m = 0.5 * (nu + k0)
+        M = 50.0 / (h - 120.0) + 8 * k0
+        I1, _ = quad(lambda k: D(k, zi, zj) * J0_s(k * R) * (k - nu),
+                     0, m, weight="cauchy", wvar=nu, limit=400)
+        I2, _ = quad(lambda k: D(k, zi, zj) * J0_s(k * R) * (k - k0),
+                     m, M, weight="cauchy", wvar=k0, limit=400)
+        I3, _ = quad(lambda k: D(k, zi, zj) * J0_s(k * R), M, 10 * M,
+                     limit=400)
+        return I1 + I2 + I3
+
+    kmax_geom = 15.0 / (h - 120.0)
+    fd = lambda R, zi, zj: greens.finite_depth_correction(  # noqa: E731
+        jnp.float64(nu), jnp.float64(k0), h,
+        jnp.float64(R), jnp.float64(zi), jnp.float64(zj), kmax_geom)
+
+    for R, zi, zj in [(30.0, -5.0, -40.0), (80.0, -60.0, -100.0),
+                      (5.0, -1.0, -2.0)]:
+        G, dR, dz = fd(R, zi, zj)
+        ref = brute(R, zi, zj)
+        assert abs(float(np.real(G)) - ref) / abs(ref) < 1e-5
+
+    # derivatives vs central differences
+    R, zi, zj = 30.0, -5.0, -40.0
+    G, dR, dz = fd(R, zi, zj)
+    step = 0.05
+    fdR = (complex(fd(R + step, zi, zj)[0])
+           - complex(fd(R - step, zi, zj)[0])) / (2 * step)
+    fdz = (complex(fd(R, zi + step, zj)[0])
+           - complex(fd(R, zi - step, zj)[0])) / (2 * step)
+    assert abs(complex(dR) - fdR) / abs(fdR) < 1e-4
+    assert abs(complex(dz) - fdz) / abs(fdz) < 1e-4
+
+    # deep limit: correction -> -1/r2 (seabed-image cancellation)
+    nu_hi = 20.0 / h
+    k0_hi = float(greens.dispersion_k0(jnp.float64(nu_hi), h))
+    G_hi, _, _ = greens.finite_depth_correction(
+        jnp.float64(nu_hi), jnp.float64(k0_hi), h,
+        jnp.float64(30.0), jnp.float64(-5.0), jnp.float64(-40.0), kmax_geom)
+    r2 = np.sqrt(30.0**2 + ((-5.0) + (-40.0) + 2 * h) ** 2)
+    assert abs(complex(G_hi) + 1.0 / r2) < 0.02 / r2
