@@ -157,7 +157,7 @@ def _radiation_normals(pa):
 
 
 def _solve_all(omegas, betas, x, nrm, area, y, w_q, S0, K0, vmodes, Ft, F1t,
-               g, rho, real_block, depth, kmax_geom):
+               g, rho, real_block, depth, kmax_geom, finite):
     """Device solve over all frequencies (jit target; see solve_bem).
 
     All inputs/outputs are real f32 (complex never crosses the host-device
@@ -189,8 +189,9 @@ def _solve_all(omegas, betas, x, nrm, area, y, w_q, S0, K0, vmodes, Ft, F1t,
     cosb = jnp.cos(betas)[:, None]                       # [nb,1]
     sinb = jnp.sin(betas)[:, None]
 
-    finite = bool(np.isfinite(depth))
-
+    # `finite` is the only static piece of the depth handling — depth and
+    # kmax_geom stay traced operands so a draft/depth sweep at a fixed
+    # mesh shape reuses one compiled executable
     def one_omega(omega):
         nu = omega * omega / g
         Gw, dGw_dR, dGw_dz = greens.wave_term(nu, Rh, zz, Ft, F1t)
@@ -350,7 +351,7 @@ def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81,
 
     if _solve_all_jit is None:
         _solve_all_jit = jax.jit(
-            _solve_all, static_argnums=(12, 13, 14, 15, 16)
+            _solve_all, static_argnums=(12, 13, 14, 17)
         )
 
     from raft_tpu.utils.placement import backend_sharding
@@ -362,7 +363,8 @@ def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81,
         put(omegas), put(betas), put(pa.cen), put(pa.nrm), put(pa.area),
         put(pa_wave.qpts), put(pa_wave.qwts), put(S0), put(K0), put(vmodes),
         put(F_tab), put(F1_tab), float(g), float(rho), real_block,
-        depth, float(kmax_geom),
+        put(depth if np.isfinite(depth) else 0.0), put(kmax_geom),
+        bool(np.isfinite(depth)),
     )
     out = {
         "w": np.asarray(omegas, float),

@@ -1079,9 +1079,57 @@ class Model:
                       rho=self.rho_water)
         write_wamit_3(os.path.join(out, "Buoy.3"), coeffs,
                       rho=self.rho_water, g=self.g)
+        from raft_tpu.bem import write_wamit_hst
+
+        write_wamit_hst(os.path.join(out, "Buoy.hst"),
+                        self.statics.C_hydro, rho=self.rho_water, g=self.g)
         return mesh_dir
 
     preprocess_HAMS = preprocess_hams
+
+    def adjust_wisdem(self, old_wisdem_file, new_wisdem_file):
+        """Write a copy of a WISDEM geometry YAML with each floating-member
+        ballast volume updated from this model's trimmed fill levels
+        (reference raft/raft_model.py:1040-1090 adjustWISDEM; the WEIS
+        ballast handoff after adjust_ballast).
+
+        Members are matched like the reference: same bottom-joint z (to 5
+        printed characters) and same first outer diameter; only the first
+        ballast entry's volume is updated, assuming a constant diameter
+        over the fill (the reference's stated assumption)."""
+        import yaml as _yaml
+
+        with open(old_wisdem_file, "r", encoding="utf-8") as f:
+            wisdem_design = _yaml.safe_load(f)
+
+        platform = wisdem_design["components"]["floating_platform"]
+        joints = {j["name"]: j for j in platform["joints"]}
+        for wmem in platform["members"]:
+            if "ballasts" not in wmem.get("internal_structure", {}):
+                continue
+            joint = joints.get(wmem.get("joint1"))
+            if joint is None:
+                continue
+            wd0 = float(np.atleast_1d(
+                wmem["outer_shape"]["outer_diameter"]["values"])[0])
+            for mem in self.members:
+                d0 = float(np.atleast_1d(mem.d)[0])
+                if (str(joint["location"][2])[0:5]
+                        == str(float(mem.rA[2]))[0:5] and wd0 == d0):
+                    t0 = float(np.atleast_1d(mem.t)[0])
+                    area = np.pi * ((d0 - 2 * t0) / 2) ** 2
+                    lf0 = float(np.atleast_1d(mem.l_fill)[0])
+                    wmem["internal_structure"]["ballasts"][0]["volume"] = (
+                        float(area * lf0)
+                    )
+                    break
+
+        with open(new_wisdem_file, "w", encoding="utf-8") as f:
+            _yaml.safe_dump(wisdem_design, f, default_flow_style=None,
+                            sort_keys=False, allow_unicode=False)
+        return wisdem_design
+
+    adjustWISDEM = adjust_wisdem
 
     # ------------------------------------------------------------------
     # plotting (host-side, optional; raft_tpu/viz.py)

@@ -32,13 +32,17 @@ from raft_tpu.utils.frames import rotation_matrix, translate_force_3to6
 
 @dataclass
 class MooringSystem:
-    """Static description of a body-coupled mooring system (arrays over lines)."""
+    """Static description of a body-coupled mooring system (arrays over
+    composite anchor-to-fairlead lines; segment axis padded to the longest
+    chain with inert entries L=0, EA=1, w=1, Wp=0)."""
 
     anchors: np.ndarray   # [nL, 3] fixed anchor positions
     rFair: np.ndarray     # [nL, 3] fairlead positions relative to the body
-    L: np.ndarray         # [nL] unstretched lengths
-    EA: np.ndarray        # [nL] axial stiffness
-    w: np.ndarray         # [nL] submerged weight per length (N/m)
+    L: np.ndarray         # [nL, S] unstretched segment lengths (anchor->fair)
+    EA: np.ndarray        # [nL, S] axial stiffnesses
+    w: np.ndarray         # [nL, S] submerged weights per length (N/m)
+    Wp: np.ndarray        # [nL, S] clump weight at the TOP of each segment
+    #                       (N; junction point mass - buoyancy; top row 0)
     depth: float
     names: list
 
@@ -56,7 +60,7 @@ class MooringSystem:
         placement to the caller (e.g. inside a jitted pipeline).
         """
         np_dtype = np.dtype(dtype.dtype if hasattr(dtype, "dtype") else dtype)
-        src = (self.anchors, self.rFair, self.L, self.EA, self.w)
+        src = (self.anchors, self.rFair, self.L, self.EA, self.w, self.Wp)
         if device == "cpu":
             from raft_tpu.utils.placement import put_cpu
 
@@ -68,40 +72,92 @@ class MooringSystem:
 
 def parse_mooring(mooring, rho_water=1025.0, g=9.81):
     """Build a MooringSystem from the design dict's ``mooring`` section
-    (schema per reference designs/*.yaml: points/lines/line_types)."""
+    (schema per reference designs/*.yaml: points/lines/line_types).
+
+    Lines chained through ``free`` intermediate points (the industry
+    chain-rope-chain pattern; MoorPy capability surface, SURVEY.md §2.2)
+    are composed into one composite anchor-to-fairlead line; a free
+    point's optional ``mass``/``volume`` become a clump weight at the
+    junction.  Free points must join exactly two lines (bridles are out
+    of scope)."""
     types = {lt["name"]: lt for lt in mooring["line_types"]}
     points = {p["name"]: p for p in mooring["points"]}
 
-    anchors, rFair, Ls, EAs, ws, names = [], [], [], [], [], []
-    for ln in mooring["lines"]:
-        pA = points[ln["endA"]]
-        pB = points[ln["endB"]]
-        # identify which end is the fixed anchor and which rides the body
-        if pA["type"] == "fixed" and pB["type"] == "vessel":
-            anchor, vessel = pA, pB
-        elif pB["type"] == "fixed" and pA["type"] == "vessel":
-            anchor, vessel = pB, pA
-        else:
-            raise ValueError(
-                f"Line '{ln.get('name','?')}' must connect a fixed point to a "
-                f"vessel point (free intermediate points are not supported yet)"
-            )
+    attach = {}          # point name -> [(line index, other point name)]
+    for i, ln in enumerate(mooring["lines"]):
+        attach.setdefault(ln["endA"], []).append((i, ln["endB"]))
+        attach.setdefault(ln["endB"], []).append((i, ln["endA"]))
+
+    def seg_props(ln):
         lt = types[ln["type"]]
         d_vol = float(lt["diameter"])  # volume-equivalent diameter
         mden = float(lt["mass_density"])
-        anchors.append(np.array(anchor["location"], float))
-        rFair.append(np.array(vessel["location"], float))
-        Ls.append(float(ln["length"]))
-        EAs.append(float(lt["stiffness"]))
-        ws.append((mden - rho_water * np.pi / 4 * d_vol**2) * g)
-        names.append(ln.get("name", f"line{len(names)+1}"))
+        return (float(ln["length"]), float(lt["stiffness"]),
+                (mden - rho_water * np.pi / 4 * d_vol**2) * g)
+
+    def point_weight(p):
+        return (float(p.get("mass", 0.0))
+                - rho_water * float(p.get("volume", 0.0))) * g
+
+    anchors, rFair, segs, names, used = [], [], [], [], set()
+    for name, p in points.items():
+        if p["type"] != "fixed":
+            continue
+        for i0, nxt in attach.get(name, []):
+            # walk the chain from this anchor through free points
+            chain = [i0]
+            cur = nxt
+            while points[cur]["type"] == "free":
+                at = attach[cur]
+                if len(at) != 2:
+                    raise ValueError(
+                        f"free point '{cur}' joins {len(at)} lines; only "
+                        "two-line chains are supported (no bridles)"
+                    )
+                (j,) = [j for j, _ in at if j != chain[-1]]
+                chain.append(j)
+                cur = [o for j, o in at if j == chain[-1]][0]
+            if points[cur]["type"] != "vessel":
+                raise ValueError(
+                    f"line chain from anchor '{name}' ends at "
+                    f"'{cur}' ({points[cur]['type']}); expected a vessel point"
+                )
+            seg = []
+            node = name
+            for j in chain:
+                ln = mooring["lines"][j]
+                node = ln["endB"] if ln["endA"] == node else ln["endA"]
+                wp = point_weight(points[node]) if (
+                    points[node]["type"] == "free") else 0.0
+                seg.append(seg_props(ln) + (wp,))
+                used.add(j)
+            anchors.append(np.array(p["location"], float))
+            rFair.append(np.array(points[cur]["location"], float))
+            segs.append(seg)
+            names.append("-".join(
+                mooring["lines"][j].get("name", f"line{j+1}") for j in chain
+            ))
+    unused = set(range(len(mooring["lines"]))) - used
+    if unused:
+        bad = [mooring["lines"][j].get("name", f"line{j+1}") for j in unused]
+        raise ValueError(
+            f"lines {bad} are not part of any fixed-to-vessel chain"
+        )
+
+    S = max(len(s) for s in segs)
+    nL = len(segs)
+    L = np.zeros((nL, S))
+    EA = np.ones((nL, S))
+    w = np.ones((nL, S))
+    Wp = np.zeros((nL, S))
+    for i, seg in enumerate(segs):
+        for k, (lk, ek, wk, wpk) in enumerate(seg):
+            L[i, k], EA[i, k], w[i, k], Wp[i, k] = lk, ek, wk, wpk
 
     return MooringSystem(
         anchors=np.array(anchors),
         rFair=np.array(rFair),
-        L=np.array(Ls),
-        EA=np.array(EAs),
-        w=np.array(ws),
+        L=L, EA=EA, w=w, Wp=Wp,
         depth=float(mooring.get("water_depth", 0.0)),
         names=names,
     )
@@ -138,9 +194,49 @@ def _profile(H, V, L, EA, w):
     return jnp.where(suspended, xs, xt), jnp.where(suspended, zs, zt)
 
 
-def catenary_solve(XF, ZF, L, EA, w, iters=60, tol=1e-11):
-    """Solve one line for fairlead tension components (HF, VF) such that the
-    catenary spans horizontal distance XF and vertical distance ZF.
+def _profile_suspended(H, V, L, EA, w):
+    """Suspended-segment spans (no seabed contact) — the analytic catenary
+    expressions, valid for any bottom-end vertical tension VA = V - wL
+    including VA < 0 (a segment sagging below its lower attachment).
+    Vectorized over a trailing segment axis; inert padding (L=0) spans 0.
+    """
+    vh = V / H
+    vah = (V - w * L) / H
+    x = H / w * (jnp.arcsinh(vh) - jnp.arcsinh(vah)) + H * L / EA
+    z = (
+        H / w * (jnp.sqrt(1 + vh**2) - jnp.sqrt(1 + vah**2))
+        + (V * L - 0.5 * w * L**2) / EA
+    )
+    return x, z
+
+
+def _segment_top_tensions(V, L, w, Wp):
+    """Vertical tension at the top of each segment of a composite line
+    (segments ordered anchor(0) -> fairlead(S-1); fairlead vertical
+    tension V; Wp = clump weight at each segment's top node)."""
+    c = w * L
+    above_seg = jnp.sum(c) - jnp.cumsum(c)            # sum_{j>i} w_j L_j
+    above_pt = jnp.sum(Wp) - jnp.cumsum(Wp) + Wp      # sum_{j>=i} Wp_j
+    return V - above_seg - above_pt
+
+
+def _profile_composite(H, V, L, EA, w, Wp):
+    """Fairlead excursion (x, z) of a composite line under fairlead tension
+    (H, V): per-segment spans stacked anchor->fairlead.  The bottom segment
+    may rest on the seabed (touchdown branch of :func:`_profile`); upper
+    segments use the suspended expressions."""
+    Vtop = _segment_top_tensions(V, L, w, Wp)
+    x0, z0 = _profile(H, Vtop[0], L[0], EA[0], w[0])
+    xu, zu = _profile_suspended(H, Vtop[1:], L[1:], EA[1:], w[1:])
+    return x0 + jnp.sum(xu), z0 + jnp.sum(zu)
+
+
+def catenary_solve(XF, ZF, L, EA, w, Wp=None, iters=60, tol=1e-11):
+    """Solve one (possibly composite) line for fairlead tension components
+    (HF, VF) such that the catenary spans horizontal distance XF and
+    vertical distance ZF.  ``L``/``EA``/``w`` may be scalars (one segment)
+    or [S] segment arrays ordered anchor->fairlead with clump weights
+    ``Wp`` at segment tops.
 
     Damped Newton in (log HF, VF) — log keeps HF positive — from the
     MoorPy-style initial guess, iterated to a relative-residual tolerance
@@ -155,16 +251,22 @@ def catenary_solve(XF, ZF, L, EA, w, iters=60, tol=1e-11):
     which is what lets the design-sweep driver run the whole mooring stage
     on the TPU.
     """
+    L = jnp.atleast_1d(L)
+    EA = jnp.atleast_1d(EA)
+    w = jnp.atleast_1d(w)
+    Wp = jnp.zeros_like(L) if Wp is None else jnp.atleast_1d(Wp)
+    L_tot = jnp.sum(L)
+    W = jnp.sum(w * L)                   # total suspended segment weight
+    w_eff = W / L_tot
     # guard XF -> 0 (fairlead directly above anchor, e.g. a vertical tendon):
     # treat as a tiny horizontal span so the solve stays finite; HF then
     # correctly comes out ~0 and the force is purely vertical
-    XF = jnp.maximum(XF, 1e-6 * L)
+    XF = jnp.maximum(XF, 1e-6 * L_tot)
     d = jnp.sqrt(XF**2 + ZF**2)
-    slack = 3.0 * jnp.maximum((L**2 - ZF**2) / XF**2 - 1.0, 1e-8)
-    lam0 = jnp.where(L <= d, 0.25, jnp.sqrt(slack))
-    H0 = jnp.maximum(jnp.abs(0.5 * w * XF / lam0), 10.0)
-    V0 = 0.5 * w * (ZF / jnp.tanh(lam0) + L)
-    W = w * L
+    slack = 3.0 * jnp.maximum((L_tot**2 - ZF**2) / XF**2 - 1.0, 1e-8)
+    lam0 = jnp.where(L_tot <= d, 0.25, jnp.sqrt(slack))
+    H0 = jnp.maximum(jnp.abs(0.5 * w_eff * XF / lam0), 10.0)
+    V0 = 0.5 * w_eff * (ZF / jnp.tanh(lam0) + L_tot) + 0.5 * jnp.sum(Wp)
     scale = jnp.maximum(jnp.abs(XF), jnp.abs(ZF))
     tol = jnp.asarray(tol, XF.dtype) + 30 * jnp.finfo(XF.dtype).eps
 
@@ -173,7 +275,7 @@ def catenary_solve(XF, ZF, L, EA, w, iters=60, tol=1e-11):
         # by closure, so custom_root's implicit derivative covers them
         H = jnp.exp(p[0])
         V = p[1]
-        x, z = _profile(H, V, L, EA, w)
+        x, z = _profile_composite(H, V, L, EA, w, Wp)
         return jnp.stack([x - XF, z - ZF])
 
     def solve(f, p0):
@@ -224,19 +326,22 @@ def catenary_solve(XF, ZF, L, EA, w, iters=60, tol=1e-11):
 
 # ---------------- system-level forces ----------------
 
-def line_forces(r6, anchors, rFair, L, EA, w):
+def line_forces(r6, anchors, rFair, L, EA, w, Wp=None):
     """6-DOF mooring reaction on the body at pose r6, plus per-line fairlead
-    force vectors and tension components.
+    force vectors and tension components.  Segment arrays are [nL, S]
+    (anchor->fairlead; S=1 for simple lines).
 
     Returns (f6[6], HF[nL], VF[nL]).
     """
+    if Wp is None:
+        Wp = jnp.zeros_like(L)
     R = rotation_matrix(r6[3], r6[4], r6[5])
     arm = jnp.einsum("ij,lj->li", R, rFair)          # rotated fairlead offsets
     p = r6[:3] + arm                                  # fairlead world positions
     dxy = p[:, :2] - anchors[:, :2]
     XF = jnp.sqrt(jnp.sum(dxy**2, axis=1))
     ZF = p[:, 2] - anchors[:, 2]
-    HF, VF = jax.vmap(catenary_solve)(XF, ZF, L, EA, w)
+    HF, VF = jax.vmap(catenary_solve)(XF, ZF, L, EA, w, Wp)
     # vertical-line guard: direction is irrelevant when XF ~ 0 since HF ~ 0
     u = dxy / jnp.maximum(XF, 1e-9)[:, None]
     F3 = jnp.stack([-HF * u[:, 0], -HF * u[:, 1], -VF], axis=1)  # [nL,3]
@@ -244,14 +349,22 @@ def line_forces(r6, anchors, rFair, L, EA, w):
     return f6, HF, VF
 
 
-def line_tensions(r6, anchors, rFair, L, EA, w):
+def line_tensions(r6, anchors, rFair, L, EA, w, Wp=None):
     """End tensions [TA..., TB...] (anchor ends first, then fairlead ends),
     matching MoorPy's getTensions ordering consumed at reference
     raft/raft_model.py:273-283."""
-    _, HF, VF = line_forces(r6, anchors, rFair, L, EA, w)
-    W = w * L
+    if Wp is None:
+        Wp = jnp.zeros_like(L)
+    _, HF, VF = line_forces(r6, anchors, rFair, L, EA, w, Wp)
+    # vertical tension at the anchor end of the composite line (1-D legacy
+    # [nL] inputs are per-line scalars — summing axis -1 would total ALL
+    # lines' weights)
+    Lw = w * L
+    W = (Lw if Lw.ndim == 1 else jnp.sum(Lw, axis=-1)) + (
+        Wp if Wp.ndim == 1 else jnp.sum(Wp, axis=-1))
+    VA = VF - W
     TB = jnp.sqrt(HF**2 + VF**2)
-    TA = jnp.where(VF >= W, jnp.sqrt(HF**2 + (VF - W) ** 2), HF)
+    TA = jnp.where(VA >= 0, jnp.sqrt(HF**2 + VA**2), HF)
     return jnp.concatenate([TA, TB])
 
 
@@ -267,7 +380,7 @@ def body_hydrostatic_force(r6, m, v, rCG, rM, AWP, rho=1025.0, g=9.81):
 
 
 def solve_equilibrium(
-    f6_ext, body_props, anchors, rFair, L, EA, w, rho=1025.0, g=9.81,
+    f6_ext, body_props, anchors, rFair, L, EA, w, Wp=None, rho=1025.0, g=9.81,
     iters=40, r6_init=None, step_tol=1e-8,
 ):
     """Find the body pose r6 where mooring + hydrostatics + external mean
@@ -283,9 +396,11 @@ def solve_equilibrium(
     Returns r6[6].
     """
     m, v, rCG, rM, AWP = body_props
+    if Wp is None:
+        Wp = jnp.zeros_like(L)
 
     def total_force(r6):
-        f_lines, _, _ = line_forces(r6, anchors, rFair, L, EA, w)
+        f_lines, _, _ = line_forces(r6, anchors, rFair, L, EA, w, Wp)
         f_body = body_hydrostatic_force(r6, m, v, rCG, rM, AWP, rho, g)
         return f_lines + f_body + f6_ext
 
@@ -316,27 +431,29 @@ def solve_equilibrium(
     return r6
 
 
-def coupled_stiffness(r6, anchors, rFair, L, EA, w):
+def coupled_stiffness(r6, anchors, rFair, L, EA, w, Wp=None):
     """Mooring-only 6x6 stiffness C = -d f6_lines / d r6 about pose r6
     (the reference's ms.getCoupledStiffness(lines_only=True),
     raft/raft_model.py:117, :366) — exact forward-mode autodiff through the
     catenary solves instead of MoorPy's finite differencing."""
 
     def f(r):
-        f6, _, _ = line_forces(r, anchors, rFair, L, EA, w)
+        f6, _, _ = line_forces(r, anchors, rFair, L, EA, w, Wp)
         return f6
 
     return -jax.jacfwd(f)(r6)
 
 
-def tension_jacobian(r6, anchors, rFair, L, EA, w):
+def tension_jacobian(r6, anchors, rFair, L, EA, w, Wp=None):
     """J_moor = d tensions / d r6  [2 nL, 6] (reference raft_model.py:366,
     consumed for tension FFTs at :273-283)."""
-    return jax.jacfwd(lambda r: line_tensions(r, anchors, rFair, L, EA, w))(r6)
+    return jax.jacfwd(
+        lambda r: line_tensions(r, anchors, rFair, L, EA, w, Wp)
+    )(r6)
 
 
 def case_mooring(f6_ext, m, v, rCG, rM, AWP, anchors, rFair, L, EA, w,
-                 rho=1025.0, g=9.81, yawstiff=0.0):
+                 Wp=None, rho=1025.0, g=9.81, yawstiff=0.0):
     """One-shot per-case mooring analysis: equilibrium pose plus all the
     linearized quantities the dynamics solve consumes
     (reference raft/raft_model.py:332-392 calcMooringAndOffsets).
@@ -348,14 +465,17 @@ def case_mooring(f6_ext, m, v, rCG, rM, AWP, anchors, rFair, L, EA, w,
 
     Returns (r6, C_moor, F_moor, T_moor, J_moor).
     """
+    if Wp is None:
+        Wp = jnp.zeros_like(L)
     r6 = solve_equilibrium(
-        f6_ext, (m, v, rCG, rM, AWP), anchors, rFair, L, EA, w, rho=rho, g=g
+        f6_ext, (m, v, rCG, rM, AWP), anchors, rFair, L, EA, w, Wp,
+        rho=rho, g=g
     )
-    C_moor = coupled_stiffness(r6, anchors, rFair, L, EA, w)
+    C_moor = coupled_stiffness(r6, anchors, rFair, L, EA, w, Wp)
     C_moor = C_moor.at[5, 5].add(yawstiff)
-    F_moor = line_forces(r6, anchors, rFair, L, EA, w)[0]
-    T_moor = line_tensions(r6, anchors, rFair, L, EA, w)
-    J_moor = tension_jacobian(r6, anchors, rFair, L, EA, w)
+    F_moor = line_forces(r6, anchors, rFair, L, EA, w, Wp)[0]
+    T_moor = line_tensions(r6, anchors, rFair, L, EA, w, Wp)
+    J_moor = tension_jacobian(r6, anchors, rFair, L, EA, w, Wp)
     return r6, C_moor, F_moor, T_moor, J_moor
 
 
@@ -373,9 +493,9 @@ def _case_mooring_flat(rho, g, yawstiff):
     """Positional-argument :func:`case_mooring` wrapper shared by the
     cached batch entry points below."""
 
-    def one(f6, m, v, rCG, rM, AWP, anchors, rFair, L, EA, w):
+    def one(f6, m, v, rCG, rM, AWP, anchors, rFair, L, EA, w, Wp):
         return case_mooring(
-            f6, m, v, rCG, rM, AWP, anchors, rFair, L, EA, w,
+            f6, m, v, rCG, rM, AWP, anchors, rFair, L, EA, w, Wp,
             rho=rho, g=g, yawstiff=yawstiff,
         )
 
@@ -387,7 +507,7 @@ def case_mooring_batch_fn(rho, g, yawstiff):
     """Jitted :func:`case_mooring`, vmapped over the case axis of ``f6_ext``
     (body properties and line arrays are shared across cases)."""
     one = _case_mooring_flat(rho, g, yawstiff)
-    return jax.jit(jax.vmap(one, in_axes=(0,) + (None,) * 10))
+    return jax.jit(jax.vmap(one, in_axes=(0,) + (None,) * 11))
 
 
 @lru_cache(maxsize=None)
@@ -397,7 +517,7 @@ def case_mooring_design_batch_fn(rho, g, yawstiff):
     the sweep driver's batched mooring equilibrium (one compile serves the
     whole sweep)."""
     one = _case_mooring_flat(rho, g, yawstiff)
-    per_design = jax.vmap(one, in_axes=(0,) + (None,) * 10)
+    per_design = jax.vmap(one, in_axes=(0,) + (None,) * 11)
     return jax.jit(jax.vmap(per_design))
 
 
@@ -407,9 +527,9 @@ def unloaded_mooring_fn():
     linearization consumed by analyze_unloaded (reference
     raft/raft_model.py:117-118)."""
 
-    def f(r6, anchors, rFair, L, EA, w):
-        C0 = coupled_stiffness(r6, anchors, rFair, L, EA, w)
-        F0 = line_forces(r6, anchors, rFair, L, EA, w)[0]
+    def f(r6, anchors, rFair, L, EA, w, Wp):
+        C0 = coupled_stiffness(r6, anchors, rFair, L, EA, w, Wp)
+        F0 = line_forces(r6, anchors, rFair, L, EA, w, Wp)[0]
         return C0, F0
 
     return jax.jit(f)

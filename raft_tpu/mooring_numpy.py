@@ -39,30 +39,69 @@ def _profile_np(H, V, L, EA, w):
     return x, z
 
 
-def catenary_solve_np(XF, ZF, L, EA, w, tol=1e-10, max_iter=60):
-    """Newton solve for one line's fairlead tensions (HF, VF)."""
-    XF = max(XF, 1e-6 * L)
+def segment_top_tensions_np(V, L, w, Wp):
+    """Vertical tension at the top of each segment (anchor(0)->fairlead;
+    NumPy twin of mooring._segment_top_tensions, shared with the
+    visualization so the junction accounting lives in one place)."""
+    c = np.asarray(w, float) * np.asarray(L, float)
+    Wp = np.asarray(Wp, float)
+    return V - (np.sum(c) - np.cumsum(c)) - (np.sum(Wp) - np.cumsum(Wp) + Wp)
+
+
+def _profile_comp_np(H, V, L, EA, w, Wp):
+    """Composite-line spans (segments anchor->fairlead; NumPy twin of
+    mooring._profile_composite).  Upper segments use the suspended
+    expressions (valid for sagging VA < 0 too); only the bottom segment
+    can rest on the seabed."""
+    L = np.atleast_1d(np.asarray(L, float))
+    EA = np.atleast_1d(np.asarray(EA, float))
+    w = np.atleast_1d(np.asarray(w, float))
+    Wp = np.atleast_1d(np.asarray(Wp, float))
+    c = w * L
+    Vtop = segment_top_tensions_np(V, L, w, Wp)
+    x, z = _profile_np(H, Vtop[0], L[0], EA[0], w[0])
+    for i in range(1, len(L)):
+        if L[i] == 0.0:
+            continue
+        vh = Vtop[i] / H
+        vah = (Vtop[i] - c[i]) / H
+        x += H / w[i] * (np.arcsinh(vh) - np.arcsinh(vah)) + H * L[i] / EA[i]
+        z += (H / w[i] * (np.sqrt(1 + vh**2) - np.sqrt(1 + vah**2))
+              + (Vtop[i] * L[i] - 0.5 * w[i] * L[i]**2) / EA[i])
+    return x, z
+
+
+def catenary_solve_np(XF, ZF, L, EA, w, Wp=None, tol=1e-10, max_iter=60):
+    """Newton solve for one (possibly composite) line's fairlead tensions
+    (HF, VF); L/EA/w/Wp may be scalars or [S] segment arrays."""
+    L = np.atleast_1d(np.asarray(L, float))
+    EA = np.atleast_1d(np.asarray(EA, float))
+    w = np.atleast_1d(np.asarray(w, float))
+    Wp = np.zeros_like(L) if Wp is None else np.atleast_1d(np.asarray(Wp, float))
+    L_tot = np.sum(L)
+    W = float(np.sum(w * L))
+    w_eff = W / L_tot
+    XF = max(XF, 1e-6 * L_tot)
     d = np.hypot(XF, ZF)
-    slack = 3.0 * max((L**2 - ZF**2) / XF**2 - 1.0, 1e-8)
-    lam0 = 0.25 if L <= d else np.sqrt(slack)
-    H = max(abs(0.5 * w * XF / lam0), 10.0)
-    V = 0.5 * w * (ZF / np.tanh(lam0) + L)
-    W = w * L
+    slack = 3.0 * max((L_tot**2 - ZF**2) / XF**2 - 1.0, 1e-8)
+    lam0 = 0.25 if L_tot <= d else np.sqrt(slack)
+    H = max(abs(0.5 * w_eff * XF / lam0), 10.0)
+    V = 0.5 * w_eff * (ZF / np.tanh(lam0) + L_tot) + 0.5 * float(np.sum(Wp))
     scale = max(abs(XF), abs(ZF))
     u = np.log(H)
     for _ in range(max_iter):
         H = np.exp(u)
-        x, z = _profile_np(H, V, L, EA, w)
+        x, z = _profile_comp_np(H, V, L, EA, w, Wp)
         r = np.array([x - XF, z - ZF])
         if np.max(np.abs(r)) < tol * scale:
             break
         # Jacobian wrt (log H, V) by central differences of the profile
         eps_u, eps_v = 1e-7, 1e-7 * (abs(V) + W)
-        xp, zp = _profile_np(np.exp(u + eps_u), V, L, EA, w)
-        xm, zm = _profile_np(np.exp(u - eps_u), V, L, EA, w)
+        xp, zp = _profile_comp_np(np.exp(u + eps_u), V, L, EA, w, Wp)
+        xm, zm = _profile_comp_np(np.exp(u - eps_u), V, L, EA, w, Wp)
         J00, J10 = (xp - xm) / (2 * eps_u), (zp - zm) / (2 * eps_u)
-        xp, zp = _profile_np(H, V + eps_v, L, EA, w)
-        xm, zm = _profile_np(H, V - eps_v, L, EA, w)
+        xp, zp = _profile_comp_np(H, V + eps_v, L, EA, w, Wp)
+        xm, zm = _profile_comp_np(H, V - eps_v, L, EA, w, Wp)
         J01, J11 = (xp - xm) / (2 * eps_v), (zp - zm) / (2 * eps_v)
         det = J00 * J11 - J01 * J10
         if abs(det) < 1e-30:
@@ -86,9 +125,11 @@ def _rotmat(r4, r5, r6):
     return Rz @ Ry @ Rx
 
 
-def line_forces_np(r6, anchors, rFair, L, EA, w):
+def line_forces_np(r6, anchors, rFair, L, EA, w, Wp=None):
     """Net 6-DOF mooring reaction at body pose r6 plus per-line (HF, VF) —
-    serial loop over lines."""
+    serial loop over lines.  L/EA/w/Wp are [nL] or [nL, S]."""
+    if Wp is None:
+        Wp = np.zeros_like(np.asarray(L, float))
     R = _rotmat(r6[3], r6[4], r6[5])
     f6 = np.zeros(6)
     HFs = np.zeros(len(L))
@@ -99,7 +140,7 @@ def line_forces_np(r6, anchors, rFair, L, EA, w):
         dxy = p[:2] - anchors[i, :2]
         XF = np.hypot(dxy[0], dxy[1])
         ZF = p[2] - anchors[i, 2]
-        HF, VF = catenary_solve_np(XF, ZF, L[i], EA[i], w[i])
+        HF, VF = catenary_solve_np(XF, ZF, L[i], EA[i], w[i], Wp[i])
         u = dxy / max(XF, 1e-9)
         F3 = np.array([-HF * u[0], -HF * u[1], -VF])
         f6[:3] += F3
@@ -108,11 +149,18 @@ def line_forces_np(r6, anchors, rFair, L, EA, w):
     return f6, HFs, VFs
 
 
-def line_tensions_np(r6, anchors, rFair, L, EA, w):
-    _, HF, VF = line_forces_np(r6, anchors, rFair, L, EA, w)
-    W = w * L
+def line_tensions_np(r6, anchors, rFair, L, EA, w, Wp=None):
+    if Wp is None:
+        Wp = np.zeros_like(np.asarray(L, float))
+    _, HF, VF = line_forces_np(r6, anchors, rFair, L, EA, w, Wp)
+    # 1-D legacy [nL] inputs are per-line scalars, not a segment axis
+    Lw = np.asarray(w, float) * np.asarray(L, float)
+    Wp_ = np.asarray(Wp, float)
+    W = (Lw if Lw.ndim == 1 else np.sum(Lw, axis=-1)) + (
+        Wp_ if Wp_.ndim == 1 else np.sum(Wp_, axis=-1))
+    VA = VF - W
     TB = np.hypot(HF, VF)
-    TA = np.where(VF >= W, np.hypot(HF, VF - W), HF)
+    TA = np.where(VA >= 0, np.hypot(HF, VA), HF)
     return np.concatenate([TA, TB])
 
 
@@ -130,14 +178,14 @@ def body_force_np(r6, m, v, rCG, rM, AWP, rho, g):
 
 
 def solve_equilibrium_np(
-    f6_ext, body_props, anchors, rFair, L, EA, w, rho=1025.0, g=9.81,
-    tol=1e-8, max_iter=40,
+    f6_ext, body_props, anchors, rFair, L, EA, w, Wp=None, rho=1025.0,
+    g=9.81, tol=1e-8, max_iter=40,
 ):
     """Damped-Newton rigid-body equilibrium (ms.solveEquilibrium3 twin)."""
     m, v, rCG, rM, AWP = body_props
 
     def total(r6):
-        f = line_forces_np(r6, anchors, rFair, L, EA, w)[0]
+        f = line_forces_np(r6, anchors, rFair, L, EA, w, Wp)[0]
         return f + body_force_np(r6, m, v, rCG, rM, AWP, rho, g) + f6_ext
 
     r6 = np.zeros(6)
@@ -158,42 +206,42 @@ def solve_equilibrium_np(
     return r6
 
 
-def coupled_stiffness_np(r6, anchors, rFair, L, EA, w):
+def coupled_stiffness_np(r6, anchors, rFair, L, EA, w, Wp=None):
     """C = -d f6_lines / d r6 by central differences (MoorPy-style)."""
     h = np.array([1e-4, 1e-4, 1e-4, 1e-6, 1e-6, 1e-6])
     C = np.zeros((6, 6))
     for j in range(6):
         e = np.zeros(6)
         e[j] = h[j]
-        fp = line_forces_np(r6 + e, anchors, rFair, L, EA, w)[0]
-        fm = line_forces_np(r6 - e, anchors, rFair, L, EA, w)[0]
+        fp = line_forces_np(r6 + e, anchors, rFair, L, EA, w, Wp)[0]
+        fm = line_forces_np(r6 - e, anchors, rFair, L, EA, w, Wp)[0]
         C[:, j] = -(fp - fm) / (2 * h[j])
     return C
 
 
-def tension_jacobian_np(r6, anchors, rFair, L, EA, w):
+def tension_jacobian_np(r6, anchors, rFair, L, EA, w, Wp=None):
     h = np.array([1e-4, 1e-4, 1e-4, 1e-6, 1e-6, 1e-6])
     nL = len(L)
     J = np.zeros((2 * nL, 6))
     for j in range(6):
         e = np.zeros(6)
         e[j] = h[j]
-        tp = line_tensions_np(r6 + e, anchors, rFair, L, EA, w)
-        tm = line_tensions_np(r6 - e, anchors, rFair, L, EA, w)
+        tp = line_tensions_np(r6 + e, anchors, rFair, L, EA, w, Wp)
+        tm = line_tensions_np(r6 - e, anchors, rFair, L, EA, w, Wp)
         J[:, j] = (tp - tm) / (2 * h[j])
     return J
 
 
 def case_mooring_np(f6_ext, body_props, anchors, rFair, L, EA, w,
-                    rho=1025.0, g=9.81, yawstiff=0.0):
+                    Wp=None, rho=1025.0, g=9.81, yawstiff=0.0):
     """Serial twin of mooring.case_mooring: equilibrium + linearization
     (reference calcMooringAndOffsets, raft/raft_model.py:332-392)."""
     r6 = solve_equilibrium_np(
-        f6_ext, body_props, anchors, rFair, L, EA, w, rho=rho, g=g
+        f6_ext, body_props, anchors, rFair, L, EA, w, Wp, rho=rho, g=g
     )
-    C = coupled_stiffness_np(r6, anchors, rFair, L, EA, w)
+    C = coupled_stiffness_np(r6, anchors, rFair, L, EA, w, Wp)
     C[5, 5] += yawstiff
-    F = line_forces_np(r6, anchors, rFair, L, EA, w)[0]
-    T = line_tensions_np(r6, anchors, rFair, L, EA, w)
-    J = tension_jacobian_np(r6, anchors, rFair, L, EA, w)
+    F = line_forces_np(r6, anchors, rFair, L, EA, w, Wp)[0]
+    T = line_tensions_np(r6, anchors, rFair, L, EA, w, Wp)
+    J = tension_jacobian_np(r6, anchors, rFair, L, EA, w, Wp)
     return r6, C, F, T, J

@@ -108,7 +108,7 @@ def _prepare_draft(base_design, s, rho_water, g):
         [_scale_fill(m, 0.0) for m in members], turbine, rho_water, g
     )
     ms = parse_mooring(d["mooring"], rho_water=rho_water, g=g)
-    moor = (ms.anchors, ms.rFair, ms.L, ms.EA, ms.w)
+    moor = (ms.anchors, ms.rFair, ms.L, ms.EA, ms.w, ms.Wp)
     A = np.asarray(_am_f64(put_cpu(nodes.astype(np.float64)), rho_water))
     return _DraftVariant(
         nodes=nodes, moor=moor, A_morison=A,
@@ -260,7 +260,7 @@ def run_draft_ballast_sweep(
         [np.array([0.0, 0.0, v.zMeta]) for v in variants for _ in range(nB)]
     )
     moor_all = tuple(
-        rep(np.stack([v.moor[i] for v in variants])) for i in range(5)
+        rep(np.stack([v.moor[i] for v in variants])) for i in range(6)
     )
     # wind-free cases all share zero mean load, so one equilibrium per
     # design suffices; results broadcast across the case axis (the NumPy

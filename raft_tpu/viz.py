@@ -82,10 +82,14 @@ def member_wireframe(mem, n_az=12):
 
 # ------------------------------------------------------------- mooring lines
 
-def line_profile(anchor, fairlead, HF, VF, L, EA, w, n=40):
+def line_profile(anchor, fairlead, HF, VF, L, EA, w, n=40, touchdown=True):
     """Sampled 3-D shape of one catenary mooring line from the converged
     fairlead tension components (the same elastic-catenary branches as
-    mooring._profile, evaluated at n arc-length stations from the anchor)."""
+    mooring._profile, evaluated at n arc-length stations from the anchor).
+
+    touchdown=False forces the suspended expressions even for VA < 0 —
+    an upper segment of a composite line sagging below its junction,
+    which must not be drawn as seabed contact."""
     anchor = np.asarray(anchor, float)
     fairlead = np.asarray(fairlead, float)
     dxy = fairlead[:2] - anchor[:2]
@@ -93,7 +97,7 @@ def line_profile(anchor, fairlead, HF, VF, L, EA, w, n=40):
     u = dxy / XF
     s = np.linspace(0.0, L, n)
     VA = VF - w * L
-    if VA >= 0:  # fully suspended
+    if VA >= 0 or not touchdown:  # suspended (incl. sagging segments)
         Vs = VA + w * s
         x = HF / w * (np.arcsinh(Vs / HF) - np.arcsinh(VA / HF)) + HF * s / EA
         z = (
@@ -119,6 +123,31 @@ def line_profile(anchor, fairlead, HF, VF, L, EA, w, n=40):
     pts[:, 1] = anchor[1] + u[1] * x
     pts[:, 2] = anchor[2] + z
     return pts
+
+
+def composite_line_profile(anchor, fairlead, HF, VF, L, EA, w, Wp=None,
+                           n=40):
+    """Sampled 3-D shape of a composite (multi-segment) line: per-segment
+    catenary profiles stacked anchor->fairlead, each drawn with its own
+    top tension (mooring._segment_top_tensions)."""
+    from raft_tpu.mooring_numpy import segment_top_tensions_np
+
+    L = np.atleast_1d(np.asarray(L, float))
+    EA = np.atleast_1d(np.asarray(EA, float))
+    w = np.atleast_1d(np.asarray(w, float))
+    Wp = np.zeros_like(L) if Wp is None else np.atleast_1d(np.asarray(Wp))
+    Vtop = segment_top_tensions_np(VF, L, w, Wp)
+    start = np.asarray(anchor, float)
+    out = []
+    for k in range(len(L)):
+        if L[k] == 0.0:
+            continue
+        pts = line_profile(start, fairlead, HF, float(Vtop[k]),
+                           float(L[k]), float(EA[k]), float(w[k]), n=n,
+                           touchdown=(k == 0))
+        out.append(pts)
+        start = pts[-1]
+    return np.concatenate(out) if out else np.asarray([anchor, fairlead])
 
 
 # --------------------------------------------------------------------- rotor
@@ -194,9 +223,9 @@ def plot_model(model, ax=None, color="k", nodes=False, station_plot=None):
     ms = model.ms
     for i in range(ms.n_lines):
         fair = np.asarray(ms.rFair[i]) + np.asarray(r6[:3])
-        pts = line_profile(
+        pts = composite_line_profile(
             ms.anchors[i], fair, float(HF[i]), float(VF[i]),
-            float(ms.L[i]), float(ms.EA[i]), float(ms.w[i]),
+            ms.L[i], ms.EA[i], ms.w[i], ms.Wp[i],
         )
         ax.plot(pts[:, 0], pts[:, 1], pts[:, 2], color="b", lw=1.0)
 

@@ -1,0 +1,162 @@
+"""Interop extras: the Capytaine NetCDF import route (golden-array exact,
+the removed reference integration's test pattern,
+reference tests/test_capytaine_integration.py), the WAMIT `.hst`
+hydrostatics file in the OpenFAST handoff tree, and the WISDEM ballast
+handoff (reference raft/raft_model.py:1040-1090 adjustWISDEM)."""
+
+import os
+
+import numpy as np
+import pytest
+import yaml
+
+from raft_tpu.bem import read_capytaine_nc, read_wamit_hst, write_wamit_hst
+
+REF = "/root/reference/tests"
+CAPY_NC = f"{REF}/test_data/mesh_converge_0.750_1.250.nc"
+CAPY_REF = f"{REF}/ref_data/capytaine_integration"
+
+
+@pytest.mark.skipif(not os.path.exists(CAPY_NC),
+                    reason="capytaine test data not mounted")
+class TestCapytaineImport:
+    def test_shapes_and_dtypes(self):
+        c = read_capytaine_nc(CAPY_NC)
+        assert len(c.w) == 28
+        assert c.A.shape == (28, 6, 6)
+        assert c.B.shape == (28, 6, 6)
+        assert c.X.shape == (28, 1, 6)
+        assert c.X.dtype == np.complex128
+
+    def test_out_of_range_raises(self):
+        with pytest.raises(ValueError):
+            read_capytaine_nc(CAPY_NC, w_des=np.arange(0.01, 3, 0.01))
+
+    def test_golden_arrays_exact(self):
+        """<1e-12 element-exact against the stored reference arrays —
+        the removed integration's validation pattern (its fEx was the
+        raw diffraction_force field)."""
+        c = read_capytaine_nc(CAPY_NC, excitation="diffraction")
+        refA = np.loadtxt(f"{CAPY_REF}/wCapy-addedMass-surge.txt")
+        assert np.abs(refA[:, 1] - c.A[:, 0, 0]).max() < 1e-12
+        refB = np.loadtxt(f"{CAPY_REF}/wCapy-damping-surge.txt")
+        assert np.abs(refB[:, 1] - c.B[:, 0, 0]).max() < 1e-12
+        refR = np.loadtxt(f"{CAPY_REF}/wCapy-fExcitationReal-surge.txt")
+        refI = np.loadtxt(f"{CAPY_REF}/wCapy-fExcitationImag-surge.txt")
+        assert np.abs(refR[:, 1] - c.X[:, 0, 0].real).max() < 1e-12
+        assert np.abs(refI[:, 1] - c.X[:, 0, 0].imag).max() < 1e-12
+
+    def test_golden_interp_exact(self):
+        wDes = np.arange(0.1, 2.8, 0.01)
+        c = read_capytaine_nc(CAPY_NC, w_des=wDes, excitation="diffraction")
+        refA = np.loadtxt(f"{CAPY_REF}/wDes-addedMassInterp-surge.txt")
+        assert np.abs(refA[:, 1] - c.A[:, 0, 0]).max() < 1e-12
+        refB = np.loadtxt(f"{CAPY_REF}/wDes-dampingInterp-surge.txt")
+        assert np.abs(refB[:, 1] - c.B[:, 0, 0]).max() < 1e-12
+        refR = np.loadtxt(f"{CAPY_REF}/wDes-fExcitationInterpReal-surge.txt")
+        refI = np.loadtxt(f"{CAPY_REF}/wDes-fExcitationInterpImag-surge.txt")
+        # ~1e-16 relative: summation-order roundoff vs the reference's
+        # complex-valued np.interp on ~3e6-magnitude forces
+        assert np.abs(refR[:, 1] - c.X[:, 0, 0].real).max() < 1e-9
+        assert np.abs(refI[:, 1] - c.X[:, 0, 0].imag).max() < 1e-9
+
+    def test_total_excitation_includes_froude_krylov(self):
+        c_tot = read_capytaine_nc(CAPY_NC)
+        c_dif = read_capytaine_nc(CAPY_NC, excitation="diffraction")
+        assert not np.allclose(c_tot.X, c_dif.X)
+
+    def test_usable_in_model_pipeline(self):
+        """Imported Capytaine coefficients drive the case solve like any
+        WAMIT import."""
+        from raft_tpu.bem import interp_to_grid
+
+        c = read_capytaine_nc(CAPY_NC)
+        w = np.arange(0.15, 2.5, 0.05)
+        A, B, X = interp_to_grid(c, w, beta=0.0)
+        assert np.isfinite(A).all() and np.isfinite(B).all()
+        assert np.isfinite(X).all()
+
+
+def test_wamit_hst_roundtrip(tmp_path):
+    rng = np.random.default_rng(3)
+    C = rng.normal(size=(6, 6)) * 1e7
+    p = str(tmp_path / "t.hst")
+    write_wamit_hst(p, C, rho=1025.0, g=9.81)
+    C2 = read_wamit_hst(p, rho=1025.0, g=9.81)
+    np.testing.assert_allclose(C2, C, rtol=1e-6)
+
+
+def test_preprocess_hams_writes_hst(tmp_path):
+    from raft_tpu.designs import deep_spar
+    from raft_tpu.model import Model
+
+    design = deep_spar(n_cases=1)
+    design["platform"]["members"][0]["potMod"] = True
+    design["platform"]["dz_BEM"] = 8.0
+    design["platform"]["da_BEM"] = 8.0
+    m = Model(design)
+    m.analyze_unloaded()
+    d = str(tmp_path / "BEM")
+    m.preprocess_hams(mesh_dir=d, nw_bem=3)
+    hst = os.path.join(d, "Output", "Wamit_format", "Buoy.hst")
+    assert os.path.exists(hst)
+    C = read_wamit_hst(hst, rho=m.rho_water, g=m.g)
+    np.testing.assert_allclose(C, m.statics.C_hydro, rtol=1e-6, atol=1.0)
+
+
+def test_adjust_wisdem_ballast_handoff(tmp_path):
+    """adjust_wisdem updates the matched member's first ballast volume
+    from the model's fill level (reference matching rules: bottom-joint z
+    to 5 printed chars + first outer diameter)."""
+    from raft_tpu.designs import deep_spar
+    from raft_tpu.model import Model
+
+    design = deep_spar(n_cases=1)
+    m = Model(design)
+    mem = m.members[0]
+    d0 = float(np.atleast_1d(mem.d)[0])
+    zA = float(mem.rA[2])
+    wisdem = {
+        "components": {
+            "floating_platform": {
+                "joints": [
+                    {"name": "jbot", "location": [0.0, 0.0, zA]},
+                    {"name": "jtop", "location": [0.0, 0.0, 10.0]},
+                ],
+                "members": [
+                    {
+                        "name": "spar", "joint1": "jbot", "joint2": "jtop",
+                        "outer_shape": {
+                            "outer_diameter": {"values": [d0, d0]}
+                        },
+                        "internal_structure": {
+                            "ballasts": [{"volume": 1.0}]
+                        },
+                    },
+                    {   # no ballast section: must be skipped untouched
+                        "name": "brace", "joint1": "jtop", "joint2": "jbot",
+                        "outer_shape": {
+                            "outer_diameter": {"values": [1.0, 1.0]}
+                        },
+                        "internal_structure": {},
+                    },
+                ],
+            }
+        }
+    }
+    old = tmp_path / "wisdem_old.yaml"
+    new = tmp_path / "wisdem_new.yaml"
+    with open(old, "w") as f:
+        yaml.safe_dump(wisdem, f)
+    out = m.adjust_wisdem(str(old), str(new))
+    t0 = float(np.atleast_1d(mem.t)[0])
+    lf0 = float(np.atleast_1d(mem.l_fill)[0])
+    expect = np.pi * ((d0 - 2 * t0) / 2) ** 2 * lf0
+    got = out["components"]["floating_platform"]["members"][0][
+        "internal_structure"]["ballasts"][0]["volume"]
+    assert got == pytest.approx(expect, rel=1e-12)
+    # written file round-trips
+    reread = yaml.safe_load(open(new))
+    assert reread["components"]["floating_platform"]["members"][0][
+        "internal_structure"]["ballasts"][0]["volume"] == pytest.approx(
+        expect, rel=1e-9)

@@ -22,6 +22,14 @@ from raft_tpu.mooring import (
 
 OC3 = "/root/reference/designs/OC3spar.yaml"
 
+import os  # noqa: E402
+
+if not os.path.exists(OC3):
+    pytest.skip("reference designs not mounted", allow_module_level=True)
+
+with open(OC3) as _f:
+    OC3_MOORING = yaml.load(_f, Loader=yaml.FullLoader)["mooring"]
+
 
 @pytest.fixture(scope="module")
 def oc3_mooring():
@@ -35,7 +43,7 @@ def test_catenary_roundtrip(oc3_mooring):
     # various fairlead positions: slack, moderate, taut
     for XF, ZF in [(848.67, 250.0), (700.0, 250.0), (880.0, 250.0)]:
         H, V = catenary_solve(XF, ZF, ms.L[0], ms.EA[0], ms.w[0])
-        x, z = _profile(H, V, ms.L[0], ms.EA[0], ms.w[0])
+        x, z = _profile(H, V, ms.L[0, 0], ms.EA[0, 0], ms.w[0, 0])
         assert float(abs(x - XF)) < 1e-6
         assert float(abs(z - ZF)) < 1e-6
         assert float(H) > 0
@@ -137,3 +145,130 @@ def test_vmap_over_cases(oc3_mooring):
     r6s = jax.vmap(lambda f: solve_equilibrium(f, body, *arr))(f6s)
     surge = np.asarray(r6s[:, 0])
     assert surge[0] < surge[1] < surge[2]
+
+
+# ---------------- composite (multi-segment) lines ----------------
+
+def _two_seg_mooring(split=0.4, scale_mid=1.0):
+    """OC3-like system where each line is two chained segments (via free
+    intermediate points); scale_mid != 1 changes the upper segment's
+    type properties."""
+    import copy
+
+    moor = copy.deepcopy(OC3_MOORING)
+    lines, points = [], list(copy.deepcopy(moor["points"]))
+    types = list(moor["line_types"])
+    mid_type = copy.deepcopy(types[0])
+    mid_type["name"] = "mid"
+    mid_type["mass_density"] = float(types[0]["mass_density"]) * scale_mid
+    mid_type["stiffness"] = float(types[0]["stiffness"]) * scale_mid
+    types.append(mid_type)
+    for i, ln in enumerate(moor["lines"]):
+        Ltot = ln["length"]
+        pA = next(p for p in points if p["name"] == ln["endA"])
+        pB = next(p for p in points if p["name"] == ln["endB"])
+        anchor = pA if pA["type"] == "fixed" else pB
+        fair = pB if pA["type"] == "fixed" else pA
+        mid = {
+            "name": f"mid{i}", "type": "free",
+            # rough initial location irrelevant: quasi-static composite
+            "location": (np.asarray(anchor["location"], float)
+                         + np.asarray(fair["location"], float)).tolist(),
+        }
+        points.append(mid)
+        lines.append({"name": f"seg{i}a", "endA": anchor["name"],
+                      "endB": f"mid{i}", "type": types[0]["name"],
+                      "length": Ltot * split})
+        lines.append({"name": f"seg{i}b", "endA": f"mid{i}",
+                      "endB": fair["name"], "type": "mid",
+                      "length": Ltot * (1 - split)})
+    moor["lines"] = lines
+    moor["points"] = points
+    moor["line_types"] = types
+    return moor
+
+
+def test_split_line_matches_unsplit(oc3_mooring):
+    """A line split into two chained segments with identical properties
+    must reproduce the single-segment solution exactly (forces, stiffness,
+    tensions) — the composite formulation's consistency check."""
+    ms2 = parse_mooring(_two_seg_mooring(split=0.37), rho_water=1025.0)
+    assert ms2.L.shape[1] == 2
+    z6 = jnp.zeros(6)
+    f1, H1, V1 = line_forces(z6, *oc3_mooring.arrays())
+    f2, H2, V2 = line_forces(z6, *ms2.arrays())
+    np.testing.assert_allclose(np.asarray(f2), np.asarray(f1), rtol=1e-8)
+    np.testing.assert_allclose(np.asarray(H2), np.asarray(H1), rtol=1e-8)
+    C1 = np.asarray(coupled_stiffness(z6, *oc3_mooring.arrays()))
+    C2 = np.asarray(coupled_stiffness(z6, *ms2.arrays()))
+    np.testing.assert_allclose(C2, C1, rtol=1e-6, atol=1.0)
+    T1 = np.asarray(line_tensions(z6, *oc3_mooring.arrays()))
+    T2 = np.asarray(line_tensions(z6, *ms2.arrays()))
+    np.testing.assert_allclose(T2, T1, rtol=1e-8)
+
+
+def test_chain_rope_chain_physics(oc3_mooring):
+    """Two-segment line with a LIGHTER upper segment (chain-rope): the
+    fairlead vertical tension drops by the weight difference of the upper
+    segment, and the horizontal pretension changes accordingly; verified
+    against an independent NumPy composite solve."""
+    from raft_tpu.mooring_numpy import catenary_solve_np
+
+    ms = parse_mooring(_two_seg_mooring(split=0.5, scale_mid=0.3),
+                       rho_water=1025.0)
+    z6 = jnp.zeros(6)
+    _, H, V = line_forces(z6, *ms.arrays())
+    # independent NumPy composite solve at the same spans
+    dxy = ms.rFair[0, :2] - ms.anchors[0, :2]
+    XF = float(np.hypot(*dxy))
+    ZF = float(ms.rFair[0, 2] - ms.anchors[0, 2])
+    Hn, Vn = catenary_solve_np(XF, ZF, ms.L[0], ms.EA[0], ms.w[0], ms.Wp[0])
+    np.testing.assert_allclose(float(H[0]), Hn, rtol=1e-7)
+    np.testing.assert_allclose(float(V[0]), Vn, rtol=1e-7)
+    # lighter top half must carry less vertical tension than all-chain
+    _, H0, V0 = line_forces(z6, *oc3_mooring.arrays())
+    assert float(V[0]) < float(V0[0])
+
+
+def test_clump_weight_at_junction(oc3_mooring):
+    """A clump weight at the chain-rope junction adds to the fairlead
+    vertical tension (the line above the clump carries it)."""
+    import copy
+
+    moor = _two_seg_mooring(split=0.5)
+    heavy = copy.deepcopy(moor)
+    for p in heavy["points"]:
+        if p["type"] == "free":
+            p["mass"] = 5000.0          # 5 t clump
+    ms0 = parse_mooring(moor, rho_water=1025.0)
+    ms1 = parse_mooring(heavy, rho_water=1025.0)
+    assert (ms1.Wp > 0).any()
+    z6 = jnp.zeros(6)
+    _, _, V0 = line_forces(z6, *ms0.arrays())
+    _, _, V1 = line_forces(z6, *ms1.arrays())
+    dV = float(V1[0] - V0[0])
+    # fairlead vertical tension rises: the clump weight itself plus any
+    # chain its pull lifts off the seabed (so dV can exceed the clump
+    # weight, but stays of its order for a 5 t clump on this system)
+    W_clump = 5000.0 * 9.81
+    assert 0.0 < dV < 3.0 * W_clump
+
+
+def test_parse_mooring_rejects_bad_topologies():
+    import copy
+
+    moor = copy.deepcopy(OC3_MOORING)
+    # free point joining three lines (a bridle) is out of scope
+    moor["points"].append({"name": "Y", "type": "free",
+                           "location": [0.0, 0.0, -100.0]})
+    extra = [
+        {"name": "b1", "endA": moor["points"][0]["name"], "endB": "Y",
+         "type": moor["line_types"][0]["name"], "length": 300.0},
+        {"name": "b2", "endA": "Y", "endB": moor["points"][1]["name"],
+         "type": moor["line_types"][0]["name"], "length": 300.0},
+        {"name": "b3", "endA": "Y", "endB": moor["points"][2]["name"],
+         "type": moor["line_types"][0]["name"], "length": 300.0},
+    ]
+    moor["lines"] += extra
+    with pytest.raises(ValueError):
+        parse_mooring(moor, rho_water=1025.0)
