@@ -640,8 +640,19 @@ class Model:
         # raft_model.py:158-309) ----
         self._init_case_metrics(ncase, nLines)
         m = self.results["case_metrics"]
+        from raft_tpu.fatigue import dirlik_del
+
+        # S-N slopes for the fatigue channels (settings overridable):
+        # welded steel tower m=4, mooring chain m=3 (DNV-OS-E301 defaults)
+        settings = self.design.get("settings") or {}
+        m_tower = get_from_dict(settings, "wohler_exp_tower", default=4.0)
+        m_chain = get_from_dict(settings, "wohler_exp_mooring", default=3.0)
         for i in range(ncase):
             self._save_case_outputs(m, i, Xi0[i], Xi[i], zeta[i], cases[i])
+            # the reference zero-fills the DEL channels (raft_model.py:199);
+            # here they are real: Dirlik spectral rainflow on the response
+            # PSDs, 1 Hz reference cycle rate
+            m["Mbase_DEL"][i] = dirlik_del(m["Mbase_PSD"][i], self.w, m_tower)
             # mooring tension spectra: T_amps = J_moor @ Xi
             T_amps = J_moor[i] @ Xi[i]  # [2nL, nw]
             m["Tmoor_avg"][i] = T_moor[i]
@@ -650,6 +661,9 @@ class Model:
                 m["Tmoor_std"][i, iT] = TRMS
                 m["Tmoor_max"][i, iT] = T_moor[i, iT] + 3 * TRMS
                 m["Tmoor_PSD"][i, iT] = np.abs(T_amps[iT]) ** 2
+                m["Tmoor_DEL"][i, iT] = dirlik_del(
+                    m["Tmoor_PSD"][i, iT], self.w, m_chain
+                )
             if display:
                 self._print_case_stats(i, nLines)
 

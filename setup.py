@@ -32,7 +32,8 @@ setup(
     packages=["raft_tpu", "raft_tpu.io", "raft_tpu.utils"],
     package_data={"raft_tpu": ["native/*.cpp", "native/Makefile"]},
     python_requires=">=3.9",
-    install_requires=["numpy", "scipy", "pyyaml", "jax"],
+    # numpy>=2.0: np.trapezoid (raft_tpu/fatigue.py, tests)
+    install_requires=["numpy>=2.0", "scipy", "pyyaml", "jax"],
     extras_require={"viz": ["matplotlib"], "omdao": ["openmdao"]},
     cmdclass=cmdclass,
 )

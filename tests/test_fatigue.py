@@ -1,0 +1,71 @@
+"""Spectral fatigue (raft_tpu/fatigue.py): Dirlik rainflow DELs replacing
+the reference's zero-filled placeholders (reference raft/raft_model.py:199,
+:224)."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.fatigue import dirlik_del, narrow_band_del, spectral_moments
+
+
+def test_spectral_moments_white_band():
+    w = np.linspace(0.1, 2.0, 400)
+    S = np.ones_like(w)
+    m0, m1, m2, m4 = spectral_moments(S, w)
+    assert m0 == pytest.approx(1.9, rel=1e-6)
+    assert m1 == pytest.approx((2.0**2 - 0.1**2) / 2, rel=1e-5)
+    assert m2 == pytest.approx((2.0**3 - 0.1**3) / 3, rel=1e-4)
+
+
+def test_dirlik_matches_rayleigh_for_narrow_band():
+    """For a narrow-band Gaussian process the rainflow-range distribution
+    is Rayleigh; Dirlik must agree with the analytic narrow-band DEL to a
+    few percent (its documented accuracy)."""
+    w0, bw = 1.0, 0.02
+    w = np.linspace(0.5, 1.5, 4001)
+    S = np.exp(-0.5 * ((w - w0) / bw) ** 2)
+    for m_w in (3.0, 4.0, 5.0):
+        d_dk = dirlik_del(S, w, m_w)
+        d_nb = narrow_band_del(S, w, m_w)
+        assert d_dk == pytest.approx(d_nb, rel=0.05), m_w
+        assert d_dk > 0
+
+
+def test_dirlik_below_rayleigh_for_wide_band():
+    """Wide-band processes accumulate less rainflow damage than the
+    narrow-band bound (Rayleigh is conservative)."""
+    w = np.linspace(0.05, 3.0, 2000)
+    S = 1.0 / (1.0 + (w / 0.5) ** 4)       # broad low-pass spectrum
+    for m_w in (3.0, 4.0):
+        assert dirlik_del(S, w, m_w) < narrow_band_del(S, w, m_w)
+
+
+def test_dirlik_scaling_and_degenerate():
+    """DEL scales linearly with the load amplitude (S ~ amp^2) and an
+    empty spectrum gives 0."""
+    w = np.linspace(0.1, 2.0, 500)
+    S = np.exp(-((w - 0.8) ** 2) / 0.1)
+    d1 = dirlik_del(S, w, 4.0)
+    d2 = dirlik_del(4.0 * S, w, 4.0)       # amplitude x2 -> DEL x2
+    assert d2 == pytest.approx(2.0 * d1, rel=1e-9)
+    assert dirlik_del(np.zeros_like(w), w, 4.0) == 0.0
+
+
+def test_model_dels_populated():
+    """End-to-end: case metrics carry nonzero tower-base and mooring DELs
+    of plausible magnitude (same order as the std of the process)."""
+    from raft_tpu.designs import demo_semi
+    from raft_tpu.model import Model
+
+    design = demo_semi(n_cases=1, nw_settings=(0.05, 0.6))
+    m = Model(design)
+    m.analyze_unloaded()
+    m.analyze_cases()
+    cm = m.results["case_metrics"]
+    assert cm["Mbase_DEL"][0] > 0
+    assert (cm["Tmoor_DEL"][0] > 0).all()
+    # a damage-equivalent RANGE is of the order of a few standard
+    # deviations of the process
+    assert 0.5 * cm["Mbase_std"][0] < cm["Mbase_DEL"][0] < 20 * cm["Mbase_std"][0]
+    ratio = cm["Tmoor_DEL"][0] / np.maximum(cm["Tmoor_std"][0], 1e-9)
+    assert (ratio > 0.5).all() and (ratio < 20).all()
