@@ -135,7 +135,7 @@ def test_model_heading_interpolation_end_to_end():
     # magnitudes at 15 deg sit between the bracketing headings bin-wise
     lo = np.minimum(surge[0], surge[2])
     hi = np.maximum(surge[0], surge[2])
-    mask = hi > 1e3 * np.max(hi) * 1e-6      # skip numerically-empty bins
+    mask = hi > 1e-3 * np.max(hi)            # skip numerically-empty bins
     assert (surge[1][mask] >= lo[mask] - 1e-6 * hi[mask]).all()
     assert (surge[1][mask] <= hi[mask] + 1e-6 * hi[mask]).all()
     # and differ from both (a nearest-snap would equal one of them)
