@@ -283,6 +283,12 @@ def _solve_all(omegas, betas, x, nrm, area, y, w_q, S0, K0, vmodes, Ft, F1t,
 
 _solve_all_jit = None
 
+# frequency-independent Rankine matrices keyed by (mesh bytes, depth) —
+# raw bytes, not hash(), so distinct meshes can never collide; FIFO bound
+# by total byte budget (each entry is two [N,N] f64 matrices)
+_rankine_cache = {}
+_RANKINE_CACHE_BYTES = 256 * 1024 * 1024
+
 # Above this panel count the TPU LU custom-call exceeds its scoped-VMEM
 # budget (observed on v5e: clean compile failure at N=8126, runtime worker
 # crash at N=2900); solve_bem falls back to the CPU backend with a warning.
@@ -342,7 +348,22 @@ def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81,
     # the TPU LU lowering is real-only; CPU (and GPU) have complex LU,
     # which halves the solve flops and peak memory
     real_block = backend == "tpu"
-    S0, K0 = _rankine(pa, depth=depth)
+    # the frequency-independent Rankine assembly is ~0.6-0.8 s of host
+    # time per call at ~850 panels; repeated solves of the same mesh
+    # (preview + final, preprocess_hams after run_bem, benchmarks) reuse it
+    key = (np.asarray(panels, float).tobytes(), depth)
+    cached = _rankine_cache.get(key)
+    if cached is None:
+        cached = _rankine(pa, depth=depth)
+        new_bytes = cached[0].nbytes + cached[1].nbytes
+        while _rankine_cache and (
+            sum(v[0].nbytes + v[1].nbytes for v in _rankine_cache.values())
+            + new_bytes > _RANKINE_CACHE_BYTES
+        ):
+            _rankine_cache.pop(next(iter(_rankine_cache)))
+        if new_bytes <= _RANKINE_CACHE_BYTES:
+            _rankine_cache[key] = cached
+    S0, K0 = cached
     # the per-frequency wave term is smooth: "centroid" swaps only its
     # quadrature for a ~2.4x faster assembly loop
     pa_wave = pa if quad == "gauss" else panel_arrays(panels, quad=quad)
