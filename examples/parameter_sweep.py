@@ -45,6 +45,19 @@ def main():
     for i, d in enumerate(AXES["d_col"]):
         print(f"  d={d:5.1f}: " + "  ".join(f"{pitch[i,j]:9.4f}"
                                             for j in range(len(AXES["draft_scale"]))))
+
+    # contour-matrix figure (the reference's parametersweep plot style)
+    from raft_tpu.viz import plot_sweep_contours
+
+    try:
+        fig, _ = plot_sweep_contours(
+            res, AXES, ["mass", "displacement", "pitch_std_deg", "surge_std"]
+        )
+    except ImportError as exc:  # matplotlib optional (raised by _require_mpl)
+        print(f"(skipping contour figure: {exc})")
+        return res
+    fig.savefig("sweep_contours.png", dpi=120)
+    print("\nsaved sweep_contours.png")
     return res
 
 

@@ -354,14 +354,18 @@ def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81,
     key = (np.asarray(panels, float).tobytes(), depth)
     cached = _rankine_cache.get(key)
     if cached is None:
-        cached = _rankine(pa, depth=depth)
+        S0f, K0f = _rankine(pa, depth=depth)
+        # cache in f32 — the solver consumes f32 anyway, and it doubles
+        # how many meshes fit the byte budget
+        cached = (S0f.astype(np.float32), K0f.astype(np.float32))
         new_bytes = cached[0].nbytes + cached[1].nbytes
-        while _rankine_cache and (
-            sum(v[0].nbytes + v[1].nbytes for v in _rankine_cache.values())
-            + new_bytes > _RANKINE_CACHE_BYTES
-        ):
-            _rankine_cache.pop(next(iter(_rankine_cache)))
-        if new_bytes <= _RANKINE_CACHE_BYTES:
+        if new_bytes <= _RANKINE_CACHE_BYTES:  # else: too big, don't evict
+            while _rankine_cache and (
+                sum(v[0].nbytes + v[1].nbytes
+                    for v in _rankine_cache.values())
+                + new_bytes > _RANKINE_CACHE_BYTES
+            ):
+                _rankine_cache.pop(next(iter(_rankine_cache)))
             _rankine_cache[key] = cached
     S0, K0 = cached
     # the per-frequency wave term is smooth: "centroid" swaps only its

@@ -277,3 +277,59 @@ def plot_responses(model, channels=None):
     axes[-1].set_xlabel("frequency (Hz)")
     fig.tight_layout()
     return fig, axes
+
+
+def plot_sweep_contours(results, axes_dict, keys, case_index=0):
+    """Contour-plot matrix over a 2-D design sweep — the reference's
+    parametersweep figure style (reference raft/parametersweep.py:122-561
+    draws 4x4 matrices of contour plots over pairs of design variables).
+
+    results : dict from sweep.run_sweep (flat leading design axis)
+    axes_dict : {param_name: values} with exactly two parameters (the grid
+        the points were built from, as passed to sweep.grid_points)
+    keys : list of scalar result keys to draw, one contour panel each
+        (extra trailing axes, e.g. a case axis, are selected with
+        ``case_index``)
+
+    Returns (fig, axes array).
+    """
+    from raft_tpu.sweep import results_to_grid
+
+    plt = _require_mpl()
+    if len(axes_dict) != 2:
+        raise ValueError(
+            f"plot_sweep_contours needs exactly two swept parameters, "
+            f"got {list(axes_dict)}"
+        )
+    (nx_name, xs), (ny_name, ys) = axes_dict.items()
+    n = len(keys)
+    ncols = int(np.ceil(np.sqrt(n)))
+    nrows = int(np.ceil(n / ncols))
+    fig, axs = plt.subplots(
+        nrows, ncols, figsize=(4.2 * ncols, 3.4 * nrows), squeeze=False
+    )
+    X, Y = np.meshgrid(xs, ys, indexing="ij")
+    for k, key in enumerate(keys):
+        ax = axs[k // ncols][k % ncols]
+        Z = np.asarray(results_to_grid(results, axes_dict, key))
+        if Z.ndim > 2:
+            # select case_index on the LAST extra axis (the case axis by
+            # results layout), index 0 on any others; out-of-range raises
+            # rather than silently plotting a different slice
+            if case_index >= Z.shape[-1]:
+                raise IndexError(
+                    f"case_index {case_index} out of range for '{key}' "
+                    f"(last axis has {Z.shape[-1]} entries)"
+                )
+            Z = Z[..., case_index]
+            while Z.ndim > 2:
+                Z = Z[..., 0]
+        cs = ax.contourf(X, Y, Z, levels=12)
+        fig.colorbar(cs, ax=ax, shrink=0.9)
+        ax.set_title(key, fontsize=9)
+        ax.set_xlabel(nx_name, fontsize=8)
+        ax.set_ylabel(ny_name, fontsize=8)
+    for k in range(n, nrows * ncols):
+        axs[k // ncols][k % ncols].axis("off")
+    fig.tight_layout()
+    return fig, axs

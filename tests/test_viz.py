@@ -96,3 +96,27 @@ def test_plot_responses_smoke(analyzed_model):
     import matplotlib.pyplot as plt
 
     plt.close(fig)
+
+
+def test_plot_sweep_contours():
+    """Contour-matrix figure over a 2-D sweep (the reference's
+    parametersweep.py:122-561 plot style)."""
+    import matplotlib
+
+    matplotlib.use("Agg", force=True)
+    from raft_tpu.viz import plot_sweep_contours
+
+    axes = {"a": [1.0, 2.0, 3.0], "b": [10.0, 20.0]}
+    n = 6
+    res = {
+        "mass": np.arange(n, dtype=float),
+        "pitch": np.arange(n, dtype=float).reshape(n) ** 2,
+        "Xi": np.zeros((n, 6, 4)),        # extra axes are index-selected
+    }
+    fig, axs = plot_sweep_contours(res, axes, ["mass", "pitch"])
+    assert axs.shape == (1, 2)
+    import matplotlib.pyplot as plt
+
+    plt.close(fig)
+    with pytest.raises(ValueError):
+        plot_sweep_contours(res, {"a": [1], "b": [2], "c": [3]}, ["mass"])
