@@ -285,7 +285,7 @@ _solve_all_jit = None
 
 # frequency-independent Rankine matrices keyed by (mesh bytes, depth) —
 # raw bytes, not hash(), so distinct meshes can never collide; FIFO bound
-# by total byte budget (each entry is two [N,N] f64 matrices)
+# by total byte budget (each entry is two [N,N] f32 matrices)
 _rankine_cache = {}
 _RANKINE_CACHE_BYTES = 256 * 1024 * 1024
 
@@ -360,12 +360,11 @@ def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81,
         cached = (S0f.astype(np.float32), K0f.astype(np.float32))
         new_bytes = cached[0].nbytes + cached[1].nbytes
         if new_bytes <= _RANKINE_CACHE_BYTES:  # else: too big, don't evict
-            while _rankine_cache and (
-                sum(v[0].nbytes + v[1].nbytes
-                    for v in _rankine_cache.values())
-                + new_bytes > _RANKINE_CACHE_BYTES
-            ):
-                _rankine_cache.pop(next(iter(_rankine_cache)))
+            held = sum(v[0].nbytes + v[1].nbytes
+                       for v in _rankine_cache.values())
+            while _rankine_cache and held + new_bytes > _RANKINE_CACHE_BYTES:
+                old = _rankine_cache.pop(next(iter(_rankine_cache)))
+                held -= old[0].nbytes + old[1].nbytes
             _rankine_cache[key] = cached
     S0, K0 = cached
     # the per-frequency wave term is smooth: "centroid" swaps only its
