@@ -258,9 +258,20 @@ class Model:
         """Load potential-flow radiation/diffraction coefficients from
         WAMIT-format `.1`/`.3` files (the reference's pyHAMS output-reading
         path, raft/raft_fowt.py:394-406; also the WAMIT/Capytaine interop
-        route shown by tests/verification.py:240-254).  Members flagged
+        route shown by tests/verification.py:240-254), or from a Capytaine
+        NetCDF dataset when ``file1`` ends in ``.nc``.  Members flagged
         ``potMod`` are already excluded from strip-theory inertial terms via
         the packed ``strip_mask``."""
+        if str(file1).endswith(".nc"):
+            from raft_tpu.bem import read_capytaine_nc
+
+            if file3 is not None:
+                raise ValueError(
+                    "import_bem: a Capytaine .nc dataset carries both "
+                    "radiation and excitation data; no second file expected"
+                )
+            self.bem_coeffs = read_capytaine_nc(file1)
+            return self.bem_coeffs
         from raft_tpu.bem import read_coeffs
 
         self.bem_coeffs = read_coeffs(

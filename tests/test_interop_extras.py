@@ -65,6 +65,17 @@ class TestCapytaineImport:
         c_dif = read_capytaine_nc(CAPY_NC, excitation="diffraction")
         assert not np.allclose(c_tot.X, c_dif.X)
 
+    def test_model_import_bem_nc_route(self):
+        """Model.import_bem dispatches .nc paths to the Capytaine reader."""
+        from raft_tpu.designs import deep_spar
+        from raft_tpu.model import Model
+
+        m = Model(deep_spar(n_cases=1, nw_settings=(0.05, 0.5)))
+        c = m.import_bem(CAPY_NC)
+        assert m.bem_coeffs is c and c.A.shape == (28, 6, 6)
+        with pytest.raises(ValueError, match="second file"):
+            m.import_bem(CAPY_NC, "something.3")
+
     def test_usable_in_model_pipeline(self):
         """Imported Capytaine coefficients drive the case solve like any
         WAMIT import."""
