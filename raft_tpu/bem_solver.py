@@ -24,6 +24,15 @@ one-shot meshes (the graph specializes on the mesh shape; see solve_bem):
 Time convention matches the reference (e^{+i w t}; impedance
 Z = -w^2 M + i w B + C, reference raft/raft_model.py:585-590), so the wave
 term uses the conjugate (outgoing H0^(2)) branch of the tabulated kernel.
+
+Known limitation: irregular frequencies are NOT removed (HAMS exposes
+If_remove_irr_freq; here a rigid-lid variant was prototyped and rejected —
+it suppressed the glitch but introduced placement-sensitive 1-10% errors
+nearby).  For a surface-piercing column of waterline radius a the first
+glitches sit near nu*a ~ 2.4 (heave) and 3.83 (surge) — e.g. ~2.0 and
+~2.5 rad/s for a 12 m column — above the wave band RAFT models resolve
+and near/above the mesh-resolution frequency cap (max_resolved_omega),
+which clamps the solve before the deep irregular region.
 Finite water depth (the depth HAMS receives in its control file, reference
 raft/raft_fowt.py:367-381) is handled as deep water + John's finite-depth
 difference: a seabed-image Rankine term plus an exponentially-decaying
