@@ -35,7 +35,8 @@ def test_cli_runs_full_analysis(tmp_path):
         os.path.abspath(__file__)))
     env["JAX_PLATFORMS"] = "cpu"   # subprocess runs headless on CPU
     out = subprocess.run(
-        [sys.executable, "-m", "raft_tpu", path, "--precision", "float64"],
+        [sys.executable, "-m", "raft_tpu", path, "--precision", "float64",
+         "--device", "cpu"],
         capture_output=True, text=True, timeout=560, env=env,
         cwd=str(tmp_path),
     )

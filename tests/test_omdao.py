@@ -188,6 +188,14 @@ def test_properties_match(both_paths):
     )
 
 
+def test_del_outputs_populated(both_paths):
+    """The component's DEL outputs carry the real Dirlik values (the
+    reference zero-fills them, raft_model.py:199/:224)."""
+    comp, model, results = both_paths
+    assert (np.asarray(comp.get_val("stats_Mbase_DEL")) > 0).all()
+    assert (np.asarray(comp.get_val("stats_Tmoor_DEL")) > 0).all()
+
+
 def test_response_match(both_paths):
     comp, model, results = both_paths
     r = results["response"]
