@@ -98,7 +98,10 @@ def test_oc4semi_native_bem_vs_marin_wamit():
     tapered base columns, honoring the design's own per-member potMod
     flags.  Measured agreement: added mass <= 3.0% (surge/heave/roll),
     surge damping <= 2.1% where it is significant; asserted at 3.5% / 10%
-    (round-1 verdict target <=3%/<=10%)."""
+    (round-1 verdict target <=3%/<=10%).  The residual ~3% is
+    mesh-converged (dz 3->2 m changes A22 by <0.4% and not toward the
+    data): the design's potMod flags panel only the 4 columns while the
+    MARIN coefficients include the 16 cross braces."""
     if not os.path.exists(MARIN1):
         pytest.skip("marin_semi.1 not mounted")
     from raft_tpu.bem import read_wamit_1
