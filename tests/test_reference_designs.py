@@ -159,6 +159,25 @@ def test_oc3_native_excitation_vs_spar3():
             )
 
 
+def test_volturnus_native_bem_mixed_geometry():
+    """Native panel solver on the full VolturnUS-S hull (potModMaster=2):
+    three circular columns + rectangular pontoons in one mesh — physically
+    sane coefficients (surge added mass of order rho*V, vanishing
+    low-frequency damping, finite excitation)."""
+    d = load_design(os.path.join(DESIGNS, "VolturnUS-S.yaml"))
+    d["turbine"]["aeroServoMod"] = 0
+    d["platform"]["potModMaster"] = 2
+    m = Model(d)
+    coeffs = m.run_bem(nw_bem=3, dz_max=4.0, da_max=4.0)
+    assert np.isfinite(coeffs.A).all() and np.isfinite(coeffs.X).all()
+    rhoV = 1025.0 * 20206.0          # published displacement ~20206 m^3
+    assert 0.6 < coeffs.A[0, 0, 0] / rhoV < 1.6
+    assert 0.3 < coeffs.A[0, 2, 2] / rhoV < 1.2
+    # radiation damping vanishes toward w -> 0 and is positive mid-band
+    assert abs(coeffs.B[0, 0, 0]) < 1e-3 * coeffs.B[1, 0, 0]
+    assert coeffs.B[1, 0, 0] > 0
+
+
 def test_volturnus_aero_servo_case():
     """Full aero-servo path (aeroServoMod=2, operating wind): mean rotor
     loads tilt the platform, the hub added-mass/damping matrices enter the
