@@ -165,6 +165,15 @@ def write_wamit_3(path, coeffs, rho=1025.0, g=9.81):
         raise ValueError("coefficient set has no excitation data to write")
     if coeffs.headings is None:
         if coeffs.X.ndim == 3 and coeffs.X.shape[1] == 1:
+            import warnings
+
+            warnings.warn(
+                "write_wamit_3: coefficient set has a single-heading "
+                "excitation column but no headings array; labeling it "
+                "0.0 deg — set coeffs.headings explicitly if the data "
+                "was solved at a different heading",
+                stacklevel=2,
+            )
             headings = np.array([0.0])
         else:
             raise ValueError(
@@ -229,11 +238,15 @@ def read_capytaine_nc(path, w_des=None, excitation="total"):
         the tabulated range (the reference integration's contract,
         reference tests/test_capytaine_integration.py:31-34).
     excitation : 'total' (Froude-Krylov + diffraction, the physical
-        excitation in current Capytaine datasets) or 'diffraction' (the
-        raw diffraction_force field alone — what the reference's removed
-        integration consumed as fEx; its golden arrays match this field
-        bit-exactly, consistent with a dataset generation where that
-        field held the total exciting force).
+        excitation in current Capytaine datasets — **conjugated on
+        import** from Capytaine's e^{-i w t} time convention to this
+        package's e^{+i w t} convention so phases feed the complex
+        impedance solve Z = -w^2 M + i w B + C correctly) or
+        'diffraction' (the raw diffraction_force field alone, passed
+        through unconjugated — reference-compat ONLY: what the
+        reference's removed integration consumed as fEx; its golden
+        arrays match this raw field bit-exactly, so this path exists to
+        reproduce them, not to drive response solves).
     """
     from scipy.io import netcdf_file
 
@@ -249,7 +262,8 @@ def read_capytaine_nc(path, w_des=None, excitation="total"):
         diff = np.asarray(f.variables["diffraction_force"][:], float)
         fk = np.asarray(f.variables["Froude_Krylov_force"][:], float)
         if excitation == "total":
-            X = (diff[0] + fk[0]) + 1j * (diff[1] + fk[1])  # [w, ndir, 6]
+            # conjugate: Capytaine e^{-iwt} -> package e^{+iwt}
+            X = (diff[0] + fk[0]) - 1j * (diff[1] + fk[1])  # [w, ndir, 6]
         elif excitation == "diffraction":
             X = diff[0] + 1j * diff[1]
         else:

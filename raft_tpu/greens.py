@@ -229,7 +229,8 @@ def dispersion_k0(nu, h, iters=30):
 
 # exact half-line remainder of the Gaussian pole subtraction with
 # sigma = a/3:  PV int_0^inf exp(-((k-a)/sigma)^2)/(k-a) dk = E1(9)/2
-_PV_TAIL = 6.158835e-06
+# = scipy.special.exp1(9)/2
+_PV_TAIL = 6.2236771e-06
 
 
 def finite_depth_correction(nu, k0, h, R, zi, zj, kmax_geom,

@@ -69,7 +69,8 @@ def test_wamit3_headings_none(tmp_path):
     X1 = np.ones((2, 1, 6)) * (1 + 1j)
     coeffs = HydroCoeffs(w=w, A=None, B=None, headings=None, X=X1)
     p = str(tmp_path / "one.3")
-    write_wamit_3(p, coeffs)
+    with pytest.warns(UserWarning, match="labeling it 0.0 deg"):
+        write_wamit_3(p, coeffs)
     _, h2, _ = read_wamit_3(p)
     np.testing.assert_allclose(h2, [0.0])
 
