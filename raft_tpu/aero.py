@@ -368,10 +368,51 @@ def rotor_evaluate(Uinf, Omega, pitch, geom, polars, env, nSector=4):
     }
 
 
+# ------------------------------------------------------- servo transfer fns
+
+def servo_transfer_terms(w, dT_dU, dT_dOm, dT_dPi, dQ_dU, dQ_dOm, dQ_dPi,
+                         kp_beta, ki_beta, kp_tau, ki_tau,
+                         k_float, Ng, I_drivetrain, Zhub):
+    """Closed-loop aero-servo transfer functions (the reference's control
+    branch, raft/raft_rotor.py:388-432), vectorized over arbitrary shared
+    leading axes of the derivative/gain arguments — the design-sweep path
+    evaluates all (design x case) operating points in one broadcast call.
+
+    w : [nw]; every other argument broadcastable to a common leading shape.
+    Returns (C, c_exc, a_aero, b_aero), each [..., nw]; the wind excitation
+    is ``f_aero = c_exc * V_w`` with the case's rotor-averaged turbulence
+    amplitude V_w.
+    """
+    e = lambda x: np.asarray(x, float)[..., None]  # noqa: E731
+    dT_dU, dT_dOm, dT_dPi = e(dT_dU), e(dT_dOm), e(dT_dPi)
+    dQ_dU, dQ_dOm, dQ_dPi = e(dQ_dU), e(dQ_dOm), e(dQ_dPi)
+    kp_beta, ki_beta = e(kp_beta), e(ki_beta)
+    kp_tau, ki_tau = e(kp_tau), e(ki_tau)
+
+    D = (
+        I_drivetrain * w**2
+        + (dQ_dOm + kp_beta * dQ_dPi - Ng * kp_tau) * 1j * w
+        + ki_beta * dQ_dPi
+        - Ng * ki_tau
+    )
+    C = 1j * w * (dQ_dU - k_float * dQ_dPi / Zhub) / D
+    H_QT = (
+        (dT_dOm + kp_beta * dT_dPi) * 1j * w + ki_beta * dT_dPi
+    ) / D
+    c_exc = dT_dU - H_QT * dQ_dU
+    resp = (
+        dT_dU - k_float * dT_dPi - H_QT * (dQ_dU - k_float * dQ_dPi)
+    )
+    b_aero = np.real(resp)
+    a_aero = np.real(resp / (1j * w))
+    return C, c_exc, a_aero, b_aero
+
+
 # ---------------------------------------------------------------- Rotor
 
 # compiled loads+derivatives executables shared across Rotor instances with
-# identical configuration (keyed by the raw geometry/polar bytes)
+# identical configuration (keyed by the raw geometry/polar bytes); each
+# entry holds (single-point executable, vmapped batch executable)
 _rotor_eval_cache = {}
 
 
@@ -438,8 +479,8 @@ class Rotor:
             )),
             tuple(sorted(self.env.items())),
         )
-        self._eval = _rotor_eval_cache.get(key)
-        if self._eval is None:
+        cached = _rotor_eval_cache.get(key)
+        if cached is None:
             geom = {
                 k: (put_cpu(v) if isinstance(v, jnp.ndarray) else v)
                 for k, v in self.geom.items()
@@ -464,8 +505,12 @@ class Rotor:
                 )  # [10 outputs, 3 inputs]
                 return vals, JT
 
-            self._eval = jax.jit(loads_and_derivs)
-            _rotor_eval_cache[key] = self._eval
+            cached = (
+                jax.jit(loads_and_derivs),
+                jax.jit(jax.vmap(loads_and_derivs)),
+            )
+            _rotor_eval_cache[key] = cached
+        self._eval, self._eval_batch = cached
 
     # -------------------------------------------------------------- control
 
@@ -487,6 +532,17 @@ class Rotor:
         self.kp_tau = -turbine["torque_control"]["VS_KP"]
         self.ki_tau = -turbine["torque_control"]["VS_KI"]
         self.Ng = turbine["gear_ratio"]
+
+    def case_gains(self, Uinf):
+        """Gain-schedule values at wind speed(s) ``Uinf``, including the
+        reference's ki_tau-assigned-from-kp_tau quirk (raft_rotor.py:375).
+        Broadcasts over array-valued Uinf.  Returns
+        (kp_beta, ki_beta, kp_tau, ki_tau)."""
+        kp_beta = -np.interp(Uinf, self.Uhub, self.kp_0)
+        ki_beta = -np.interp(Uinf, self.Uhub, self.ki_0)
+        kp_tau = self.kp_tau * (kp_beta == 0)
+        ki_tau = self.kp_tau * (kp_beta == 0)
+        return kp_beta, ki_beta, kp_tau, ki_tau
 
     # -------------------------------------------------------------- BEM
 
@@ -526,6 +582,35 @@ class Rotor:
         )
         return loads, derivs
 
+    def run_bem_batch(self, Uhub, ptfm_pitch, yaw_misalign=None):
+        """Batched steady loads + SI derivatives over a leading lane axis —
+        the design sweep's second-pass rotor evaluation (one vmapped
+        compiled CPU call instead of one serial :meth:`run_bem` per design
+        x case; the reference re-runs CCBlade per sweep point,
+        raft/parametersweep.py:56-100 via runRAFT -> raft_model.py:516-517).
+
+        Uhub, ptfm_pitch, yaw_misalign : broadcastable arrays [nt]
+        Returns (vals [nt, 10], J [nt, 10, 3]) with the same layout as
+        :meth:`run_bem`'s stacked outputs, derivatives already SI.
+        """
+        Uhub = np.atleast_1d(np.asarray(Uhub, np.float64))
+        ptfm_pitch = np.broadcast_to(
+            np.asarray(ptfm_pitch, np.float64), Uhub.shape
+        )
+        yaw = np.zeros_like(Uhub) if yaw_misalign is None else np.broadcast_to(
+            np.asarray(yaw_misalign, np.float64), Uhub.shape
+        )
+        Omega_rpm = np.interp(Uhub, self.Uhub, self.Omega_rpm)
+        pitch_deg = np.interp(Uhub, self.Uhub, self.pitch_deg)
+        tilt = np.deg2rad(self.shaft_tilt) + ptfm_pitch
+
+        vals, J = self._eval_batch(
+            put_cpu(Uhub), put_cpu(Omega_rpm * np.pi / 30.0),
+            put_cpu(np.deg2rad(pitch_deg)), put_cpu(tilt),
+            put_cpu(np.deg2rad(yaw)),
+        )
+        return np.asarray(vals), np.asarray(J)
+
     # ---------------------------------------------------- aero-servo terms
 
     def calc_aero_servo_contributions(self, case, ptfm_pitch=0.0):
@@ -563,35 +648,14 @@ class Rotor:
             f_aero = dT_dU * self.V_w
             self.C = np.zeros_like(w, dtype=complex)
         elif self.aeroServoMod == 2:
-            self.kp_beta = -np.interp(Uinf, self.Uhub, self.kp_0)
-            self.ki_beta = -np.interp(Uinf, self.Uhub, self.ki_0)
-            # reference quirk: ki_tau assigned from kp_tau (raft_rotor.py:375)
-            kp_tau = self.kp_tau * (self.kp_beta == 0)
-            ki_tau = self.kp_tau * (self.kp_beta == 0)
+            self.kp_beta, self.ki_beta, kp_tau, ki_tau = self.case_gains(Uinf)
 
-            D = (
-                self.I_drivetrain * w**2
-                + (dQ_dOm + self.kp_beta * dQ_dPi - self.Ng * kp_tau) * 1j * w
-                + self.ki_beta * dQ_dPi
-                - self.Ng * ki_tau
+            self.C, self.c_exc, a_aero, b_aero = servo_transfer_terms(
+                w, dT_dU, dT_dOm, dT_dPi, dQ_dU, dQ_dOm, dQ_dPi,
+                self.kp_beta, self.ki_beta, kp_tau, ki_tau,
+                self.k_float, self.Ng, self.I_drivetrain, self.Zhub,
             )
-            self.C = 1j * w * (dQ_dU - self.k_float * dQ_dPi / self.Zhub) / D
-
-            H_QT = (
-                (dT_dOm + self.kp_beta * dT_dPi) * 1j * w
-                + self.ki_beta * dT_dPi
-            ) / D
-            self.c_exc = dT_dU - H_QT * dQ_dU
-
-            f_aero = (dT_dU - H_QT * dQ_dU) * self.V_w
-            b_aero = np.real(
-                dT_dU - self.k_float * dT_dPi
-                - H_QT * (dQ_dU - self.k_float * dQ_dPi)
-            )
-            a_aero = np.real(
-                (dT_dU - self.k_float * dT_dPi
-                 - H_QT * (dQ_dU - self.k_float * dQ_dPi)) / (1j * w)
-            )
+            f_aero = self.c_exc * self.V_w
         else:
             raise ValueError(f"aeroServoMod={self.aeroServoMod} not supported here")
 

@@ -424,6 +424,24 @@ class Model:
             )
         )
 
+    def aero_case_means(self, cases, wind, ptfm_pitch=0.0):
+        """Per-case mean rotor loads at the PRP at a given platform pitch
+        (the reference's first calcTurbineConstants pass,
+        raft/raft_model.py:504-513); zero rows for wind-free cases or aero
+        off.  Shared by prepare_case_inputs and the fused sweep's
+        design-independent first pass (sweep_fused.py)."""
+        rHub = np.array([0.0, 0.0, self.hHub])
+        F = np.zeros((len(cases), 6))
+        if self.rotor is None or self.aeroServoMod <= 0:
+            return F
+        for i, case in enumerate(cases):
+            if wind[i] > 0.0:
+                F0_hub, _, _, _ = self.rotor.calc_aero_servo_contributions(
+                    case, ptfm_pitch=ptfm_pitch
+                )
+                F[i] = np.asarray(transform_force(F0_hub, offset=rHub))
+        return F
+
     def case_pipeline_fn(self, checkable=False, wrap=None):
         """The (un-jitted) batched device function for the case dynamics:
         (zeta[nc,nw], beta[nc], C_lin[nc,6,6], M_lin[nc,nw,6,6],
@@ -476,17 +494,11 @@ class Model:
         # ---- per-case aero means at zero platform pitch
         # (reference solveStatics first pass, raft_model.py:504-513) ----
         rHub = np.array([0.0, 0.0, self.hHub])
-        F_aero0 = np.zeros((ncase, 6))
         aero_on = (
             self.rotor is not None
             and self.aeroServoMod > 0
         )
-        for i, case in enumerate(cases):
-            if aero_on and wind[i] > 0.0:
-                F0_hub, _, _, _ = self.rotor.calc_aero_servo_contributions(
-                    case, ptfm_pitch=0.0
-                )
-                F_aero0[i] = np.asarray(transform_force(F0_hub, offset=rHub))
+        F_aero0 = self.aero_case_means(cases, wind)
 
         # ---- mean offsets & linearized mooring, all cases in one jitted
         # vmapped CPU f64 call ----

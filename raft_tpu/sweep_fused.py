@@ -18,8 +18,14 @@ a draft x ballast study of VolturnUS-S):
    rounding), so two `compute_statics` evaluations per draft (fill scale 0
    and 1) give every ballast point by linear combination — 32 statics
    evaluations cover all 256 designs;
- - **mooring**: all designs x cases solved in ONE vmapped f64 CPU call
-   (implicit-diff catenary, mooring.case_mooring_design_batch_fn);
+ - **aero-servo** (operating-wind cases, aeroServoMod 1/2): the zero-pitch
+   first pass is design-independent -> one rotor evaluation per case; the
+   second pass at each design's mean pitch is ONE vmapped compiled CPU
+   call over (design x wind-case) lanes, and the hub a(w)/b(w) terms enter
+   the device graph as rank-1 frequency profiles (a * P_hub);
+ - **mooring**: all designs x distinct-mean-load cases solved in ONE
+   vmapped f64 CPU call (implicit-diff catenary,
+   mooring.case_mooring_design_batch_fn);
  - **dynamics**: all designs x cases x frequencies in ONE jitted TPU
    dispatch — `lax.map` over draft groups (bounds live memory) around
    `vmap` over (draft-in-group, ballast, case), with response statistics
@@ -120,6 +126,65 @@ def _prepare_draft(base_design, s, rho_water, g):
     )
 
 
+def _aero_second_pass(model0, cases, wind, pitch_mean):
+    """Second-pass rotor loads + aero-servo transfer terms at each design's
+    mean platform pitch: ONE vmapped compiled CPU call over (design x
+    wind-case) lanes plus broadcast transfer-function algebra (the
+    reference re-runs CCBlade serially per sweep point,
+    raft/raft_model.py:516-517 inside parametersweep.py:56-100's loop).
+
+    pitch_mean : [nd, nc] mean platform pitch (rad) per design x case.
+    Returns (a [nd, nc, nw], b [nd, nc, nw], F_aero0 [nd, nc, 6] at PRP).
+    """
+    from raft_tpu.aero import servo_transfer_terms
+    from raft_tpu.utils.frames import transform_force
+
+    rotor = model0.rotor
+    nd, nc = pitch_mean.shape
+    nw = model0.nw
+    a = np.zeros((nd, nc, nw))
+    b = np.zeros((nd, nc, nw))
+    F0 = np.zeros((nd, nc, 6))
+    widx = np.where(wind > 0.0)[0]
+    if len(widx) == 0 or rotor is None:
+        return a, b, F0
+    nwind = len(widx)
+    U = np.broadcast_to(wind[widx][None], (nd, nwind))
+    yaw = np.array(
+        [float(cases[i].get("yaw_misalign", 0.0)) for i in widx]
+    )
+    vals, J = rotor.run_bem_batch(
+        U.ravel(), pitch_mean[:, widx].ravel(),
+        np.broadcast_to(yaw[None], (nd, nwind)).ravel(),
+    )
+    vals = vals.reshape(nd, nwind, 10)
+    J = J.reshape(nd, nwind, 10, 3)
+
+    # mean hub loads with the reference's ordering quirk [T, Y, Z, My, Q, Mz]
+    # (raft/raft_rotor.py:350-351), shifted to the PRP
+    F_hub = np.stack(
+        [vals[..., 0], vals[..., 6], vals[..., 7],
+         vals[..., 8], vals[..., 1], vals[..., 9]], axis=-1,
+    )
+    rHub = np.array([0.0, 0.0, model0.hHub])
+    F0[:, widx] = np.asarray(transform_force(F_hub, offset=rHub))
+
+    dT_dU, dT_dOm, dT_dPi = J[..., 0, 0], J[..., 0, 1], J[..., 0, 2]
+    dQ_dU, dQ_dOm, dQ_dPi = J[..., 1, 0], J[..., 1, 1], J[..., 1, 2]
+    if model0.aeroServoMod == 1:
+        b[:, widx] = dT_dU[..., None]
+    else:
+        kp_beta, ki_beta, kp_tau, ki_tau = rotor.case_gains(wind[widx])
+        _, _, a_w, b_w = servo_transfer_terms(
+            model0.w, dT_dU, dT_dOm, dT_dPi, dQ_dU, dQ_dOm, dQ_dPi,
+            kp_beta, ki_beta, kp_tau, ki_tau,
+            rotor.k_float, rotor.Ng, rotor.I_drivetrain, rotor.Zhub,
+        )
+        a[:, widx] = a_w
+        b[:, widx] = b_w
+    return a, b, F0
+
+
 def _ballast_combine(v, b):
     """Statics for the full ballast axis of one draft variant by linear
     combination (b : [nB] ballast density scales).
@@ -143,16 +208,26 @@ def _dynamics_pipeline(model0, return_xi):
         float(model0.depth), float(model0.rho_water), float(model0.g),
         float(model0.XiStart), int(model0.nIter),
         np.dtype(model0.dtype).name, np.dtype(model0.cdtype).name,
-        bool(return_xi),
+        float(model0.hHub), bool(return_xi),
     )
 
 
 @lru_cache(maxsize=16)
 def _dynamics_pipeline_cached(w_bytes, k_bytes, nw, depth, rho, g,
                               XiStart, nIter, dtype_name, cdtype_name,
-                              return_xi):
+                              hHub, return_xi):
     """Build the jitted sweep pipeline: lax.map over draft groups, vmap
-    over (draft-in-group, ballast, case)."""
+    over (draft-in-group, ballast, case).
+
+    The per-(design, case) aero-servo hub terms enter as rank-1 frequency
+    profiles: M_lin(w) = M0 + a(w) * P_hub and B_lin(w) = b(w) * P_hub,
+    where P_hub is the constant 6x6 pattern of a unit fore-aft hub added
+    mass translated to the PRP (translate_matrix_3to6 is linear in its 3x3
+    argument, so the full [nw,6,6] hub matrices never leave the device
+    graph; the reference assembles them on host per case,
+    raft/raft_model.py:552-555)."""
+    from raft_tpu.utils.frames import translate_matrix_3to6
+
     dtype = np.dtype(dtype_name).type
     cdtype = np.dtype(cdtype_name).type
     w = np.frombuffer(w_bytes, np.float64, count=nw)
@@ -161,31 +236,39 @@ def _dynamics_pipeline_cached(w_bytes, k_bytes, nw, depth, rho, g,
     one_case = make_case_dynamics(
         w, k, depth, rho, g, XiStart, nIter, dtype, cdtype,
     )
+    E00 = np.zeros((1, 3, 3))
+    E00[0, 0, 0] = 1.0
+    P_hub = jnp.asarray(
+        np.asarray(translate_matrix_3to6(E00, np.array([0.0, 0.0, hHub])))[0],
+        dtype,
+    )
 
-    def per_design(nodes, zeta, beta, C_case, M0):
-        M_lin = jnp.broadcast_to(M0[None], (nw, 6, 6))
-        B_lin = jnp.zeros((nw, 6, 6), dtype)
+    def per_design(nodes, zeta, beta, C_case, M0, a_c, b_c):
         Fz = jnp.zeros((nw, 6), dtype)
 
-        def fn(z, b, C):
+        def fn(z, b, C, a1, b1):
+            M_lin = M0[None] + a1[:, None, None] * P_hub
+            B_lin = b1[:, None, None] * P_hub
             return one_case(nodes, z, b, C, M_lin, B_lin, Fz, Fz)
 
-        xr, xi, iters, conv = jax.vmap(fn)(zeta, beta, C_case)  # [nc, ...]
+        xr, xi, iters, conv = jax.vmap(fn)(
+            zeta, beta, C_case, a_c, b_c
+        )  # [nc, ...]
         std = jnp.sqrt(jnp.sum(xr * xr + xi * xi, axis=-1) * dw)  # [nc, 6]
         if return_xi:
             return std, iters, conv, xr, xi
         return std, iters, conv
 
     # [gd, nB] design axes inside a group; nodes shared along ballast
-    per_draft = jax.vmap(per_design, in_axes=(None, None, None, 0, 0))
-    per_group = jax.vmap(per_draft, in_axes=(0, None, None, 0, 0))
+    per_draft = jax.vmap(per_design, in_axes=(None, None, None, 0, 0, 0, 0))
+    per_group = jax.vmap(per_draft, in_axes=(0, None, None, 0, 0, 0, 0))
 
-    def pipeline(nodes_g, zeta, beta, C_g, M0_g):
+    def pipeline(nodes_g, zeta, beta, C_g, M0_g, a_g, b_g):
         def step(xs):
-            nodes, C, M0 = xs
-            return per_group(nodes, zeta, beta, C, M0)
+            nodes, C, M0, a_c, b_c = xs
+            return per_group(nodes, zeta, beta, C, M0, a_c, b_c)
 
-        return jax.lax.map(step, (nodes_g, C_g, M0_g))
+        return jax.lax.map(step, (nodes_g, C_g, M0_g, a_g, b_g))
 
     return jax.jit(pipeline)
 
@@ -204,9 +287,12 @@ def run_draft_ballast_sweep(
     Parameters
     ----------
     base_design : dict
-        VolturnUS-S-style design (must have a cases table; aero enters only
-        through precomputed means, so for the benchmark configuration the
-        cases are wind-free like the headline RAO metric).
+        VolturnUS-S-style design (must have a cases table).  Operating-wind
+        cases run the full aero-servo path (aeroServoMod 1/2): per-case
+        mean rotor loads feed the mooring equilibria, and each design's
+        mean-pitch rotor re-evaluation contributes hub added mass a(w) and
+        damping b(w) to the dynamics — matching the reference sweep, which
+        runs the complete model per point (raft/parametersweep.py:56-100).
     draft_scales : [nD] multipliers on submerged member depths.
     ballast_scales : [nB] multipliers on ballast fill density.
     draft_group : drafts per lax.map step (bounds device memory:
@@ -224,17 +310,15 @@ def run_draft_ballast_sweep(
     if nD % draft_group:
         raise ValueError("len(draft_scales) must be divisible by draft_group")
 
-    spec, height, period, beta, wind = model0._case_arrays(
-        cases_as_dicts(base_design)
-    )
-    if np.any(wind > 0.0):
-        raise ValueError(
-            "fused sweep expects wind-free cases (aero means enter the "
-            "mooring stage as external loads; wire F_aero0 here when "
-            "sweeping wind cases)"
-        )
+    cases = cases_as_dicts(base_design)
+    spec, height, period, beta, wind = model0._case_arrays(cases)
     zeta = model0._zeta(spec, height, period)              # [nc, nw] f64
     nc = zeta.shape[0]
+    aero_on = (
+        model0.rotor is not None
+        and model0.aeroServoMod > 0
+        and bool(np.any(wind > 0.0))
+    )
 
     # ---- host prep: one variant per draft, ballast by linearity ----
     t0 = time.perf_counter()
@@ -246,7 +330,21 @@ def run_draft_ballast_sweep(
     comb = [_ballast_combine(v, b) for v in variants]
     t_host = time.perf_counter() - t0
 
-    # ---- mooring: all designs x cases in one f64 CPU call ----
+    # ---- aero first pass: per-case mean loads at zero pitch ----
+    # (design-independent, so one rotor evaluation per case serves the
+    # whole sweep; the reference re-runs it per point)
+    t0 = time.perf_counter()
+    F_prp = (
+        model0.aero_case_means(cases, wind)
+        if aero_on else np.zeros((nc, 6))
+    )
+    t_aero1 = time.perf_counter() - t0
+
+    # ---- mooring: all designs x distinct-mean-load cases in one f64 CPU
+    # call.  Cases sharing the same mean load (all wind-free cases, and
+    # repeated wind speeds) collapse to one equilibrium per design; the
+    # NumPy baseline in bench_sweep.py applies the same collapse, so the
+    # timed comparison stays symmetric. ----
     t0 = time.perf_counter()
     moor_fn = case_mooring_design_batch_fn(
         model0.rho_water, model0.g, model0.yawstiff
@@ -262,18 +360,32 @@ def run_draft_ballast_sweep(
     moor_all = tuple(
         rep(np.stack([v.moor[i] for v in variants])) for i in range(6)
     )
-    # wind-free cases all share zero mean load, so one equilibrium per
-    # design suffices; results broadcast across the case axis (the NumPy
-    # baseline in bench_sweep.py applies the same collapse, so the timed
-    # comparison stays symmetric)
-    F0 = np.zeros((nd, 1, 6))
+    groups = {}
+    inv = np.zeros(nc, int)
+    for i in range(nc):
+        inv[i] = groups.setdefault(F_prp[i].tobytes(), len(groups))
+    ng = len(groups)
+    F0g = np.zeros((ng, 6))
+    for i in range(nc):
+        F0g[inv[i]] = F_prp[i]
+    F0 = np.broadcast_to(F0g[None], (nd, ng, 6)).copy()
     out = moor_fn(*put_cpu((F0, mass_all, V_all, rCG_all, rM_all, AWP_all))
                   , *put_cpu(moor_all))
-    bcast = lambda a: np.broadcast_to(  # noqa: E731
-        np.asarray(a), (a.shape[0], nc) + a.shape[2:]
-    ).copy()
-    r6, C_moor, F_moor, T_moor, J_moor = (bcast(np.asarray(o)) for o in out)
+    expand = lambda a: np.asarray(a)[:, inv].copy()  # noqa: E731
+    r6, C_moor, F_moor, T_moor, J_moor = (expand(o) for o in out)
     t_moor = time.perf_counter() - t0
+
+    # ---- aero second pass at the mean platform pitch of every design ----
+    t0 = time.perf_counter()
+    if aero_on:
+        a_hub, b_hub, F_aero2 = _aero_second_pass(
+            model0, cases, wind, r6[:, :, 4]
+        )
+    else:
+        a_hub = np.zeros((nd, nc, model0.nw))
+        b_hub = np.zeros((nd, nc, model0.nw))
+        F_aero2 = np.zeros((nd, nc, 6))
+    t_aero2 = time.perf_counter() - t0
 
     # ---- dynamics: one jitted TPU dispatch ----
     dtype = model0.dtype
@@ -298,6 +410,8 @@ def run_draft_ballast_sweep(
         jnp.asarray(np.asarray(beta, dtype)),
         jnp.asarray(shp(C_lin.astype(dtype))),
         jnp.asarray(shp(M0_all.astype(dtype))),
+        jnp.asarray(shp(a_hub.reshape(nD, nB, nc, model0.nw).astype(dtype))),
+        jnp.asarray(shp(b_hub.reshape(nD, nB, nc, model0.nw).astype(dtype))),
     )
     t0 = time.perf_counter()
     dyn = pipeline(*dev_args)
@@ -311,6 +425,12 @@ def run_draft_ballast_sweep(
     # reference raft/parametersweep.py:9-21) ----
     offset = np.hypot(r6[:, 0, 0], r6[:, 0, 1])
     pitch = np.rad2deg(r6[:, 0, 4])
+    # omdao-style aggregates (omdao.py:728-733): per-case mean + 3*std
+    # maxima, incl. the reference's sway_max-from-heave_std quirk
+    # (raft_fowt.py:716), then the max over cases
+    surge_max = r6[:, :, 0] + 3.0 * std[:, :, 0]           # [nd, nc]
+    sway_max = r6[:, :, 1] + 3.0 * std[:, :, 2]
+    pitch_max = np.rad2deg(r6[:, :, 4] + 3.0 * std[:, :, 4])
     res = {
         "draft_scales": np.asarray(draft_scales, float),
         "ballast_scales": b,
@@ -327,9 +447,16 @@ def run_draft_ballast_sweep(
         "iters": iters.reshape(nD, nB, nc),
         "Xi0": r6.reshape(nD, nB, nc, 6),
         "T_moor": T_moor.reshape((nD, nB) + T_moor.shape[1:]),
+        # per-case aggregates (the omdao Max_Offset / Max_PtfmPitch view)
+        "offset_max": np.hypot(surge_max, sway_max).max(axis=1).reshape(nD, nB),
+        "pitch_max_deg": pitch_max.max(axis=1).reshape(nD, nB),
+        # second-pass mean aero loads at the PRP (zero for wind-free cases)
+        "F_aero0": F_aero2.reshape(nD, nB, nc, 6),
         "timing": {
             "host_prep_s": t_host,
+            "aero_first_s": t_aero1,
             "mooring_s": t_moor,
+            "aero_second_s": t_aero2,
             "dynamics_first_s": t_dyn_first,
             "total_s": time.perf_counter() - t_start,
         },
@@ -342,6 +469,7 @@ def run_draft_ballast_sweep(
         tm = res["timing"]
         print(
             f"fused sweep {nD}x{nB}: host {tm['host_prep_s']:.2f}s, "
+            f"aero {tm['aero_first_s'] + tm['aero_second_s']:.2f}s, "
             f"mooring {tm['mooring_s']:.2f}s, dynamics(first) "
             f"{tm['dynamics_first_s']:.2f}s, total {tm['total_s']:.2f}s"
         )

@@ -81,14 +81,61 @@ def test_fused_sweep_matches_direct_model():
         )
 
 
-def test_fused_sweep_rejects_wind_cases():
-    base = _base_design()
+VOLTURNUS = "/root/reference/designs/VolturnUS-S.yaml"
+
+
+@pytest.mark.skipif(
+    not __import__("os").path.exists(VOLTURNUS),
+    reason="reference designs not mounted",
+)
+def test_fused_sweep_with_wind_matches_direct_model():
+    """Operating-wind cases through the fused sweep (first-pass sharing,
+    batched mean-pitch rotor re-evaluation, rank-1 hub a/b profiles in the
+    device graph) must match the plain Model-per-design path, which runs
+    the serial per-case aero pipeline (prepare_case_inputs)."""
+    from raft_tpu.io.schema import load_design
+
+    base = load_design(VOLTURNUS)
+    base["settings"] = {
+        "min_freq": 0.02, "max_freq": 0.6, "XiStart": 0.1, "nIter": 15,
+    }
     keys = base["cases"]["keys"]
-    rows = [dict(zip(keys, r)) for r in base["cases"]["data"]]
-    rows[0]["wind_speed"] = 10.0
-    base["cases"]["data"] = [[r[k] for k in keys] for r in rows]
-    with pytest.raises(ValueError, match="wind-free"):
-        run_draft_ballast_sweep(base, [1.0], [1.0], draft_group=1, verbose=False)
+    row = dict(zip(keys, base["cases"]["data"][0]))
+    rows = []
+    for wind, hs, tp in [(0.0, 3.0, 8.0), (10.5, 4.0, 9.0), (16.0, 5.5, 10.0)]:
+        r = dict(row)
+        r.update(wind_speed=wind, wave_spectrum="JONSWAP",
+                 wave_height=hs, wave_period=tp)
+        rows.append([r[k] for k in keys])
+    base["cases"]["data"] = rows
+
+    drafts = [0.95, 1.05]
+    ballasts = [0.8, 1.2]
+    res = run_draft_ballast_sweep(
+        base, drafts, ballasts, draft_group=1, return_xi=True, verbose=False,
+    )
+    assert res["converged"].all()
+
+    iD, iB = 1, 0
+    d = _apply_point(base, drafts[iD], ballasts[iB])
+    m = Model(d)
+    m.analyze_unloaded()
+    args, aux = m.prepare_case_inputs(verbose=False)
+    out = jax.jit(m.case_pipeline_fn())(*(jax.numpy.asarray(a) for a in args))
+    Xi_direct = np.asarray(out[0], np.float64) + 1j * np.asarray(out[1], np.float64)
+
+    # mean offsets (wind loads shift the equilibria per case) and the
+    # second-pass mean aero loads must agree with the serial path
+    np.testing.assert_allclose(
+        res["Xi0"][iD, iB], aux["Xi0"], rtol=1e-6, atol=1e-10
+    )
+    np.testing.assert_allclose(
+        res["F_aero0"][iD, iB], aux["F_aero0"], rtol=1e-6, atol=1e-6
+    )
+    # responses, all cases including the wind ones
+    np.testing.assert_allclose(
+        np.abs(res["Xi"][iD, iB]), np.abs(Xi_direct), rtol=2e-5, atol=1e-7
+    )
 
 
 def test_scale_draft_only_touches_submerged_z():
