@@ -1,20 +1,27 @@
 """Design-sweep benchmark: 256-point draft x ballast sweep of VolturnUS-S
-(BASELINE.json configs[3]; north-star target: 100x vs single-core NumPy).
+(BASELINE.json configs[3]; north-star target: 100x vs single-core NumPy),
+with the FULL physics per point — operating-wind cases run the complete
+aero-servo path in BOTH paths, like the reference sweep, which runs the
+whole model per design (reference raft/parametersweep.py:56-100).
 
 Two paths compute the SAME study (identical physics, f64 mooring in both):
 
  - **fused TPU sweep** (raft_tpu/sweep_fused.py): 16 strip-node bundles
    (one per draft), 32 statics evaluations (ballast-density linearity),
-   one vmapped f64 CPU mooring call, one jitted TPU dispatch for all
-   256 designs x 12 cases x 128 frequencies of dynamics;
+   one shared zero-pitch rotor pass per case, one vmapped f64 CPU mooring
+   call over distinct-mean-load groups, one vmapped compiled rotor
+   re-evaluation over (design x wind-case) lanes at the mean pitches, and
+   one jitted TPU dispatch for all 256 designs x 12 cases x 128
+   frequencies of dynamics;
 
- - **serial NumPy baseline**: a reference-style Python loop over all 256
-   designs (reference raft/parametersweep.py:56-100 runRAFT-per-point
-   semantics) — per design: geometry processing + statics + mooring
-   equilibrium/linearization (raft_tpu/mooring_numpy.py) + the
-   reference-loop RAO solve (raft_tpu/reference_numpy.py).  Both paths
-   solve one mooring equilibrium per design (the cases are wind-free, so
-   mean loads are identical; the collapse is applied symmetrically).
+ - **serial NumPy baseline**: a reference-style Python loop over designs —
+   per design: geometry + statics + serial rotor BEM with
+   finite-difference derivatives (raft_tpu/rotor_numpy.py; the reference
+   consumes analytic Fortran adjoints from CCBlade) at zero pitch per
+   wind case, mooring equilibrium/linearization per distinct mean load
+   (raft_tpu/mooring_numpy.py; the same case-collapse as the fused path,
+   applied symmetrically), the mean-pitch rotor re-evaluation per wind
+   case, and the reference-loop RAO solve (raft_tpu/reference_numpy.py).
 
 Reported: wall-clock of each path, speedup, per-design ms, and the response
 parity between the two (RAO-magnitude L_inf over a design sample).
@@ -23,6 +30,8 @@ Timing convention: the fused path is timed on its hot second run (compile
 excluded, like bench.py's headline metric — compiles amortize across
 sweeps and persist in the XLA compilation cache); the one-time compile cost
 is reported separately.  Host prep IS included in the fused wall-clock.
+The baseline may time a subset of designs (sweep_baseline_designs_timed)
+and extrapolate linearly — per-design cost is constant across the grid.
 """
 
 import json
@@ -64,21 +73,42 @@ def _apply_point_numpy(base_design, draft, ballast):
     return d
 
 
-def run_numpy_sweep(base_design, drafts, ballasts, zeta, beta, w, k,
-                    depth, rho, g, yawstiff, XiStart, nIter, limit=None):
+def run_numpy_sweep(base_design, drafts, ballasts, cases, wind, zeta, beta,
+                    w, k, depth, rho, g, yawstiff, XiStart, nIter,
+                    hHub, rotor_cfg=None, limit=None):
     """Serial single-core NumPy sweep (the baseline).  Returns (wall-clock
     seconds, metrics dict, Xi of the last design) over the first ``limit``
-    designs (None = all)."""
+    designs (None = all).  ``rotor_cfg`` (rotor_numpy.rotor_numpy_config)
+    enables the aero-servo path for wind cases."""
     from raft_tpu.geometry import pack_nodes, process_members
     from raft_tpu.mooring_numpy import case_mooring_np
     from raft_tpu.mooring import parse_mooring
-    from raft_tpu.reference_numpy import added_mass_numpy, rao_solve_numpy
+    from raft_tpu.reference_numpy import (
+        _translate_matrix_3to6,
+        added_mass_numpy,
+        rao_solve_numpy,
+    )
+    from raft_tpu.rotor_numpy import aero_servo_np, case_gains_np
     from raft_tpu.statics import compute_statics
 
     points = [(d, bl) for d in drafts for bl in ballasts]
     if limit is not None:
         points = points[:limit]
     nc, nw = zeta.shape
+    wind = np.asarray(wind, float)
+    wind_idx = (
+        np.where(wind > 0.0)[0] if rotor_cfg is not None else np.array([], int)
+    )
+    rHub = np.array([0.0, 0.0, hHub])
+    E00 = np.zeros((3, 3))
+    E00[0, 0] = 1.0
+    P_hub = _translate_matrix_3to6(E00, rHub)
+
+    def to_prp(F_hub):
+        out = F_hub.copy()
+        out[3:] += np.cross(rHub, F_hub[:3])
+        return out
+
     mass = np.zeros(len(points))
     offset = np.zeros(len(points))
     pitch = np.zeros(len(points))
@@ -95,17 +125,48 @@ def run_numpy_sweep(base_design, drafts, ballasts, zeta, beta, w, k,
         ms = parse_mooring(d["mooring"], rho_water=rho, g=g)
         props = (st.mass, st.V, st.rCG_TOT, np.array([0.0, 0.0, st.zMeta]),
                  st.AWP)
-        r6, C_moor, F_moor, T_moor, J_moor = case_mooring_np(
-            np.zeros(6), props, ms.anchors, ms.rFair, ms.L, ms.EA, ms.w,
-            rho=rho, g=g, yawstiff=yawstiff,
-        )
-        # all cases share the wind-free mean load -> one equilibrium,
-        # C_moor broadcast across cases (same collapse as the fused path)
-        C_lin = (st.C_struc + st.C_hydro + C_moor)[None].repeat(nc, axis=0)
+
+        # first-pass rotor at zero platform pitch, per wind case
+        F_prp = np.zeros((nc, 6))
+        for i in wind_idx:
+            F_hub, _, _ = aero_servo_np(
+                rotor_cfg, case_gains_np(rotor_cfg, wind[i]), w, cases[i],
+                ptfm_pitch=0.0,
+            )
+            F_prp[i] = to_prp(F_hub)
+
+        # one mooring equilibrium per distinct mean load (wind-free cases
+        # collapse to one solve — same grouping as the fused path)
+        groups = {}
+        inv = np.zeros(nc, int)
+        for i in range(nc):
+            inv[i] = groups.setdefault(F_prp[i].tobytes(), len(groups))
+        r6_g, C_g = [], []
+        for gkey, gi in sorted(groups.items(), key=lambda kv: kv[1]):
+            F0 = np.frombuffer(gkey, np.float64)
+            r6_i, C_i, _, _, _ = case_mooring_np(
+                F0, props, ms.anchors, ms.rFair, ms.L, ms.EA, ms.w,
+                rho=rho, g=g, yawstiff=yawstiff,
+            )
+            r6_g.append(r6_i)
+            C_g.append(C_i)
+        r6_c = np.stack([r6_g[inv[i]] for i in range(nc)])       # [nc, 6]
+        C_moor_c = np.stack([C_g[inv[i]] for i in range(nc)])    # [nc, 6, 6]
+
+        C_lin = st.C_struc + st.C_hydro + C_moor_c
         M_lin = np.broadcast_to(
             st.M_struc + A, (nc, nw, 6, 6)
         ).copy()
         B_lin = np.zeros((nc, nw, 6, 6))
+        # second-pass rotor at each case's mean platform pitch -> hub
+        # a(w)/b(w) (reference raft_model.py:516-517, :552-555)
+        for i in wind_idx:
+            _, a_i, b_i = aero_servo_np(
+                rotor_cfg, case_gains_np(rotor_cfg, wind[i]), w, cases[i],
+                ptfm_pitch=r6_c[i, 4],
+            )
+            M_lin[i] += a_i[:, None, None] * P_hub
+            B_lin[i] += b_i[:, None, None] * P_hub
         Fz = np.zeros((nc, nw, 6))
         Xi = rao_solve_numpy(
             nodes, w, k, depth, rho, g, zeta, beta, C_lin, M_lin, B_lin,
@@ -116,29 +177,53 @@ def run_numpy_sweep(base_design, drafts, ballasts, zeta, beta, w, k,
             np.sum(np.abs(Xi) ** 2, axis=-1) * dw
         ).reshape(nc, 6)
         mass[ip] = st.mass
-        offset[ip] = np.hypot(r6[0], r6[1])
-        pitch[ip] = np.rad2deg(r6[4])
+        offset[ip] = np.hypot(r6_c[0, 0], r6_c[0, 1])
+        pitch[ip] = np.rad2deg(r6_c[0, 4])
     t_np = time.perf_counter() - t0
     return t_np, dict(mass=mass, offset=offset, pitch=pitch, std=std), Xi
+
+
+WIND_SPEEDS = [8.0, 10.5, 12.0, 14.0, 16.0, 20.0]  # cases 7-12 operate
+
+
+def _flagship_wind_design():
+    """The flagship sweep design: VolturnUS-S, 12 cases, the last 6 with
+    operating wind at aeroServoMod=2 (the reference sweep runs the full
+    model incl. CCBlade + control per point).  Falls back to the wind-free
+    table when the design has no blade data (reference mount absent)."""
+    from __graft_entry__ import _flagship_design
+
+    base = _flagship_design(NW_MIN, NW_MAX, N_CASES)
+    if "blade" not in base.get("turbine", {}):
+        return base, False
+    base["turbine"]["aeroServoMod"] = 2
+    keys = base["cases"]["keys"]
+    rows = [dict(zip(keys, r)) for r in base["cases"]["data"]]
+    for j, u in enumerate(WIND_SPEEDS):
+        rows[len(rows) - len(WIND_SPEEDS) + j]["wind_speed"] = u
+    base["cases"]["data"] = [[r[k] for k in keys] for r in rows]
+    return base, True
 
 
 def run(baseline_limit=None, verbose=True):
     """Run both paths; returns the result dict for bench.py."""
     import jax
 
-    from __graft_entry__ import _flagship_design
     from raft_tpu.model import Model
+    from raft_tpu.rotor_numpy import rotor_numpy_config
     from raft_tpu.sweep_fused import run_draft_ballast_sweep
 
     from raft_tpu.io.schema import cases_as_dicts
 
-    base = _flagship_design(NW_MIN, NW_MAX, N_CASES)
+    base, aero_on = _flagship_wind_design()
     drafts, ballasts = _grids()
     model0 = Model(base)
-    spec, height, period, beta, wind = model0._case_arrays(
-        cases_as_dicts(base)
-    )
+    cases = cases_as_dicts(base)
+    spec, height, period, beta, wind = model0._case_arrays(cases)
     zeta = model0._zeta(spec, height, period)
+    rotor_cfg = (
+        rotor_numpy_config(base["turbine"], base["site"]) if aero_on else None
+    )
 
     # ---- fused TPU sweep: first run (compiles), then a timed hot run ----
     res = run_draft_ballast_sweep(
@@ -156,9 +241,10 @@ def run(baseline_limit=None, verbose=True):
     # ---- serial NumPy baseline ----
     n_base = n_designs if baseline_limit is None else baseline_limit
     t_np, np_metrics, Xi_np_last = run_numpy_sweep(
-        base, drafts, ballasts, zeta, beta, model0.w, model0.k,
+        base, drafts, ballasts, cases, wind, zeta, beta, model0.w, model0.k,
         model0.depth, model0.rho_water, model0.g, model0.yawstiff,
-        model0.XiStart, model0.nIter, limit=baseline_limit,
+        model0.XiStart, model0.nIter, model0.hHub, rotor_cfg=rotor_cfg,
+        limit=baseline_limit,
     )
 
     # ---- parity between the two paths ----
@@ -188,6 +274,8 @@ def run(baseline_limit=None, verbose=True):
     baseline_full = per_design_np * n_designs
     out = {
         "sweep_n_designs": n_designs,
+        "sweep_aero_servo": bool(aero_on),
+        "sweep_wind_cases": int(np.sum(wind > 0.0)),
         "sweep_wall_s": round(t_fused, 3),
         "sweep_first_run_s": round(t_first, 3),
         "sweep_per_design_ms": round(t_fused / n_designs * 1000, 3),

@@ -202,13 +202,16 @@ def _induction(phi, cl, cd, sigma_p, F_args, usecd=True):
 
 
 def _solve_phi(theta, cl_tab, cd_tab, aoa_grid, sigma_p, F_args,
-               n_bisect=50, n_newton=2):
+               n_bisect=30, n_newton=2):
     """Inflow angle phi solving the BEM residual for one blade section.
 
     Bisection on Ning's primary bracket (eps, pi/2), with fallback brackets
     (-pi/4, -eps) and (pi/2, pi-eps) selected by sign tests — then
     differentiable Newton polishing so jacfwd recovers the implicit
-    derivative through the solve.
+    derivative through the solve.  30 halvings shrink the bracket to
+    ~1.5e-9 rad, deep inside the Newton basin; the polish then reaches
+    f64 roundoff (validated against scipy brentq at 1e-12 by
+    tests/test_aero.py's NumPy-twin comparison).
     """
 
     def resid(phi):
@@ -592,6 +595,11 @@ class Rotor:
         Uhub, ptfm_pitch, yaw_misalign : broadcastable arrays [nt]
         Returns (vals [nt, 10], J [nt, 10, 3]) with the same layout as
         :meth:`run_bem`'s stacked outputs, derivatives already SI.
+
+        The lane axis is padded to a multiple of 64 so sweeps of varying
+        size share compiled executables (each distinct lane count would
+        otherwise trigger a fresh XLA compile of the vmapped jacfwd
+        graph).
         """
         Uhub = np.atleast_1d(np.asarray(Uhub, np.float64))
         ptfm_pitch = np.broadcast_to(
@@ -600,16 +608,22 @@ class Rotor:
         yaw = np.zeros_like(Uhub) if yaw_misalign is None else np.broadcast_to(
             np.asarray(yaw_misalign, np.float64), Uhub.shape
         )
-        Omega_rpm = np.interp(Uhub, self.Uhub, self.Omega_rpm)
-        pitch_deg = np.interp(Uhub, self.Uhub, self.pitch_deg)
-        tilt = np.deg2rad(self.shaft_tilt) + ptfm_pitch
+        n = Uhub.size
+        nb = -(-n // 64) * 64
+        pad = lambda a: np.concatenate(  # noqa: E731
+            [a, np.full(nb - n, a[-1])]
+        ) if nb > n else a
+        Uhub_p, pitch_p, yaw_p = pad(Uhub), pad(ptfm_pitch), pad(yaw)
+        Omega_rpm = np.interp(Uhub_p, self.Uhub, self.Omega_rpm)
+        pitch_deg = np.interp(Uhub_p, self.Uhub, self.pitch_deg)
+        tilt = np.deg2rad(self.shaft_tilt) + pitch_p
 
         vals, J = self._eval_batch(
-            put_cpu(Uhub), put_cpu(Omega_rpm * np.pi / 30.0),
+            put_cpu(Uhub_p), put_cpu(Omega_rpm * np.pi / 30.0),
             put_cpu(np.deg2rad(pitch_deg)), put_cpu(tilt),
-            put_cpu(np.deg2rad(yaw)),
+            put_cpu(np.deg2rad(yaw_p)),
         )
-        return np.asarray(vals), np.asarray(J)
+        return np.asarray(vals)[:n], np.asarray(J)[:n]
 
     # ---------------------------------------------------- aero-servo terms
 

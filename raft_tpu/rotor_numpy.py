@@ -1,0 +1,335 @@
+"""Serial NumPy rotor BEM — the baseline twin of raft_tpu.aero.
+
+Reproduces the reference's CCBlade usage pattern with plain NumPy/SciPy
+loops (reference raft/raft_rotor.py:213-306 runCCBlade consuming
+CCBlade.evaluate): a Python loop over azimuthal sectors and blade sections,
+Ning's guaranteed-bracket inflow-angle residual solved per section with
+scipy.optimize.brentq, trapezoidal integration to the 6-component hub
+loads, and d{T,Q}/d{U, Omega, pitch} by central finite differences.
+
+The reference consumes analytic Fortran adjoints from CCBlade; central
+differences are the plain-NumPy equivalent, and their 6 extra evaluations
+are counted in the baseline's wall-clock (stated in bench_sweep.py).  This
+module doubles as the aero oracle: tests assert the vectorized JAX rotor
+(raft_tpu/aero.py) matches these loops.
+
+Pure NumPy/SciPy in the evaluation path; no JAX.
+"""
+
+import numpy as np
+from scipy.optimize import brentq
+
+_RAD2DEG = 57.29577951308232
+
+
+def _wind_components_np(Uinf, Omega, azimuth, r, precurve, presweep, precone,
+                        yaw, tilt, hubHt, shearExp):
+    """Velocity components in the blade-aligned frame at every section
+    (CCBlade windcomponents; twin of aero._wind_components)."""
+    sy, cy = np.sin(yaw), np.cos(yaw)
+    st, ct = np.sin(tilt), np.cos(tilt)
+    sa, ca = np.sin(azimuth), np.cos(azimuth)
+    sc, cc = np.sin(precone), np.cos(precone)
+
+    x_az = -r * sc + precurve * cc
+    z_az = r * cc + precurve * sc
+    y_az = presweep
+
+    height = (y_az * sa + z_az * ca) * ct - x_az * st
+    V = Uinf * (1.0 + height / hubHt) ** shearExp
+
+    Vwind_x = V * ((cy * st * ca + sy * sa) * sc + cy * ct * cc)
+    Vwind_y = V * (cy * st * sa - sy * ca)
+    Vrot_x = -Omega * y_az * sc
+    Vrot_y = Omega * z_az
+    return Vwind_x + Vrot_x, Vwind_y + Vrot_y
+
+
+def _induction_np(phi, cl, cd, sigma_p, B, r, Rhub, Rtip, Vx, Vy):
+    """Scalar induction factors + Ning residual (twin of aero._induction)."""
+    sphi = np.sin(phi)
+    cphi = np.cos(phi)
+    abs_s = max(abs(sphi), 1e-9)
+
+    ftip = B / 2.0 * (Rtip / r - 1.0) / abs_s
+    Ftip = 2.0 / np.pi * np.arccos(min(max(np.exp(-ftip), 0.0), 1.0))
+    fhub = B / 2.0 * (r / Rhub - 1.0) / abs_s
+    Fhub = 2.0 / np.pi * np.arccos(min(max(np.exp(-fhub), 0.0), 1.0))
+    F = max(Ftip * Fhub, 1e-6)
+
+    cn = cl * cphi + cd * sphi
+    ct = cl * sphi - cd * cphi
+
+    k = sigma_p * cn / (4.0 * F * sphi * sphi)
+    kp = sigma_p * ct / (4.0 * F * sphi * cphi)
+
+    if phi > 0:
+        if k <= 2.0 / 3.0:
+            a = k / (1.0 + k)
+        else:
+            g1 = 2.0 * F * k - (10.0 / 9.0 - F)
+            g2 = max(2.0 * F * k - F * (4.0 / 3.0 - F), 1e-12)
+            g3 = 2.0 * F * k - (25.0 / 9.0 - 2.0 * F)
+            if abs(g3) < 1e-6:
+                a = 1.0 - 1.0 / (2.0 * np.sqrt(g2))
+            else:
+                a = (g1 - np.sqrt(g2)) / g3
+    else:
+        a = k / max(k - 1.0, 1e-9) if k > 1.0 else 0.0
+
+    if abs(1.0 - kp) < 1e-9:
+        kp += 1e-9
+    ap = kp / (1.0 - kp)
+
+    Vy_safe = Vy if abs(Vy) >= 1e-6 else np.sign(Vy) * 1e-6 + 1e-12
+    one_minus_a = 1.0 - a
+    if abs(one_minus_a) < 1e-12:
+        one_minus_a = 1e-12
+    resid = sphi / one_minus_a - Vx / Vy_safe * cphi * (1.0 - kp)
+    return resid, a, ap, F
+
+
+def _solve_phi_np(theta, cl_tab, cd_tab, aoa_grid, sigma_p,
+                  B, r, Rhub, Rtip, Vx, Vy):
+    """Inflow angle for one section: brentq on Ning's brackets (twin of
+    aero._solve_phi, which uses bisection + Newton polish)."""
+
+    def resid(phi):
+        alpha = phi - theta
+        cl = np.interp(alpha * _RAD2DEG, aoa_grid, cl_tab)
+        cd = np.interp(alpha * _RAD2DEG, aoa_grid, cd_tab)
+        return _induction_np(phi, cl, cd, sigma_p, B, r, Rhub, Rtip, Vx, Vy)[0]
+
+    eps = 1e-6
+    r_lo = resid(eps)
+    r_hi = resid(np.pi / 2)
+    if r_lo * r_hi <= 0:
+        lo, hi = eps, np.pi / 2
+    elif resid(-np.pi / 4) < 0 and resid(-eps) > 0:
+        lo, hi = -np.pi / 4, -eps
+    else:
+        lo, hi = np.pi / 2, np.pi - eps
+    return brentq(resid, lo, hi, xtol=1e-12, rtol=1e-14), resid
+
+
+def rotor_loads_np(Uinf, Omega, pitch, geom, polars, env, nSector=4):
+    """Steady 6-component hub loads with reference-style serial loops
+    (twin of aero.rotor_evaluate; same math, per-section Python loop).
+
+    Returns dict with T, Y, Z, Q, My, Mz, P.
+    """
+    aoa_grid, cl_tabs, cd_tabs, _ = polars
+    r = np.asarray(geom["r"], float)
+    chord = np.asarray(geom["chord"], float)
+    theta_all = np.asarray(geom["theta"], float) + pitch
+    precurve = np.asarray(geom["precurve"], float)
+    presweep = np.asarray(geom["presweep"], float)
+    B = geom["B"]
+    Rhub, Rtip = geom["Rhub"], geom["Rtip"]
+    precone = geom["precone"]
+    sigma_p = B * chord / (2.0 * np.pi * r)
+    n = len(r)
+
+    azimuths = np.arange(nSector) * (2.0 * np.pi / nSector)
+
+    # curvature of the extended (hub/tip zero-load) radial stations
+    rfull = np.concatenate([[Rhub], r, [Rtip]])
+    pcfull = np.concatenate([precurve[:1], precurve, precurve[-1:]])
+    psfull = np.concatenate([presweep[:1], presweep, presweep[-1:]])
+    x_az = -rfull * np.sin(precone) + pcfull * np.cos(precone)
+    z_az = rfull * np.cos(precone) + pcfull * np.sin(precone)
+    y_az = psfull
+    cone = np.arctan2(-np.gradient(x_az), np.gradient(z_az))
+    s = np.concatenate([
+        [0.0],
+        np.cumsum(np.sqrt(np.diff(rfull) ** 2 + np.diff(pcfull) ** 2
+                          + np.diff(psfull) ** 2)),
+    ])
+    ccone, scone = np.cos(cone), np.sin(cone)
+
+    T = Y = Z = Q = My = Mz = 0.0
+    for az in azimuths:  # serial sector loop (CCBlade's evaluate pattern)
+        Vx_all, Vy_all = _wind_components_np(
+            Uinf, Omega, az, r, precurve, presweep, precone,
+            geom["yaw"], geom["tilt"], geom["hubHt"], geom["shearExp"],
+        )
+        Np = np.zeros(n)
+        Tp = np.zeros(n)
+        for i in range(n):  # serial section loop
+            phi, resid = _solve_phi_np(
+                theta_all[i], cl_tabs[i], cd_tabs[i], aoa_grid, sigma_p[i],
+                B, r[i], Rhub, Rtip, Vx_all[i], Vy_all[i],
+            )
+            alpha = phi - theta_all[i]
+            cl = np.interp(alpha * _RAD2DEG, aoa_grid, cl_tabs[i])
+            cd = np.interp(alpha * _RAD2DEG, aoa_grid, cd_tabs[i])
+            _, a, ap, F = _induction_np(
+                phi, cl, cd, sigma_p[i], B, r[i], Rhub, Rtip,
+                Vx_all[i], Vy_all[i],
+            )
+            W2 = (Vx_all[i] * (1 - a)) ** 2 + (Vy_all[i] * (1 + ap)) ** 2
+            Np[i] = (cl * np.cos(phi) + cd * np.sin(phi)) * 0.5 * env["rho"] * W2 * chord[i]
+            Tp[i] = (cl * np.sin(phi) - cd * np.cos(phi)) * 0.5 * env["rho"] * W2 * chord[i]
+
+        Npf = np.concatenate([[0.0], Np, [0.0]])
+        Tpf = np.concatenate([[0.0], Tp, [0.0]])
+        Fx = np.trapezoid(Npf * ccone, s)
+        Fy_a = -np.trapezoid(Tpf, s)
+        Fz_a = np.trapezoid(Npf * scone, s)
+        Qa = np.trapezoid(Tpf * z_az, s)
+        My_a = np.trapezoid(Npf * (z_az * ccone - x_az * scone), s)
+        Mz_a = -np.trapezoid(Tpf * x_az + Npf * y_az * ccone, s)
+        ca, sa = np.cos(az), np.sin(az)
+        T += Fx
+        Y += ca * Fy_a - sa * Fz_a
+        Z += sa * Fy_a + ca * Fz_a
+        Q += Qa
+        My += ca * My_a - sa * Mz_a
+        Mz += sa * My_a + ca * Mz_a
+
+    scale = B / nSector
+    out = dict(T=T * scale, Y=Y * scale, Z=Z * scale, Q=Q * scale,
+               My=My * scale, Mz=Mz * scale)
+    out["P"] = out["Q"] * Omega
+    return out
+
+
+def run_bem_np(rotor_cfg, Uhub, ptfm_pitch=0.0, yaw_misalign=0.0,
+               rel_step=1e-4):
+    """Loads + SI derivatives at the operating point (serial twin of
+    Rotor.run_bem).  Derivatives by central finite differences — 6 extra
+    full evaluations, the plain-NumPy stand-in for CCBlade's analytic
+    adjoints.
+
+    rotor_cfg : dict with 'geom' (numpy arrays), 'polars', 'env',
+        'Uhub_sched', 'Omega_rpm_sched', 'pitch_deg_sched' — see
+        rotor_numpy_config().
+    """
+    Omega = np.interp(Uhub, rotor_cfg["Uhub_sched"],
+                      rotor_cfg["Omega_rpm_sched"]) * np.pi / 30.0
+    pitch = np.deg2rad(np.interp(Uhub, rotor_cfg["Uhub_sched"],
+                                 rotor_cfg["pitch_deg_sched"]))
+    geom = dict(rotor_cfg["geom"])
+    geom["tilt"] = np.deg2rad(rotor_cfg["shaft_tilt"]) + ptfm_pitch
+    geom["yaw"] = np.deg2rad(yaw_misalign)
+    polars, env = rotor_cfg["polars"], rotor_cfg["env"]
+
+    def ev(U, Om, pi):
+        return rotor_loads_np(U, Om, pi, geom, polars, env)
+
+    loads = ev(Uhub, Omega, pitch)
+    hU = max(abs(Uhub), 1.0) * rel_step
+    hOm = max(abs(Omega), 0.1) * rel_step
+    hPi = max(abs(pitch), 0.01) * rel_step
+    d = {}
+    for name, h, args in (
+        ("dU", hU, lambda s: (Uhub + s, Omega, pitch)),
+        ("dOm", hOm, lambda s: (Uhub, Omega + s, pitch)),
+        ("dPi", hPi, lambda s: (Uhub, Omega, pitch + s)),
+    ):
+        p = ev(*args(h))
+        m = ev(*args(-h))
+        d[f"dT_{name}"] = (p["T"] - m["T"]) / (2 * h)
+        d[f"dQ_{name}"] = (p["Q"] - m["Q"]) / (2 * h)
+    return loads, d
+
+
+def rotor_numpy_config(turbine, site):
+    """Host-side rotor configuration for the serial path, from the same
+    design dict fields Rotor.__init__ consumes (geometry, operating
+    schedule with parked extension, interpolated polars)."""
+    from raft_tpu.aero import build_airfoils
+
+    gt = np.array(turbine["blade"]["geometry"], float)
+    Uhub = np.array(turbine["wt_ops"]["v"], float)
+    Omega_rpm = np.array(turbine["wt_ops"]["omega_op"], float)
+    pitch_deg = np.array(turbine["wt_ops"]["pitch_op"], float)
+    Uhub = np.r_[Uhub, Uhub.max() * 1.4, 100]
+    Omega_rpm = np.r_[Omega_rpm, 0, 0]
+    pitch_deg = np.r_[pitch_deg, 90, 90]
+    aoa, cl, cd, cm = build_airfoils(turbine, n_span=gt.shape[0])
+    geom = dict(
+        r=gt[:, 0], chord=gt[:, 1], theta=np.deg2rad(gt[:, 2]),
+        precurve=gt[:, 3], presweep=gt[:, 4],
+        Rhub=float(turbine["Rhub"]), Rtip=float(turbine["blade"]["Rtip"]),
+        B=int(turbine["nBlades"]),
+        precone=float(np.deg2rad(turbine["precone"])),
+        hubHt=float(turbine["Zhub"]),
+        shearExp=float(site["shearExp"]),
+    )
+    cfg = dict(
+        geom=geom,
+        polars=(aoa, np.asarray(cl), np.asarray(cd), np.asarray(cm)),
+        env=dict(rho=float(site["rho_air"]), mu=float(site["mu_air"])),
+        Uhub_sched=Uhub, Omega_rpm_sched=Omega_rpm,
+        pitch_deg_sched=pitch_deg,
+        shaft_tilt=float(turbine["shaft_tilt"]),
+        Zhub=float(turbine["Zhub"]),
+        R_rot=float(turbine["blade"]["Rtip"]),
+        I_drivetrain=float(turbine["I_drivetrain"]),
+    )
+    # ROSCO gain schedules over the extended operating schedule
+    # (twin of Rotor.set_control_gains, reference raft_rotor.py:309-323)
+    pc = turbine.get("pitch_control")
+    if pc is None:
+        cfg.update(kp_0=np.zeros_like(Uhub), ki_0=np.zeros_like(Uhub),
+                   k_float=0.0, kp_tau=0.0, ki_tau=0.0, Ng=1.0)
+    else:
+        pc_angles = np.array(pc["GS_Angles"]) * _RAD2DEG
+        cfg.update(
+            kp_0=np.interp(pitch_deg, pc_angles, pc["GS_Kp"],
+                           left=0, right=0),
+            ki_0=np.interp(pitch_deg, pc_angles, pc["GS_Ki"],
+                           left=0, right=0),
+            k_float=-pc["Fl_Kp"],
+            kp_tau=-turbine["torque_control"]["VS_KP"],
+            ki_tau=-turbine["torque_control"]["VS_KI"],
+            Ng=turbine["gear_ratio"],
+        )
+    return cfg
+
+
+def case_gains_np(cfg, Uinf):
+    """Gain-schedule values at wind speed Uinf with the reference's
+    ki_tau-from-kp_tau quirk (raft_rotor.py:375) — serial twin of
+    Rotor.case_gains, packed for aero_servo_np."""
+    kp_beta = -np.interp(Uinf, cfg["Uhub_sched"], cfg["kp_0"])
+    ki_beta = -np.interp(Uinf, cfg["Uhub_sched"], cfg["ki_0"])
+    kp_tau = cfg["kp_tau"] * (kp_beta == 0)
+    ki_tau = cfg["kp_tau"] * (kp_beta == 0)
+    return kp_beta, ki_beta, kp_tau, ki_tau, cfg["Ng"], cfg["k_float"]
+
+
+def aero_servo_np(rotor_cfg, gains, w, case, ptfm_pitch=0.0):
+    """Serial twin of Rotor.calc_aero_servo_contributions for
+    aeroServoMod=2: mean hub loads (reference ordering quirk
+    [T, Y, Z, My, Q, Mz], raft_rotor.py:350-351) and the closed-loop
+    a(w)/b(w) from the same transfer-function algebra
+    (raft_rotor.py:388-432), with ``gains`` =
+    (kp_beta, ki_beta, kp_tau, ki_tau, Ng, k_float) at this wind speed.
+
+    Returns (F_aero0_hub[6], a_aero[nw], b_aero[nw]).
+    """
+    loads, d = run_bem_np(
+        rotor_cfg, case["wind_speed"], ptfm_pitch=ptfm_pitch,
+        yaw_misalign=case.get("yaw_misalign", 0.0),
+    )
+    F_aero0 = np.array([loads["T"], loads["Y"], loads["Z"],
+                        loads["My"], loads["Q"], loads["Mz"]])
+    kp_beta, ki_beta, kp_tau, ki_tau, Ng, k_float = gains
+    I_dt = rotor_cfg["I_drivetrain"]
+    D = (
+        I_dt * w**2
+        + (d["dQ_dOm"] + kp_beta * d["dQ_dPi"] - Ng * kp_tau) * 1j * w
+        + ki_beta * d["dQ_dPi"]
+        - Ng * ki_tau
+    )
+    H_QT = ((d["dT_dOm"] + kp_beta * d["dT_dPi"]) * 1j * w
+            + ki_beta * d["dT_dPi"]) / D
+    resp = (
+        d["dT_dU"] - k_float * d["dT_dPi"]
+        - H_QT * (d["dQ_dU"] - k_float * d["dQ_dPi"])
+    )
+    b_aero = np.real(resp)
+    a_aero = np.real(resp / (1j * w))
+    return F_aero0, a_aero, b_aero

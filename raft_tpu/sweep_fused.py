@@ -319,6 +319,16 @@ def run_draft_ballast_sweep(
         and model0.aeroServoMod > 0
         and bool(np.any(wind > 0.0))
     )
+    if np.any(wind > 0.0) and not aero_on:
+        import warnings
+
+        warnings.warn(
+            "run_draft_ballast_sweep: cases specify operating wind but the "
+            "design has aero off (aeroServoMod=0 or no rotor data); the "
+            "sweep runs WITHOUT wind loading, like the reference's "
+            "aeroServoMod gate (reference raft/raft_fowt.py:445)",
+            stacklevel=2,
+        )
 
     # ---- host prep: one variant per draft, ballast by linearity ----
     t0 = time.perf_counter()
@@ -331,11 +341,12 @@ def run_draft_ballast_sweep(
     t_host = time.perf_counter() - t0
 
     # ---- aero first pass: per-case mean loads at zero pitch ----
-    # (design-independent, so one rotor evaluation per case serves the
-    # whole sweep; the reference re-runs it per point)
+    # (design-independent, so one batched rotor evaluation serves the
+    # whole sweep; the reference re-runs it per point).  Reuses the
+    # second-pass machinery at a single zero-pitch "design" lane.
     t0 = time.perf_counter()
     F_prp = (
-        model0.aero_case_means(cases, wind)
+        _aero_second_pass(model0, cases, wind, np.zeros((1, nc)))[2][0]
         if aero_on else np.zeros((nc, 6))
     )
     t_aero1 = time.perf_counter() - t0

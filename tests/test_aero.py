@@ -179,3 +179,30 @@ def test_kaimal_rotor_average_reduces_high_freq(rotor):
     # rotor averaging filters high-frequency point turbulence
     assert Rot[-1] < 0.2 * U[-1] + 1e-12
     assert Rot[0] <= U[0] * 1.01
+
+
+def test_numpy_twin_matches_jax_rotor(rotor):
+    """The serial NumPy rotor (rotor_numpy.py, the baseline twin with
+    brentq root solves and FD derivatives) reproduces the vectorized JAX
+    rotor: loads to f64 roundoff, derivatives to FD truncation."""
+    from raft_tpu.io.schema import load_design
+    from raft_tpu.rotor_numpy import (
+        case_gains_np,
+        rotor_numpy_config,
+        run_bem_np,
+    )
+
+    design = load_design(VOLTURNUS)
+    ncfg = rotor_numpy_config(design["turbine"], design["site"])
+    for U, pp in [(10.0, 0.0), (16.0, 0.05)]:
+        lj, dj = rotor.run_bem(U, ptfm_pitch=pp)
+        ln, dn = run_bem_np(ncfg, U, ptfm_pitch=pp)
+        for key in ("T", "Q", "Y", "Z", "My", "Mz"):
+            assert ln[key] == pytest.approx(lj[key], rel=1e-9)
+        for key in ("dT_dU", "dT_dOm", "dT_dPi", "dQ_dU", "dQ_dOm", "dQ_dPi"):
+            assert dn[key] == pytest.approx(dj[key], rel=1e-4)
+    # gain schedules agree with Rotor.case_gains (incl. the ki_tau quirk)
+    g_np = case_gains_np(ncfg, 10.5)
+    g_jax = rotor.case_gains(10.5)
+    np.testing.assert_allclose(g_np[:4], g_jax, rtol=1e-12)
+    assert g_np[4] == rotor.Ng and g_np[5] == rotor.k_float

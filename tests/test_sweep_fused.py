@@ -149,3 +149,19 @@ def test_scale_draft_only_touches_submerged_z():
             else:
                 assert z1 == z0
             assert list(map(float, m0[key][:2])) == list(map(float, m1[key][:2]))
+
+
+def test_wind_cases_without_rotor_warn():
+    """Operating-wind cases on an aero-off design run wind-free (the
+    reference's aeroServoMod gate) but must warn loudly."""
+    base = _base_design()
+    keys = base["cases"]["keys"]
+    rows = [dict(zip(keys, r)) for r in base["cases"]["data"]]
+    rows[0]["wind_speed"] = 10.0
+    base["cases"]["data"] = [[r[k] for k in keys] for r in rows]
+    with pytest.warns(UserWarning, match="WITHOUT wind loading"):
+        res = run_draft_ballast_sweep(
+            base, [1.0], [1.0], draft_group=1, verbose=False
+        )
+    assert res["converged"].all()
+    assert np.all(res["F_aero0"] == 0.0)
