@@ -116,12 +116,16 @@ def main():
     }
 
     # ---- north-star sweep benchmark: 256-design draft x ballast sweep
-    # (BASELINE.json configs[3]; full serial-NumPy baseline measured, no
-    # extrapolation).  Guarded so the headline metric always prints. ----
+    # with the full aero-servo physics in BOTH paths (BASELINE.json
+    # configs[3]; the reference sweep runs the whole model per point).
+    # The serial baseline is timed on 64 of the 256 designs and scaled
+    # linearly (per-design cost is constant; ~5 s/design x 256 would be
+    # ~21 min of driver bench time).  Guarded so the headline metric
+    # always prints. ----
     try:
         import bench_sweep
 
-        out.update(bench_sweep.run(verbose=False))
+        out.update(bench_sweep.run(baseline_limit=64, verbose=False))
     except Exception as exc:  # pragma: no cover - defensive for the driver
         out["sweep_error"] = f"{type(exc).__name__}: {exc}"
 
@@ -135,7 +139,12 @@ def main():
     print(json.dumps(out))
 
 
-def bench_bem(nw=8):
+def bench_bem(nw=8, nw_large=4):
+    """BEM assembly+solve timings at two mesh sizes: ~850 panels (the
+    TPU-vs-CPU crossover regime, full nw) and a ~3000-panel production
+    mesh (past the old TPU LU ceiling — exercises the blocked
+    Gauss-Jordan path and mesh-size bucketing; fewer frequencies to bound
+    the CPU comparison time)."""
     import jax
 
     from raft_tpu.bem_solver import solve_bem
@@ -146,19 +155,19 @@ def bench_bem(nw=8):
     design = deep_spar(n_cases=1)
     design["platform"]["members"][0]["potMod"] = True
     m = Model(design)
-    # ~850 panels: above the TPU-vs-CPU crossover (~500 panels) while
-    # keeping the one-time compile ~20 s (cached persistently thereafter)
-    panels = mesh_platform(m.members, dz_max=2.5, da_max=2.5)
-    w = np.linspace(0.2, 1.2, nw)
     backend = jax.default_backend()
 
-    def timed(bk):
+    def timed(panels, w, bk):
         solve_bem(panels, w, backend=bk)  # compile + warm
         t0 = time.perf_counter()
         out = solve_bem(panels, w, backend=bk)
         return time.perf_counter() - t0, out
 
-    t_cpu, out_cpu = timed("cpu")
+    # ~850 panels: above the TPU-vs-CPU crossover (~500 panels) while
+    # keeping the one-time compile ~20 s (cached persistently thereafter)
+    panels = mesh_platform(m.members, dz_max=2.5, da_max=2.5)
+    w = np.linspace(0.2, 1.2, nw)
+    t_cpu, out_cpu = timed(panels, w, "cpu")
     res = {
         "bem_panels": len(panels),
         "bem_nw": nw,
@@ -166,12 +175,29 @@ def bench_bem(nw=8):
         "bem_device_backend": backend,
     }
     if backend != "cpu":
-        t_dev, out_dev = timed(backend)
+        t_dev, out_dev = timed(panels, w, backend)
         res["bem_device_s"] = round(t_dev, 3)
         res["bem_device_vs_cpu"] = round(t_cpu / t_dev, 2)
         res["bem_A_rel_err_device_vs_cpu"] = float(
             np.abs(out_dev["A"] - out_cpu["A"]).max()
             / np.abs(out_cpu["A"]).max()
+        )
+
+    panels_l = mesh_platform(m.members, dz_max=1.25, da_max=1.25)
+    w_l = np.linspace(0.2, 0.8, nw_large)
+    t_cpu_l, out_cpu_l = timed(panels_l, w_l, "cpu")
+    res.update({
+        "bem_large_panels": len(panels_l),
+        "bem_large_nw": nw_large,
+        "bem_large_cpu_s": round(t_cpu_l, 3),
+    })
+    if backend != "cpu":
+        t_dev_l, out_dev_l = timed(panels_l, w_l, backend)
+        res["bem_large_device_s"] = round(t_dev_l, 3)
+        res["bem_large_device_vs_cpu"] = round(t_cpu_l / t_dev_l, 2)
+        res["bem_large_A_rel_err_device_vs_cpu"] = float(
+            np.abs(out_dev_l["A"] - out_cpu_l["A"]).max()
+            / np.abs(out_cpu_l["A"]).max()
         )
     return res
 
