@@ -10,7 +10,10 @@ one-shot meshes (the graph specializes on the mesh shape; see solve_bem):
   * constant-strength source panels on the wetted hull (meshed by
     raft_tpu/mesh.py),
   * free-surface Green function G = 1/r + 1/r' + Gw with the wave term Gw
-    evaluated from precomputed regularized tables (raft_tpu/greens.py),
+    evaluated gather-free on TPU (exact Struve/Bessel oscillatory part +
+    per-region Chebyshev remainders, greens.eval_F_F1_cheb; row-blocked
+    assembly feeds the basis contractions to the MXU) and from
+    precomputed regularized tables on CPU (raft_tpu/greens.py),
   * body boundary condition  sigma/2 + K sigma = v_n  solved on-device as
     the equivalent real 2N x 2N block system (the dense complex LU has no
     TPU lowering; real f32 LU does), lax.map'd over frequency — the
@@ -260,7 +263,7 @@ def _blocked_gj(A, b, block=512):
     return x
 
 
-def _solve_all(omegas, betas, x, nrm, area, y, w_q, S0, K0, vmodes, Ft, F1t,
+def _solve_all(omegas, betas, x, nrm, area, y, w_q, S0, K0, vmodes, tables,
                g, rho, real_block, depth, kmax_geom, finite):
     """Device solve over all frequencies (jit target; see solve_bem).
 
@@ -271,8 +274,15 @@ def _solve_all(omegas, betas, x, nrm, area, y, w_q, S0, K0, vmodes, Ft, F1t,
     [[Kr, -Ki], [Ki, Kr]] [sr; si] = [br; bi] (the dense complex LU has
     no TPU lowering; real f32 LU does); backends with a complex LU (CPU)
     use the plain c64 solve at half the flops/memory.  Frequencies are
-    processed by lax.map so one [N,N,Q] wave-term evaluation is live at
-    a time.
+    processed by lax.map so one influence assembly is live at a time.
+
+    ``tables`` selects the wave-term kernel: a dict of Chebyshev patch
+    coefficients (greens.load_cheb_tables) runs the gather-free evaluation
+    — the TPU path, where table gathers dominate assembly time — and the
+    assembly is row-blocked (lax.map over collocation chunks) so the
+    Chebyshev basis matmuls stay in modest [E, deg] blocks; a (F, F1)
+    tuple (greens.load_tables) runs the bilinear-lookup kernel in one
+    whole-matrix sweep — the CPU path, where gathers are cheap.
     """
     import jax
     import jax.numpy as jnp
@@ -280,15 +290,11 @@ def _solve_all(omegas, betas, x, nrm, area, y, w_q, S0, K0, vmodes, Ft, F1t,
     f = jnp.float32
     c = jnp.complex64
     N = x.shape[0]
-
-    # pairwise geometry for the wave term (collocation x quad points),
-    # built on device once — [N,N,Q] never crosses the transfer boundary
-    Rh = jnp.sqrt((x[:, None, None, 0] - y[None, :, :, 0]) ** 2
-                  + (x[:, None, None, 1] - y[None, :, :, 1]) ** 2)
-    zz = x[:, None, None, 2] + y[None, :, :, 2]
-    # unit horizontal direction from source to field point (for dGw/dR)
-    ex = (x[:, None, None, 0] - y[None, :, :, 0]) / jnp.maximum(Rh, 1e-9)
-    ey = (x[:, None, None, 1] - y[None, :, :, 1]) / jnp.maximum(Rh, 1e-9)
+    cheb = isinstance(tables, dict)
+    # row-block size: TPU meshes are padded to multiples of 256; CPU (and
+    # odd sizes) assemble in one sweep like before
+    RB = 32 if (cheb and N % 32 == 0) else N
+    nblk = N // RB
 
     cosb = jnp.cos(betas)[:, None]                       # [nb,1]
     sinb = jnp.sin(betas)[:, None]
@@ -298,33 +304,55 @@ def _solve_all(omegas, betas, x, nrm, area, y, w_q, S0, K0, vmodes, Ft, F1t,
     # mesh shape reuses one compiled executable
     def one_omega(omega):
         nu = omega * omega / g
-        Gw, dGw_dR, dGw_dz = greens.wave_term(nu, Rh, zz, Ft, F1t)
-        if finite:
-            # finite-depth wave-term difference (John's G minus the deep
-            # tabulated part; the seabed-image Rankine term is already in
-            # S0/K0 from _rankine)
-            k0 = greens.dispersion_k0(nu, depth)
-            dGc, dRc, dzc = greens.finite_depth_correction(
-                nu, k0, depth,
-                Rh, x[:, None, None, 2], y[None, :, :, 2], kmax_geom,
-            )
-            Gw = Gw + dGc
-            dGw_dR = dGw_dR + dRc
-            dGw_dz = dGw_dz + dzc
-        else:
-            k0 = nu
-        # e^{+iwt} convention: conjugate branch (outgoing waves)
-        Gw = jnp.conj(Gw)
-        dGw_dR = jnp.conj(dGw_dR)
-        dGw_dz = jnp.conj(dGw_dz)
+        k0 = greens.dispersion_k0(nu, depth) if finite else nu
 
-        Sw = jnp.sum(w_q[None] * Gw, axis=-1)
-        Kw = jnp.sum(
-            w_q[None] * (dGw_dR * (ex * nrm[:, None, None, 0]
-                                   + ey * nrm[:, None, None, 1])
-                         + dGw_dz * nrm[:, None, None, 2]),
-            axis=-1,
-        )
+        def assemble(xc, nc_):
+            """Influence rows for a collocation chunk [RB,3] -> [RB,N]."""
+            Rh = jnp.sqrt((xc[:, None, None, 0] - y[None, :, :, 0]) ** 2
+                          + (xc[:, None, None, 1] - y[None, :, :, 1]) ** 2)
+            zz = xc[:, None, None, 2] + y[None, :, :, 2]
+            ex = (xc[:, None, None, 0] - y[None, :, :, 0]) / jnp.maximum(
+                Rh, 1e-9)
+            ey = (xc[:, None, None, 1] - y[None, :, :, 1]) / jnp.maximum(
+                Rh, 1e-9)
+            if cheb:
+                Gw, dGw_dR, dGw_dz = greens.wave_term_cheb(
+                    nu, Rh, zz, tables)
+            else:
+                Gw, dGw_dR, dGw_dz = greens.wave_term(nu, Rh, zz, *tables)
+            if finite:
+                # finite-depth wave-term difference (John's G minus the
+                # deep tabulated part; the seabed-image Rankine term is
+                # already in S0/K0 from _rankine)
+                dGc, dRc, dzc = greens.finite_depth_correction(
+                    nu, k0, depth,
+                    Rh, xc[:, None, None, 2], y[None, :, :, 2], kmax_geom,
+                )
+                Gw = Gw + dGc
+                dGw_dR = dGw_dR + dRc
+                dGw_dz = dGw_dz + dzc
+            # e^{+iwt} convention: conjugate branch (outgoing waves)
+            Gw = jnp.conj(Gw)
+            dGw_dR = jnp.conj(dGw_dR)
+            dGw_dz = jnp.conj(dGw_dz)
+            Sw = jnp.sum(w_q[None] * Gw, axis=-1)
+            Kw = jnp.sum(
+                w_q[None] * (dGw_dR * (ex * nc_[:, None, None, 0]
+                                       + ey * nc_[:, None, None, 1])
+                             + dGw_dz * nc_[:, None, None, 2]),
+                axis=-1,
+            )
+            return Sw, Kw
+
+        if nblk == 1:
+            Sw, Kw = assemble(x, nrm)
+        else:
+            Sw, Kw = jax.lax.map(
+                lambda args: assemble(*args),
+                (x.reshape(nblk, RB, 3), nrm.reshape(nblk, RB, 3)),
+            )
+            Sw = Sw.reshape(N, N)
+            Kw = Kw.reshape(N, N)
 
         S = S0.astype(c) + Sw
         K = K0.astype(c) + Kw
@@ -493,23 +521,28 @@ def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81,
         pa_wave = panel_arrays(panels, quad=quad)
         if real_block:
             pa_wave = pad_panel_arrays(pa_wave)
-    F_tab, F1_tab = greens.load_tables()
+    # TPU: gather-free Chebyshev wave-term kernel; CPU: bilinear tables
+    if real_block:
+        tables = greens.load_cheb_tables()
+    else:
+        tables = tuple(greens.load_tables())
     vmodes = _radiation_normals(pa)                     # [6, N]
 
     if _solve_all_jit is None:
         _solve_all_jit = jax.jit(
-            _solve_all, static_argnums=(12, 13, 14, 17)
+            _solve_all, static_argnums=(11, 12, 13, 16)
         )
 
     from raft_tpu.utils.placement import backend_sharding
 
     put = lambda a: jax.device_put(        # noqa: E731
         np.asarray(a, np.float32), backend_sharding(backend))
+    tables = jax.tree.map(put, tables)
 
     A, B, Xr, Xi = _solve_all_jit(
         put(omegas), put(betas), put(pa.cen), put(pa.nrm), put(pa.area),
         put(pa_wave.qpts), put(pa_wave.qwts), put(S0), put(K0), put(vmodes),
-        put(F_tab), put(F1_tab), float(g), float(rho), real_block,
+        tables, float(g), float(rho), real_block,
         put(depth if np.isfinite(depth) else 0.0), put(kmax_geom),
         bool(np.isfinite(depth)),
     )

@@ -157,3 +157,58 @@ def test_finite_depth_correction_vs_quadrature():
         jnp.float64(30.0), jnp.float64(-5.0), jnp.float64(-40.0), kmax_geom)
     r2 = np.sqrt(30.0**2 + ((-5.0) + (-40.0) + 2 * h) ** 2)
     assert abs(complex(G_hi) + 1.0 / r2) < 0.02 / r2
+
+
+def test_cheb_eval_matches_quadrature():
+    """The gather-free Chebyshev kernel evaluation (the TPU assembly path)
+    matches the tanh-sinh quadrature across every region patch and the
+    out-of-domain asymptote."""
+    import jax
+
+    C = greens.load_cheb_tables()
+    rng = np.random.default_rng(11)
+    a = np.concatenate([rng.uniform(0, 100, 1500), rng.uniform(0, 3, 500),
+                        rng.uniform(100, 140, 100)])
+    b = np.concatenate([-10**rng.uniform(-5, np.log10(40), 1500),
+                        -10**rng.uniform(-5, 0.5, 500),
+                        -10**rng.uniform(-1, 1.2, 100)])
+    F_ref, F1_ref = greens.compute_F_F1(a, b)
+    with jax.enable_x64(True):
+        Fc, F1c = greens.eval_F_F1_cheb(
+            np.asarray(a), np.asarray(b), C)
+    in_dom = (a <= 100) & (b >= -40)
+    assert np.abs(np.asarray(Fc) - F_ref)[in_dom].max() < 2e-6
+    assert np.abs(np.asarray(F1c) - F1_ref)[in_dom].max() < 1e-4
+    # beyond-domain asymptote sanity (same branch as interp_F_F1)
+    assert np.abs(np.asarray(Fc) - F_ref)[~in_dom].max() < 5e-4
+
+
+def test_b0_closed_forms():
+    """The free-surface closed forms the Chebyshev decomposition rests on:
+    F(a,0) = -(pi/2)(H0+Y0), F1(a,0) = -(pi/2)(H1+Y1) + 1 - 1/a."""
+    from scipy.special import struve, y0, y1
+
+    a = np.array([0.05, 0.5, 2.0, 8.0, 25.0, 60.0])
+    b = np.full_like(a, -1e-12)
+    F, F1 = greens.compute_F_F1(a, b)
+    np.testing.assert_allclose(
+        F, -(np.pi / 2) * (struve(0, a) + y0(a)), atol=5e-9)
+    np.testing.assert_allclose(
+        F1, -(np.pi / 2) * (struve(1, a) + y1(a)) + 1 - 1 / a, atol=5e-9)
+
+
+def test_device_struve_and_smooth_bessels():
+    """Device Struve H0/H1 and smooth-Y remainders vs scipy."""
+    from scipy.special import struve, y0, y1, j0, j1
+
+    from raft_tpu.utils import bessel
+
+    G = 0.5772156649015329
+    x = np.concatenate([np.linspace(1e-5, 6, 200), np.linspace(6, 16, 100),
+                        np.linspace(16, 200, 100)])
+    assert np.abs(np.asarray(bessel.struve_h0(x)) - struve(0, x)).max() < 1e-6
+    assert np.abs(np.asarray(bessel.struve_h1(x)) - struve(1, x)).max() < 1e-6
+    y0sm = y0(x) - (2 / np.pi) * (np.log(x / 2) + G) * j0(x)
+    y1sm = y1(x) + (2 / np.pi) / x - (2 / np.pi) * (np.log(x / 2) + G) * j1(x)
+    assert np.abs(np.asarray(bessel.y0_smooth(x)) - y0sm).max() < 1e-6
+    assert np.abs(np.asarray(bessel.y1_smooth(x)) - y1sm).max() < 1e-6
