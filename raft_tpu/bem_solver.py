@@ -28,14 +28,23 @@ Time convention matches the reference (e^{+i w t}; impedance
 Z = -w^2 M + i w B + C, reference raft/raft_model.py:585-590), so the wave
 term uses the conjugate (outgoing H0^(2)) branch of the tabulated kernel.
 
-Known limitation: irregular frequencies are NOT removed (HAMS exposes
-If_remove_irr_freq; here a rigid-lid variant was prototyped and rejected —
-it suppressed the glitch but introduced placement-sensitive 1-10% errors
-nearby).  For a surface-piercing column of waterline radius a the first
-glitches sit near nu*a ~ 2.4 (heave) and 3.83 (surge) — e.g. ~2.0 and
-~2.5 rad/s for a 12 m column — above the wave band RAFT models resolve
-and near/above the mesh-resolution frequency cap (max_resolved_omega),
-which clamps the solve before the deep irregular region.
+Irregular frequencies are removed by the extended-boundary-condition
+method (HAMS If_remove_irr_freq equivalent): the interior waterplane is
+panelled AT z = 0 (mesh.lid_panels_from_mesh) and joins the system as a
+rigid extension with the doubled-jump lid diagonal (LID_JUMP).  A
+DISPLACED rigid lid (z = -0.4/-0.2 below the surface) was prototyped in
+round 2 and rejected for placement-sensitive 1-10% errors; the z = 0 lid
+works because the TPU kernel is exact at b -> 0 (the closed forms in
+raft_tpu/greens.py).  The CPU path's bilinear table clamps lid-row
+arguments to its b = -1e-5 log-grid floor, which carries up to ~1e-2
+kernel error for close low-frequency pairs; the truncated-cylinder test
+bounds the resulting valid-band bias at ~0.5-1.2% on CPU (vs ~0.3% on
+TPU) — still an order of magnitude below the irregular-frequency glitch
+it removes, but the TPU backend is the precision path for lidded solves.
+Validated on the truncated-cylinder scan through the first glitches
+(nu*a ~ 2.40 heave, 3.83 surge): both removed.  The mesh-resolution
+frequency cap (max_resolved_omega) remains purely a panels-per-
+wavelength limit, decoupled from the irregular band.
 Finite water depth (the depth HAMS receives in its control file, reference
 raft/raft_fowt.py:367-381) is handled as deep water + John's finite-depth
 difference: a seabed-image Rankine term plus an exponentially-decaying
@@ -113,6 +122,17 @@ def panel_arrays(panels, quad="gauss"):
     return PanelArrays(cen=cen, nrm=nrm, area=area, qpts=qpts, qwts=qwts)
 
 
+def _concat_panel_arrays(pa, pb):
+    """Concatenate two PanelArrays along the panel axis."""
+    return PanelArrays(
+        cen=np.concatenate([pa.cen, pb.cen]),
+        nrm=np.concatenate([pa.nrm, pb.nrm]),
+        area=np.concatenate([pa.area, pb.area]),
+        qpts=np.concatenate([pa.qpts, pb.qpts]),
+        qwts=np.concatenate([pa.qwts, pb.qwts]),
+    )
+
+
 def pad_panel_arrays(pa, multiple=256):
     """Pad a PanelArrays to the next multiple of ``multiple`` with exactly
     inert dummy entries: zero area, zero quadrature weight, zero normal,
@@ -147,7 +167,7 @@ def pad_panel_arrays(pa, multiple=256):
     )
 
 
-def _rankine(pa, dtype=np.float64, depth=np.inf):
+def _rankine(pa, dtype=np.float64, depth=np.inf, lid_mask=None):
     """Frequency-independent Rankine + image influence matrices (host, once).
 
     S0[i,j] = int_j (1/r + 1/r') dS,   K0[i,j] = int_j d/dn_i (1/r + 1/r') dS
@@ -195,6 +215,14 @@ def _rankine(pa, dtype=np.float64, depth=np.inf):
     idx = np.arange(N)
     S_r[idx, idx] = 2.0 * np.sqrt(np.pi * pa.area)
     K_r[idx, idx] = 0.0
+    if lid_mask is not None and np.any(lid_mask):
+        # the free-surface image of a z=0 lid panel IS the panel: its
+        # image-self entry takes the same closed-form potential and the
+        # flat-panel zero PV (the generic quadrature would integrate its
+        # own clamped near-singularity instead)
+        li = np.where(lid_mask)[0]
+        S_i[li, li] = 2.0 * np.sqrt(np.pi * pa.area[li])
+        K_i[li, li] = 0.0
     S0, K0 = S_r + S_i, K_r + K_i
     if np.isfinite(depth):
         yb = y.copy()
@@ -263,8 +291,8 @@ def _blocked_gj(A, b, block=512):
     return x
 
 
-def _solve_all(omegas, betas, x, nrm, area, y, w_q, S0, K0, vmodes, tables,
-               g, rho, real_block, depth, kmax_geom, finite):
+def _solve_all(omegas, betas, x, nrm, area, y, w_q, S0, K0, vmodes, jump,
+               tables, g, rho, real_block, depth, kmax_geom, finite):
     """Device solve over all frequencies (jit target; see solve_bem).
 
     All inputs/outputs are real f32 (complex never crosses the host-device
@@ -357,9 +385,11 @@ def _solve_all(omegas, betas, x, nrm, area, y, w_q, S0, K0, vmodes, tables,
         S = S0.astype(c) + Sw
         K = K0.astype(c) + Kw
         # exterior (fluid-side) limit of the single-layer normal derivative:
-        # dphi/dn = -sigma/2 + K' sigma  (pulsating-sphere eigenvalue check
-        # K'[1] = -1/2 fixes the jump sign; see tests/test_bem_solver.py)
-        lhs = K / (4 * jnp.pi) - 0.5 * jnp.eye(N, dtype=c)
+        # dphi/dn = jump*sigma + K' sigma with jump = -1/2 on body rows
+        # (pulsating-sphere eigenvalue check K'[1] = -1/2 fixes the sign;
+        # see tests/test_bem_solver.py) and LID_JUMP on interior
+        # free-surface rows (their coincident image doubles the layer)
+        lhs = K / (4 * jnp.pi) + jnp.diag(jump).astype(c)
 
         # radiation RHS (unit velocity) + diffraction RHS per heading;
         # finite depth uses the cosh-profile incident wave at wavenumber k0
@@ -436,11 +466,31 @@ _RANKINE_CACHE_BYTES = 256 * 1024 * 1024
 TPU_PANEL_LIMIT = 4096
 
 
+# lid-row jump coefficient of the extended integral equation: the
+# free-surface image of a z=0 panel coincides with the panel (doubling
+# the effective layer) and the collocation limit approaches from the
+# interior side, flipping the sign relative to a body row's -1/2.
+# Selected from the +-1/2, +-1 candidates by the truncated-cylinder
+# irregular-frequency scan (tests/test_bem_solver.py): +1 removes the
+# nu*a ~ 2.40/3.83 glitches (J0/J1 zeros) cleanly and leaves the valid
+# band within ~0.3% of the lid-free solve; -1/2 and -1 made the
+# irregular behavior worse.
+LID_JUMP = 1.0
+
+
 def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81,
-              quad="gauss", backend=None, depth=np.inf):
+              quad="gauss", backend=None, depth=np.inf, lid_panels=None):
     """Radiation + diffraction solve over frequencies.
 
     panels : [npan,4,3] wetted-hull panels (outward normals)
+    lid_panels : optional [nlid,4,3] interior free-surface panels at z=0
+        (mesh.lid_panels_from_mesh) — the extended-boundary-condition
+        irregular-frequency removal: lids join the system as rigid
+        extensions (zero radiation normal velocity, diffraction forced
+        like body panels) but are excluded from the pressure-force
+        integrals, displacing the interior-problem eigenfrequencies out
+        of the wave band (HAMS If_remove_irr_freq equivalent, reference
+        raft/raft_fowt.py:381).
     omegas : [nw] rad/s;  betas : wave headings [rad]
     depth : water depth [m] (np.inf = deep water).  Finite depth adds the
         seabed-image Rankine term, the John wave-term correction
@@ -461,6 +511,10 @@ def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81,
     global _solve_all_jit
 
     pa = panel_arrays(panels)        # 2x2 Gauss for the singular Rankine part
+    n_body = pa.n
+    has_lid = lid_panels is not None and len(lid_panels) > 0
+    if has_lid:
+        pa = _concat_panel_arrays(pa, panel_arrays(lid_panels))
     n_real = pa.n
     depth = float(depth)
     # keel depth from panel VERTICES — centroids sit up to half a panel
@@ -494,13 +548,21 @@ def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81,
         # bucket the mesh size (compile reuse across designs) and give the
         # blocked large-N solve its 512-row block multiple
         pa = pad_panel_arrays(pa)
+    # lid rows: everything past the body panels, up to the bucket padding
+    # (dummy pad entries keep the body jump; their rows are inert anyway)
+    lid_mask = np.zeros(pa.n, bool)
+    lid_mask[n_body:n_real] = True
+    jump = np.where(lid_mask, LID_JUMP, -0.5)
     # the frequency-independent Rankine assembly is ~0.6-0.8 s of host
     # time per call at ~850 panels; repeated solves of the same mesh
     # (preview + final, preprocess_hams after run_bem, benchmarks) reuse it
-    key = (np.asarray(panels, float).tobytes(), depth, pa.n)
+    key = (
+        np.asarray(panels, float).tobytes(), depth, pa.n,
+        np.asarray(lid_panels, float).tobytes() if has_lid else b"",
+    )
     cached = _rankine_cache.get(key)
     if cached is None:
-        S0f, K0f = _rankine(pa, depth=depth)
+        S0f, K0f = _rankine(pa, depth=depth, lid_mask=lid_mask)
         # cache in f32 — the solver consumes f32 anyway, and it doubles
         # how many meshes fit the byte budget
         cached = (S0f.astype(np.float32), K0f.astype(np.float32))
@@ -519,6 +581,9 @@ def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81,
         pa_wave = pa
     else:
         pa_wave = panel_arrays(panels, quad=quad)
+        if has_lid:
+            pa_wave = _concat_panel_arrays(
+                pa_wave, panel_arrays(lid_panels, quad=quad))
         if real_block:
             pa_wave = pad_panel_arrays(pa_wave)
     # TPU: gather-free Chebyshev wave-term kernel; CPU: bilinear tables
@@ -527,10 +592,13 @@ def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81,
     else:
         tables = tuple(greens.load_tables())
     vmodes = _radiation_normals(pa)                     # [6, N]
+    # lids are rigid extensions: zero radiation normal velocity AND zero
+    # weight in the pressure-force integrals (both flow through vmodes)
+    vmodes[:, lid_mask] = 0.0
 
     if _solve_all_jit is None:
         _solve_all_jit = jax.jit(
-            _solve_all, static_argnums=(11, 12, 13, 16)
+            _solve_all, static_argnums=(12, 13, 14, 17)
         )
 
     from raft_tpu.utils.placement import backend_sharding
@@ -542,7 +610,7 @@ def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81,
     A, B, Xr, Xi = _solve_all_jit(
         put(omegas), put(betas), put(pa.cen), put(pa.nrm), put(pa.area),
         put(pa_wave.qpts), put(pa_wave.qwts), put(S0), put(K0), put(vmodes),
-        tables, float(g), float(rho), real_block,
+        put(jump), tables, float(g), float(rho), real_block,
         put(depth if np.isfinite(depth) else 0.0), put(kmax_geom),
         bool(np.isfinite(depth)),
     )
@@ -567,12 +635,17 @@ def max_resolved_omega(panel_size, g=9.81, panels_per_wavelength=7.0):
 
 def coeffs_from_members(members, omegas, headings_deg=(0.0,), rho=1025.0,
                         g=9.81, dz_max=0.0, da_max=0.0, panels=None,
-                        quad="gauss", backend=None, depth=np.inf):
+                        quad="gauss", backend=None, depth=np.inf,
+                        irr_removal=True):
     """Mesh all potMod members, run the native solver, return a HydroCoeffs
     set (same container the WAMIT-file import path produces, so the Model
     pipeline is agnostic to where coefficients came from).
 
     A pre-built panel array can be passed to skip the meshing step.
+
+    irr_removal : generate interior free-surface lids from the mesh's
+        waterline loops and solve the extended system (irregular-frequency
+        removal, on by default — the HAMS If_remove_irr_freq equivalent).
 
     Frequencies above what the mesh resolves are clamped to the solve cap
     and back-filled with the cap value for A (B, X decay there anyway) —
@@ -580,19 +653,24 @@ def coeffs_from_members(members, omegas, headings_deg=(0.0,), rho=1025.0,
     (reference raft/raft_fowt.py:398-401).
     """
     from raft_tpu.bem import HydroCoeffs
-    from raft_tpu.mesh import mesh_platform, panel_geometry
+    from raft_tpu.mesh import (
+        lid_panels_from_mesh,
+        mesh_platform,
+        panel_geometry,
+    )
 
     omegas = np.sort(np.asarray(omegas, float))
     if panels is None:
         panels = mesh_platform(members, dz_max=dz_max, da_max=da_max)
     if len(panels) == 0:
         raise ValueError("no potMod members to mesh for the BEM solve")
+    lids = lid_panels_from_mesh(panels) if irr_removal else None
     size = float(np.sqrt(np.median(panel_geometry(panels)[2])))
     w_cap = max_resolved_omega(size, g=g)
     w_solve = np.unique(np.minimum(omegas, w_cap))
     betas = np.deg2rad(np.asarray(headings_deg, float))
     out = solve_bem(panels, w_solve, betas=betas, rho=rho, g=g, quad=quad,
-                    backend=backend, depth=depth)
+                    backend=backend, depth=depth, lid_panels=lids)
     return HydroCoeffs(
         w=out["w"], A=out["A"], B=out["B"],
         headings=np.asarray(headings_deg, float), X=out["X"],

@@ -532,3 +532,82 @@ def _native_or_python_revolve(r_rp, z_rp, da_max):
     if n < 0:  # capacity exceeded — fall back
         return revolve_profile(r_rp, z_rp, da_max)
     return out[:n]
+
+
+def lid_panels_from_mesh(panels, nr=2, z_tol=1e-6):
+    """Interior free-surface ("lid") panels for irregular-frequency removal:
+    extract the waterline loop(s) of a clipped hull mesh and fill each with
+    ``nr`` concentric rings of quads collapsing to the loop centroid.
+
+    This is the geometric half of the extended-boundary-condition method
+    (the reference's external solver exposes it as HAMS
+    If_remove_irr_freq, consumed at reference raft/raft_fowt.py:381): the
+    interior waterplane is panelled AT z = 0 and joins the body surface as
+    a rigid extension (v_n = 0), displacing the interior-problem
+    eigenfrequencies out of the wave band.  Works for any surface-piercing
+    waterline whose loop is star-shaped about its centroid (circular and
+    rectangular columns included).
+
+    Keep ``nr`` SMALL: the lid only needs to represent the interior
+    waterplane approximately, and refining it degrades the source-system
+    conditioning through near-singular lid<->waterline-panel interactions
+    (measured on the truncated cylinder: nr=2 biases the valid band
+    <= 0.3%, nr=8 up to 4%).
+
+    Returns [nlid, 4, 3] panels lying exactly at z = 0 (normals +z).
+    """
+    p = np.asarray(panels, float)
+    # collect panel edges with both endpoints on the waterplane
+    edges = {}
+    for quad in p:
+        for k in range(4):
+            a, b = quad[k], quad[(k + 1) % 4]
+            if abs(a[2]) < z_tol and abs(b[2]) < z_tol:
+                ka = (round(a[0], 6), round(a[1], 6))
+                kb = (round(b[0], 6), round(b[1], 6))
+                if ka != kb:
+                    edges.setdefault(ka, []).append(kb)
+    loops = []
+    visited = set()
+    for start in list(edges):
+        if start in visited:
+            continue
+        loop = [start]
+        visited.add(start)
+        cur = start
+        while True:
+            nxts = [v for v in edges.get(cur, []) if v not in visited]
+            if not nxts:
+                break
+            cur = nxts[0]
+            visited.add(cur)
+            loop.append(cur)
+        if len(loop) >= 3:
+            loops.append(np.array(loop, float))
+    out = []
+    for loop in loops:
+        c = loop.mean(axis=0)
+        ts = np.linspace(1.0, 0.0, nr + 1)
+        nv = len(loop)
+        for k in range(nr):
+            P1 = c + ts[k] * (loop - c)          # outer ring [nv, 2]
+            P2 = c + ts[k + 1] * (loop - c)      # inner ring
+            for i in range(nv):
+                j = (i + 1) % nv
+                quad = np.zeros((4, 3))
+                # wind so the +z normal comes out of panel_geometry for a
+                # counter-clockwise waterline loop; orientation is fixed
+                # below regardless of loop direction
+                quad[0, :2] = P1[i]
+                quad[1, :2] = P1[j]
+                quad[2, :2] = P2[j]
+                quad[3, :2] = P2[i]
+                out.append(quad)
+    if not out:
+        return np.zeros((0, 4, 3))
+    lids = np.asarray(out)
+    # enforce +z normals panel-by-panel (loop direction may be either way)
+    _, nrm, _ = panel_geometry(lids)
+    flip = nrm[:, 2] < 0.0
+    lids[flip] = lids[flip, ::-1]
+    return lids

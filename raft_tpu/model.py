@@ -280,7 +280,7 @@ class Model:
         return self.bem_coeffs
 
     def run_bem(self, headings=(0.0,), nw_bem=24, dz_max=None, da_max=None,
-                panels=None, quad="gauss", w_grid=None):
+                panels=None, quad="gauss", w_grid=None, irr_removal=True):
         """Run the NATIVE radiation/diffraction panel solver on all potMod
         members (the reference's calcBEM path, raft/raft_fowt.py:318-423,
         with the external Fortran HAMS subprocess replaced by the TPU-native
@@ -310,6 +310,7 @@ class Model:
             headings_deg=headings, rho=self.rho_water, g=self.g,
             dz_max=dz, da_max=da, panels=panels, quad=quad,
             backend=self.device, depth=self.depth,
+            irr_removal=irr_removal,
         )
         return self.bem_coeffs
 

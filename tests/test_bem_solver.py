@@ -178,3 +178,39 @@ def test_padded_real_block_solve_inert(monkeypatch):
     scaleX = np.abs(out_cpu["X"]).max()
     assert np.abs(out_pad["A"] - out_cpu["A"]).max() < 2e-4 * scaleA
     assert np.abs(out_pad["X"] - out_cpu["X"]).max() < 2e-4 * scaleX
+
+
+def test_irregular_frequency_removal():
+    """Extended-boundary-condition lid (z=0 interior waterplane panels,
+    doubled-jump diagonal): the truncated cylinder's first irregular
+    frequencies — surge near nu*a = 3.83 (J1 zero) and heave near
+    nu*a = 2.40 (J0 zero) — are removed, while the valid band stays
+    within ~1% of the lid-free solve."""
+    cyl = mesh.clip_waterplane(mesh.mesh_member(
+        [0, 2], [2.0, 2.0], np.array([0.0, 0.0, -1.0]),
+        np.array([0.0, 0.0, 1.0]), 0.15, 0.15))
+    lids = mesh.lid_panels_from_mesh(cyl)
+    assert len(lids) > 0 and np.all(np.abs(lids[:, :, 2]) < 1e-9)
+    g, rho = 9.81, 1000.0
+
+    # surge glitch: on-glitch vs trend of the neighbors
+    nus = np.array([3.70, 3.85, 4.00])
+    ws = np.sqrt(nus * g)
+    out0 = bem_solver.solve_bem(cyl, ws, rho=rho, g=g)
+    outL = bem_solver.solve_bem(cyl, ws, rho=rho, g=g, lid_panels=lids)
+    trend0 = 0.5 * (out0["A"][0, 0, 0] + out0["A"][2, 0, 0])
+    trendL = 0.5 * (outL["A"][0, 0, 0] + outL["A"][2, 0, 0])
+    dev0 = abs(out0["A"][1, 0, 0] - trend0) / trend0
+    devL = abs(outL["A"][1, 0, 0] - trendL) / trendL
+    assert dev0 > 0.03          # the lid-free solve shows the glitch
+    assert devL < 0.005         # the lid removes it
+    # valid band: lid bias small
+    nus_ok = np.array([0.8, 1.5])
+    ws_ok = np.sqrt(nus_ok * g)
+    a0 = bem_solver.solve_bem(cyl, ws_ok, rho=rho, g=g)["A"]
+    aL = bem_solver.solve_bem(cyl, ws_ok, rho=rho, g=g,
+                              lid_panels=lids)["A"]
+    assert np.abs(aL[:, 0, 0] - a0[:, 0, 0]).max() < 0.012 * np.abs(
+        a0[:, 0, 0]).max()
+    assert np.abs(aL[:, 2, 2] - a0[:, 2, 2]).max() < 0.005 * np.abs(
+        a0[:, 2, 2]).max()
