@@ -99,11 +99,24 @@ def main():
     rao_np = np.abs(Xi_np) / np.where(mask, np.abs(zeta), np.inf)[:, None, :]
     rao_err = float(np.max(np.abs(rao_jax - rao_np)))
 
+    from bench_sweep import PEAK_FLOPS_BF16
+    from raft_tpu.utils.profiling import compiled_flops
+
+    rao_flops = compiled_flops(fn, dev_args)
+
     out = {
         "metric": "VolturnUS-S RAO-solve wall-clock (128 w x 12 cases)",
         "value": round(t_jax, 6),
         "unit": "s",
         "vs_baseline": round(t_np / t_jax, 2),
+        "rao_gflops": round(rao_flops / 1e9, 3),
+        "rao_achieved_gflops_s": (
+            round(rao_flops / t_per_solve / 1e9, 2) if rao_flops else 0.0
+        ),
+        "rao_mfu_vs_bf16_peak": (
+            round(rao_flops / t_per_solve / PEAK_FLOPS_BF16, 6)
+            if rao_flops else 0.0
+        ),
         "baseline_numpy_s": round(t_np, 3),
         "on_device_per_solve_s": round(t_per_solve, 6),
         "vs_baseline_on_device": round(t_np / t_per_solve, 2),
@@ -125,9 +138,18 @@ def main():
     try:
         import bench_sweep
 
-        out.update(bench_sweep.run(baseline_limit=64, verbose=False))
+        out.update(bench_sweep.run(baseline_limit=48, verbose=False))
     except Exception as exc:  # pragma: no cover - defensive for the driver
         out["sweep_error"] = f"{type(exc).__name__}: {exc}"
+
+    # ---- the reference's 5-parameter geometry study: 3^5 = 243 points
+    # with dependent geometry, fairlead repositioning, and ballast trim
+    # (reference raft/parametersweep.py:40-100) ----
+    try:
+        out.update(bench_sweep.run_geometry(baseline_limit=12,
+                                            verbose=False))
+    except Exception as exc:  # pragma: no cover - defensive for the driver
+        out["sweep243_error"] = f"{type(exc).__name__}: {exc}"
 
     # ---- native BEM radiation/diffraction assembly+solve timing: the OC3
     # spar mesh on the default backend (TPU here) vs CPU, warm numbers ----
@@ -161,7 +183,13 @@ def bench_bem(nw=8, nw_large=4):
         solve_bem(panels, w, backend=bk)  # compile + warm
         t0 = time.perf_counter()
         out = solve_bem(panels, w, backend=bk)
-        return time.perf_counter() - t0, out
+        dt = time.perf_counter() - t0
+        # flops queried OUTSIDE the timed window (the cost query re-lowers
+        # the graph, which must not pollute the wall-clock)
+        out["flops"] = solve_bem(
+            panels, w, backend=bk, report_cost=True
+        ).get("flops", 0.0)
+        return dt, out
 
     # ~850 panels: above the TPU-vs-CPU crossover (~500 panels) while
     # keeping the one-time compile ~20 s (cached persistently thereafter)
@@ -175,6 +203,8 @@ def bench_bem(nw=8, nw_large=4):
         "bem_device_backend": backend,
     }
     if backend != "cpu":
+        from bench_sweep import PEAK_FLOPS_BF16
+
         t_dev, out_dev = timed(panels, w, backend)
         res["bem_device_s"] = round(t_dev, 3)
         res["bem_device_vs_cpu"] = round(t_cpu / t_dev, 2)
@@ -182,6 +212,11 @@ def bench_bem(nw=8, nw_large=4):
             np.abs(out_dev["A"] - out_cpu["A"]).max()
             / np.abs(out_cpu["A"]).max()
         )
+        fl = float(out_dev.get("flops", 0.0))
+        if fl:
+            res["bem_achieved_gflops_s"] = round(fl / t_dev / 1e9, 2)
+            res["bem_mfu_vs_bf16_peak"] = round(
+                fl / t_dev / PEAK_FLOPS_BF16, 6)
 
     panels_l = mesh_platform(m.members, dz_max=1.25, da_max=1.25)
     w_l = np.linspace(0.2, 0.8, nw_large)

@@ -76,12 +76,31 @@ def _apply_point_numpy(base_design, draft, ballast):
 def run_numpy_sweep(base_design, drafts, ballasts, cases, wind, zeta, beta,
                     w, k, depth, rho, g, yawstiff, XiStart, nIter,
                     hHub, rotor_cfg=None, limit=None):
-    """Serial single-core NumPy sweep (the baseline).  Returns (wall-clock
-    seconds, metrics dict, Xi of the last design) over the first ``limit``
-    designs (None = all).  ``rotor_cfg`` (rotor_numpy.rotor_numpy_config)
-    enables the aero-servo path for wind cases."""
+    """Serial single-core NumPy draft x ballast sweep (the baseline):
+    builds the per-point design dicts and hands them to
+    :func:`run_numpy_designs`."""
+    points = [(d, bl) for d in drafts for bl in ballasts]
+    if limit is not None:
+        points = points[:limit]
+    designs = [_apply_point_numpy(base_design, dr, bl) for dr, bl in points]
+    return run_numpy_designs(
+        designs, cases, wind, zeta, beta, w, k, depth, rho, g, yawstiff,
+        XiStart, nIter, hHub, rotor_cfg=rotor_cfg,
+    )
+
+
+def run_numpy_designs(designs, cases, wind, zeta, beta,
+                      w, k, depth, rho, g, yawstiff, XiStart, nIter,
+                      hHub, rotor_cfg=None, trim_ballast_density=False):
+    """Serial single-core NumPy sweep over explicit design dicts (the
+    baseline's general form, mirroring the reference sweep's
+    full-model-per-point loop).  Returns (wall-clock seconds, metrics
+    dict, Xi of the last design).  ``rotor_cfg``
+    (rotor_numpy.rotor_numpy_config) enables the aero-servo path for wind
+    cases; ``trim_ballast_density`` applies the same closed-form uniform
+    density trim as the fused path (symmetrically timed)."""
     from raft_tpu.geometry import pack_nodes, process_members
-    from raft_tpu.mooring_numpy import case_mooring_np
+    from raft_tpu.mooring_numpy import case_mooring_np, line_forces_np
     from raft_tpu.mooring import parse_mooring
     from raft_tpu.reference_numpy import (
         _translate_matrix_3to6,
@@ -91,9 +110,7 @@ def run_numpy_sweep(base_design, drafts, ballasts, cases, wind, zeta, beta,
     from raft_tpu.rotor_numpy import aero_servo_np, case_gains_np
     from raft_tpu.statics import compute_statics
 
-    points = [(d, bl) for d in drafts for bl in ballasts]
-    if limit is not None:
-        points = points[:limit]
+    points = designs
     nc, nw = zeta.shape
     wind = np.asarray(wind, float)
     wind_idx = (
@@ -116,14 +133,34 @@ def run_numpy_sweep(base_design, drafts, ballasts, cases, wind, zeta, beta,
     Xi = None
 
     t0 = time.perf_counter()
-    for ip, (dr, bl) in enumerate(points):
-        d = _apply_point_numpy(base_design, dr, bl)
+    for ip, d in enumerate(points):
         members = process_members(d)
         nodes = pack_nodes(members)
         st = compute_statics(members, d["turbine"], rho, g)
         A = added_mass_numpy(nodes, rho)
         ms = parse_mooring(d["mooring"], rho_water=rho, g=g)
-        props = (st.mass, st.V, st.rCG_TOT, np.array([0.0, 0.0, st.zMeta]),
+        mass_d, rCG_d = st.mass, st.rCG_TOT
+        M_struc_d, C_struc_d = st.M_struc, st.C_struc
+        if trim_ballast_density:
+            # same closed-form uniform density trim as the fused path
+            from raft_tpu.sweep_fused import _scale_fill, _unit_fill
+
+            S0 = compute_statics(
+                [_scale_fill(m, 0.0) for m in members], d["turbine"],
+                rho, g)
+            Su = compute_statics(
+                [_unit_fill(m) for m in members], d["turbine"], rho, g)
+            Fz0 = line_forces_np(
+                np.zeros(6), ms.anchors, ms.rFair, ms.L, ms.EA, ms.w,
+                ms.Wp)[0][2]
+            Vf = max(Su.mass - S0.mass, 1e-12)
+            delta = (rho * st.V + Fz0 / g - st.mass) / Vf
+            mass_d = st.mass + delta * Vf
+            rCG_d = (st.mass * st.rCG_TOT + delta * (
+                Su.mass * Su.rCG_TOT - S0.mass * S0.rCG_TOT)) / mass_d
+            M_struc_d = st.M_struc + delta * (Su.M_struc - S0.M_struc)
+            C_struc_d = st.C_struc + delta * (Su.C_struc - S0.C_struc)
+        props = (mass_d, st.V, rCG_d, np.array([0.0, 0.0, st.zMeta]),
                  st.AWP)
 
         # first-pass rotor at zero platform pitch, per wind case
@@ -153,9 +190,9 @@ def run_numpy_sweep(base_design, drafts, ballasts, cases, wind, zeta, beta,
         r6_c = np.stack([r6_g[inv[i]] for i in range(nc)])       # [nc, 6]
         C_moor_c = np.stack([C_g[inv[i]] for i in range(nc)])    # [nc, 6, 6]
 
-        C_lin = st.C_struc + st.C_hydro + C_moor_c
+        C_lin = C_struc_d + st.C_hydro + C_moor_c
         M_lin = np.broadcast_to(
-            st.M_struc + A, (nc, nw, 6, 6)
+            M_struc_d + A, (nc, nw, 6, 6)
         ).copy()
         B_lin = np.zeros((nc, nw, 6, 6))
         # second-pass rotor at each case's mean platform pitch -> hub
@@ -176,7 +213,7 @@ def run_numpy_sweep(base_design, drafts, ballasts, cases, wind, zeta, beta,
         std[ip] = np.sqrt(
             np.sum(np.abs(Xi) ** 2, axis=-1) * dw
         ).reshape(nc, 6)
-        mass[ip] = st.mass
+        mass[ip] = mass_d
         offset[ip] = np.hypot(r6_c[0, 0], r6_c[0, 1])
         pitch[ip] = np.rad2deg(r6_c[0, 4])
     t_np = time.perf_counter() - t0
@@ -292,11 +329,120 @@ def run(baseline_limit=None, verbose=True):
             k: round(v, 3) for k, v in res_hot["timing"].items()
         },
     }
+    out.update(_utilization("sweep_dynamics", res_hot))
     if verbose:
         print(json.dumps(out))
     return out
 
 
+# v5e single-chip peak (bf16 systolic); the dynamics/BEM matmuls run at
+# forced-f32 ("highest") precision, i.e. multiple bf16 passes, so MFU
+# against this peak understates the arithmetic actually performed
+PEAK_FLOPS_BF16 = 197e12
+
+
+def _utilization(prefix, res):
+    """Achieved GFLOP/s + model-flop-utilization entries for a sweep
+    result carrying dynamics_flops and the dispatch wall-clock."""
+    fl = float(res.get("dynamics_flops", 0.0))
+    t = float(res["timing"]["dynamics_first_s"])
+    if fl <= 0.0 or t <= 0.0:
+        return {}
+    return {
+        f"{prefix}_gflops": round(fl / 1e9, 2),
+        f"{prefix}_achieved_gflops_s": round(fl / t / 1e9, 2),
+        f"{prefix}_mfu_vs_bf16_peak": round(fl / t / PEAK_FLOPS_BF16, 6),
+    }
+
+
+GEOM_LO, GEOM_HI = 0.9, 1.1   # the 3-level scale grid per axis
+
+
+def run_geometry(baseline_limit=12, verbose=True):
+    """The reference's 5-parameter geometry study (3^5 = 243 points over
+    center/outer column diameter, draft, column spacing, pontoon height
+    with dependent geometry + fairlead repositioning + ballast trim,
+    reference raft/parametersweep.py:40-100) through the general fused
+    sweep, against the serial full-model-per-point NumPy baseline.
+
+    Both paths run the full 12-case table (6 operating-wind cases) per
+    point and the same closed-form density trim.  The baseline is timed
+    on ``baseline_limit`` points and scaled linearly.
+    """
+    from raft_tpu.model import Model
+    from raft_tpu.io.schema import cases_as_dicts
+    from raft_tpu.rotor_numpy import rotor_numpy_config
+    from raft_tpu.sweep_fused import apply_volturnus_point, run_design_sweep
+
+    base, aero_on = _flagship_wind_design()
+    if "blade" not in base.get("turbine", {}):
+        return {"sweep243_error": "reference design not mounted"}
+    levels = [GEOM_LO, 1.0, GEOM_HI]
+    pts = [
+        dict(ccD=a, ocD=b, draft=c, spacing=d, pontoon=e)
+        for a in levels for b in levels for c in levels
+        for d in levels for e in levels
+    ]
+    designs = [apply_volturnus_point(base, **p) for p in pts]
+
+    model0 = Model(base)
+    cases = cases_as_dicts(base)
+    spec, height, period, beta, wind = model0._case_arrays(cases)
+    zeta = model0._zeta(spec, height, period)
+    rotor_cfg = rotor_numpy_config(base["turbine"], base["site"])
+
+    res = run_design_sweep(designs, group=64, trim_ballast_density=True,
+                           verbose=verbose)
+    t0 = time.perf_counter()
+    res = run_design_sweep(designs, group=64, trim_ballast_density=True,
+                           verbose=verbose)
+    t_fused = time.perf_counter() - t0
+
+    nb = min(baseline_limit, len(designs))
+    t_np, np_metrics, _ = run_numpy_designs(
+        designs[:nb], cases, wind, zeta, beta, model0.w, model0.k,
+        model0.depth, model0.rho_water, model0.g, model0.yawstiff,
+        model0.XiStart, model0.nIter, model0.hHub, rotor_cfg=rotor_cfg,
+        trim_ballast_density=True,
+    )
+    baseline_full = t_np / nb * len(designs)
+
+    mass_err = float(np.max(np.abs(
+        res["mass"][:nb] - np_metrics["mass"]) / np_metrics["mass"]))
+    off_err = float(np.max(np.abs(
+        res["offset"][:nb] - np_metrics["offset"])))
+    denom = np.maximum(np.abs(np_metrics["std"]), 1e-3)
+    std_err = float(np.max(
+        np.abs(res["std"][:nb] - np_metrics["std"]) / denom))
+
+    out = {
+        "sweep243_n_designs": len(designs),
+        "sweep243_wall_s": round(t_fused, 3),
+        "sweep243_per_design_ms": round(t_fused / len(designs) * 1000, 2),
+        "sweep243_baseline_numpy_s": round(t_np, 3),
+        "sweep243_baseline_designs_timed": nb,
+        "sweep243_baseline_full_s": round(baseline_full, 3),
+        "sweep243_vs_baseline": round(baseline_full / t_fused, 2),
+        "sweep243_mass_rel_err": mass_err,
+        "sweep243_offset_abs_err_m": off_err,
+        "sweep243_std_rel_err": std_err,
+        "sweep243_converged_frac": float(np.mean(res["converged"])),
+        "sweep243_timing_breakdown": {
+            k: round(v, 3) for k, v in res["timing"].items()
+        },
+        # the reference study's contour-matrix outputs, on the 3^5 grid
+        "sweep243_outputs_shape": [3, 3, 3, 3, 3],
+    }
+    out.update(_utilization("sweep243_dynamics", res))
+    if verbose:
+        print(json.dumps({k: v for k, v in out.items()
+                          if not isinstance(v, dict)}))
+    return out
+
+
 if __name__ == "__main__":
     limit = int(sys.argv[1]) if len(sys.argv) > 1 else None
-    run(baseline_limit=limit)
+    if len(sys.argv) > 2 and sys.argv[2] == "geom":
+        run_geometry(baseline_limit=limit or 12)
+    else:
+        run(baseline_limit=limit)

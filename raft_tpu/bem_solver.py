@@ -479,7 +479,8 @@ LID_JUMP = 1.0
 
 
 def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81,
-              quad="gauss", backend=None, depth=np.inf, lid_panels=None):
+              quad="gauss", backend=None, depth=np.inf, lid_panels=None,
+              report_cost=False):
     """Radiation + diffraction solve over frequencies.
 
     panels : [npan,4,3] wetted-hull panels (outward normals)
@@ -607,13 +608,14 @@ def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81,
         np.asarray(a, np.float32), backend_sharding(backend))
     tables = jax.tree.map(put, tables)
 
-    A, B, Xr, Xi = _solve_all_jit(
+    call_args = (
         put(omegas), put(betas), put(pa.cen), put(pa.nrm), put(pa.area),
         put(pa_wave.qpts), put(pa_wave.qwts), put(S0), put(K0), put(vmodes),
         put(jump), tables, float(g), float(rho), real_block,
         put(depth if np.isfinite(depth) else 0.0), put(kmax_geom),
         bool(np.isfinite(depth)),
     )
+    A, B, Xr, Xi = _solve_all_jit(*call_args)
     out = {
         "w": np.asarray(omegas, float),
         "A": np.asarray(A, np.float64),
@@ -623,6 +625,10 @@ def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81,
         "npanels": n_real,
         "npanels_solved": pa.n,   # incl. inert bucket padding on TPU
     }
+    if report_cost:
+        from raft_tpu.utils.profiling import compiled_flops
+
+        out["flops"] = compiled_flops(_solve_all_jit, call_args)
     return out
 
 

@@ -115,3 +115,18 @@ def trace(log_dir="/tmp/raft_tpu_trace"):
         yield log_dir
     finally:
         jax.profiler.stop_trace()
+
+
+def compiled_flops(jitted_fn, args):
+    """XLA cost-model flop count of a jitted function at the given
+    arguments (compiled.cost_analysis; the lower+compile hits the jit and
+    persistent caches, so this is cheap on a warm executable).  Returns
+    0.0 when the backend does not report costs — callers should treat the
+    value as an estimate for utilization reporting, not a guarantee."""
+    try:
+        cost = jitted_fn.lower(*args).compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        return float(cost.get("flops", 0.0)) if cost else 0.0
+    except Exception:  # pragma: no cover - cost model availability varies
+        return 0.0

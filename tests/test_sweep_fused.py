@@ -165,3 +165,79 @@ def test_wind_cases_without_rotor_warn():
         )
     assert res["converged"].all()
     assert np.all(res["F_aero0"] == 0.0)
+
+
+@pytest.mark.skipif(
+    not __import__("os").path.exists(VOLTURNUS),
+    reason="reference designs not mounted",
+)
+def test_general_design_sweep_matches_direct_model():
+    """The general design-list sweep (per-design geometry bundles, padded
+    design axis, closed-form density trim) matches the direct Model path
+    on 5-parameter VolturnUS variations, including a wind case."""
+    from raft_tpu.io.schema import load_design
+    from raft_tpu.sweep_fused import apply_volturnus_point, run_design_sweep
+
+    base = load_design(VOLTURNUS)
+    base["settings"] = {
+        "min_freq": 0.02, "max_freq": 0.6, "XiStart": 0.1, "nIter": 15,
+    }
+    keys = base["cases"]["keys"]
+    row = dict(zip(keys, base["cases"]["data"][0]))
+    rows = []
+    for wind, hs, tp in [(0.0, 3.0, 8.0), (12.0, 4.5, 9.0)]:
+        r = dict(row)
+        r.update(wind_speed=wind, wave_spectrum="JONSWAP",
+                 wave_height=hs, wave_period=tp)
+        rows.append([r[k] for k in keys])
+    base["cases"]["data"] = rows
+
+    points = [
+        dict(ccD=1.1, ocD=0.95, draft=1.05, spacing=0.95, pontoon=1.1),
+        dict(ccD=0.9, ocD=1.05, draft=0.95, spacing=1.05, pontoon=0.9),
+        dict(),  # base geometry
+    ]
+    designs = [apply_volturnus_point(base, **p) for p in points]
+    res = run_design_sweep(designs, group=2, return_xi=True, verbose=False)
+    assert res["converged"].all()
+
+    for i in (0, 2):
+        m = Model(designs[i])
+        m.analyze_unloaded()
+        args, aux = m.prepare_case_inputs(verbose=False)
+        out = jax.jit(m.case_pipeline_fn())(
+            *(jax.numpy.asarray(a) for a in args))
+        Xi_direct = (np.asarray(out[0], np.float64)
+                     + 1j * np.asarray(out[1], np.float64))
+        assert res["mass"][i] == pytest.approx(m.statics.mass, rel=1e-12)
+        assert res["GMT"][i] == pytest.approx(
+            m.statics.zMeta - m.statics.rCG_TOT[2], rel=1e-9)
+        np.testing.assert_allclose(
+            res["Xi0"][i], aux["Xi0"], rtol=1e-6, atol=1e-10)
+        np.testing.assert_allclose(
+            np.abs(res["Xi"][i]), np.abs(Xi_direct), rtol=2e-5, atol=1e-7)
+
+
+@pytest.mark.skipif(
+    not __import__("os").path.exists(VOLTURNUS),
+    reason="reference designs not mounted",
+)
+def test_density_trim_zeroes_heave_imbalance():
+    """The closed-form ballast-density trim reproduces
+    Model.adjust_ballast_density: trimmed statics balance weight,
+    buoyancy, and mooring pull."""
+    from raft_tpu.io.schema import load_design
+    from raft_tpu.sweep_fused import apply_volturnus_point, run_design_sweep
+
+    base = load_design(VOLTURNUS)
+    base["settings"] = {
+        "min_freq": 0.05, "max_freq": 0.3, "XiStart": 0.1, "nIter": 15,
+    }
+    d1 = apply_volturnus_point(base, draft=1.08, ocD=1.05)
+    res = run_design_sweep([d1], group=1, trim_ballast_density=True,
+                           verbose=False)
+    m = Model(d1)
+    delta_ref = m.adjust_ballast_density()
+    assert res["delta_rho"][0] == pytest.approx(delta_ref, rel=1e-6)
+    m.analyze_unloaded()
+    assert res["mass"][0] == pytest.approx(m.statics.mass, rel=1e-9)
