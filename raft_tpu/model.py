@@ -186,6 +186,7 @@ class Model:
         # mooring
         self.ms = parse_mooring(design["mooring"], rho_water=self.rho_water, g=self.g)
         self._moor_arrays = self.ms.arrays()
+        self._bridle_arrays = self.ms.bridle_arrays()
         self.yawstiff = design["platform"].get("yaw_stiffness", 0.0)
 
         # turbine lumped properties
@@ -233,7 +234,7 @@ class Model:
         equilibrium offsets (reference raft/raft_model.py:109-146)."""
         z6 = jnp.zeros(6, dtype=jnp.float64)
         arr = self._moor_arrays
-        C0, F0 = unloaded_mooring_fn()(z6, *arr)
+        C0, F0 = unloaded_mooring_fn()(z6, *arr, self._bridle_arrays)
         self.C_moor0 = np.asarray(C0)
         self.F_moor0 = np.asarray(F0)
 
@@ -338,7 +339,7 @@ class Model:
         fn = case_mooring_batch_fn(self.rho_water, self.g, self.yawstiff)
         args = put_cpu(
             (np.asarray(F_aero0, np.float64),) + self._body_props()
-        ) + self._moor_arrays
+        ) + self._moor_arrays + (self._bridle_arrays,)
         out = fn(*args)
         return tuple(np.asarray(o) for o in out)
 
@@ -917,7 +918,8 @@ class Model:
         heading copies follow the reference.
         """
         z6 = jnp.zeros(6, dtype=jnp.float64)
-        F_moor0 = np.asarray(line_forces(z6, *self._moor_arrays)[0])
+        F_moor0 = np.asarray(
+            line_forces(z6, *self._moor_arrays, self._bridle_arrays)[0])
 
         def heave_imbalance():
             st = compute_statics(
@@ -993,7 +995,8 @@ class Model:
         """Uniformly adjust ballast densities to zero the unloaded heave
         (reference raft/raft_model.py:982-1037)."""
         z6 = jnp.zeros(6, dtype=jnp.float64)
-        F_moor0 = np.asarray(line_forces(z6, *self._moor_arrays)[0])
+        F_moor0 = np.asarray(
+            line_forces(z6, *self._moor_arrays, self._bridle_arrays)[0])
 
         for mem in self.members:
             if np.isscalar(mem.l_fill):

@@ -13,9 +13,13 @@ are ``vmap``-batched; the whole system is differentiable and vmappable over
 load cases (mean aero loads) and design parameters.
 
 Catenary formulation: the standard quasi-static elastic catenary (as in
-MoorPy/MAP; suspended + seabed-contact cases, frictionless seabed CB=0 which
-is MoorPy's default for lines parsed from YAML), solved by damped Newton in
-(log HF, VF).
+MoorPy/MAP; suspended + seabed-contact cases, with optional MoorPy-style
+CB seabed friction via the line type's ``cb``/``seabed_friction`` key —
+frictionless remains the default, matching MoorPy's YAML parsing), solved
+by damped Newton in (log HF, log VF).  Free points joining three or more
+lines form bridle groups whose junction positions are solved by an
+adaptive Levenberg-Marquardt force balance under ``lax.custom_root``
+(the equilibrium routinely sits at a leg's slack/taut stiffness kink).
 """
 
 from dataclasses import dataclass
@@ -29,6 +33,47 @@ from raft_tpu.utils.frames import rotation_matrix, translate_force_3to6
 
 
 # ---------------- host-side parsing ----------------
+
+@dataclass
+class BridleSet:
+    """Bridled line groups: free junction points joining three or more
+    lines (MoorPy's general point-object capability; the classic crow's
+    foot / delta connection).  Each bridle has up to K legs running
+    bottom->top from the junction's perspective:
+
+      kind 0 : anchor leg  — segments ordered anchor -> junction (the
+               junction is the leg's top end; the anchor end may rest on
+               the seabed),
+      kind 1 : vessel leg  — segments ordered junction -> fairlead (the
+               junction is the leg's bottom end; fully suspended),
+      kind -1: inert padding.
+
+    ``ends`` holds the leg's terminal point: anchor world position
+    (kind 0) or fairlead position in the body frame (kind 1).
+    """
+
+    kind: np.ndarray    # [nB, K]
+    ends: np.ndarray    # [nB, K, 3]
+    L: np.ndarray       # [nB, K, S]
+    EA: np.ndarray      # [nB, K, S]
+    w: np.ndarray       # [nB, K, S]
+    Wp: np.ndarray      # [nB, K, S]
+    Wj: np.ndarray      # [nB] junction net weight (N; mass - buoyancy)
+    p0: np.ndarray      # [nB, 3] junction position initial guess
+
+    @property
+    def n(self):
+        return len(self.Wj)
+
+    def arrays(self, dtype=jnp.float64, device="cpu"):
+        src = (self.kind.astype(float), self.ends, self.L, self.EA,
+               self.w, self.Wp, self.Wj, self.p0)
+        if device == "cpu":
+            from raft_tpu.utils.placement import put_cpu
+
+            return tuple(put_cpu(np.asarray(a, float)) for a in src)
+        return tuple(jnp.asarray(a, dtype) for a in src)
+
 
 @dataclass
 class MooringSystem:
@@ -45,6 +90,19 @@ class MooringSystem:
     #                       (N; junction point mass - buoyancy; top row 0)
     depth: float
     names: list
+    cb: np.ndarray = None  # [nL] seabed friction coefficient (MoorPy CB;
+    #                        bottom segment's line_type 'cb', default 0)
+    bridles: BridleSet = None   # bridled groups, or None
+
+    def __post_init__(self):
+        if self.cb is None:
+            self.cb = np.zeros(len(self.L))
+
+    def bridle_arrays(self, dtype=jnp.float64, device="cpu"):
+        """Bridle pytree for the solver functions (None if unbridled)."""
+        if self.bridles is None:
+            return None
+        return self.bridles.arrays(dtype=dtype, device=device)
 
     @property
     def n_lines(self):
@@ -60,7 +118,8 @@ class MooringSystem:
         placement to the caller (e.g. inside a jitted pipeline).
         """
         np_dtype = np.dtype(dtype.dtype if hasattr(dtype, "dtype") else dtype)
-        src = (self.anchors, self.rFair, self.L, self.EA, self.w, self.Wp)
+        src = (self.anchors, self.rFair, self.L, self.EA, self.w, self.Wp,
+               self.cb)
         if device == "cpu":
             from raft_tpu.utils.placement import put_cpu
 
@@ -74,12 +133,15 @@ def parse_mooring(mooring, rho_water=1025.0, g=9.81):
     """Build a MooringSystem from the design dict's ``mooring`` section
     (schema per reference designs/*.yaml: points/lines/line_types).
 
-    Lines chained through ``free`` intermediate points (the industry
-    chain-rope-chain pattern; MoorPy capability surface, SURVEY.md §2.2)
-    are composed into one composite anchor-to-fairlead line; a free
-    point's optional ``mass``/``volume`` become a clump weight at the
-    junction.  Free points must join exactly two lines (bridles are out
-    of scope)."""
+    Lines chained through two-line ``free`` intermediate points (the
+    industry chain-rope-chain pattern; MoorPy capability surface,
+    SURVEY.md §2.2) are composed into one composite anchor-to-fairlead
+    line; a free point's optional ``mass``/``volume`` become a clump
+    weight at the junction.  Free points joining three or more lines
+    become bridle junctions (``MooringSystem.bridles``): each attached
+    chain is walked to its terminal fixed/vessel point and becomes a
+    bridle leg, solved by a junction force-balance Newton at analysis
+    time."""
     types = {lt["name"]: lt for lt in mooring["line_types"]}
     points = {p["name"]: p for p in mooring["points"]}
 
@@ -93,50 +155,103 @@ def parse_mooring(mooring, rho_water=1025.0, g=9.81):
         d_vol = float(lt["diameter"])  # volume-equivalent diameter
         mden = float(lt["mass_density"])
         return (float(ln["length"]), float(lt["stiffness"]),
-                (mden - rho_water * np.pi / 4 * d_vol**2) * g)
+                (mden - rho_water * np.pi / 4 * d_vol**2) * g,
+                float(lt.get("cb", lt.get("seabed_friction", 0.0))))
 
     def point_weight(p):
         return (float(p.get("mass", 0.0))
                 - rho_water * float(p.get("volume", 0.0))) * g
+
+    junctions = {
+        name for name, p in points.items()
+        if p["type"] == "free" and len(attach.get(name, [])) >= 3
+    }
+
+    def walk_chain(start_line, start_node):
+        """Follow a chain from ``start_node`` (just crossed ``start_line``)
+        through two-line free points; returns (line indices, terminal
+        point name) — the terminal is fixed/vessel/junction."""
+        chain = [start_line]
+        cur = start_node
+        while points[cur]["type"] == "free" and cur not in junctions:
+            at = attach[cur]
+            (j,) = [j for j, _ in at if j != chain[-1]]
+            chain.append(j)
+            cur = [o for j, o in at if j == chain[-1]][0]
+        return chain, cur
+
+    def chain_segments(chain, start_node):
+        """Segment property tuples for ``chain`` walked from
+        ``start_node``, with intermediate free-point clump weights."""
+        seg = []
+        node = start_node
+        for j in chain:
+            ln = mooring["lines"][j]
+            node = ln["endB"] if ln["endA"] == node else ln["endA"]
+            wp = point_weight(points[node]) if (
+                points[node]["type"] == "free" and node not in junctions
+            ) else 0.0
+            seg.append(seg_props(ln) + (wp,))
+            used.add(j)
+        return seg
 
     anchors, rFair, segs, names, used = [], [], [], [], set()
     for name, p in points.items():
         if p["type"] != "fixed":
             continue
         for i0, nxt in attach.get(name, []):
-            # walk the chain from this anchor through free points
-            chain = [i0]
-            cur = nxt
-            while points[cur]["type"] == "free":
-                at = attach[cur]
-                if len(at) != 2:
-                    raise ValueError(
-                        f"free point '{cur}' joins {len(at)} lines; only "
-                        "two-line chains are supported (no bridles)"
-                    )
-                (j,) = [j for j, _ in at if j != chain[-1]]
-                chain.append(j)
-                cur = [o for j, o in at if j == chain[-1]][0]
+            chain, cur = walk_chain(i0, nxt)
+            if cur in junctions:
+                continue        # bridle anchor leg, claimed below
             if points[cur]["type"] != "vessel":
                 raise ValueError(
                     f"line chain from anchor '{name}' ends at "
                     f"'{cur}' ({points[cur]['type']}); expected a vessel point"
                 )
-            seg = []
-            node = name
-            for j in chain:
-                ln = mooring["lines"][j]
-                node = ln["endB"] if ln["endA"] == node else ln["endA"]
-                wp = point_weight(points[node]) if (
-                    points[node]["type"] == "free") else 0.0
-                seg.append(seg_props(ln) + (wp,))
-                used.add(j)
+            seg = chain_segments(chain, name)
             anchors.append(np.array(p["location"], float))
             rFair.append(np.array(points[cur]["location"], float))
             segs.append(seg)
             names.append("-".join(
                 mooring["lines"][j].get("name", f"line{j+1}") for j in chain
             ))
+
+    # ---- bridle junctions: each attached chain becomes a leg ----
+    bridle_legs, bridle_Wj, bridle_p0 = [], [], []
+    for name in sorted(junctions):
+        legs = []
+        for i0, nxt in attach[name]:
+            chain, cur = walk_chain(i0, nxt)
+            term = points[cur]
+            if cur in junctions or term["type"] == "free":
+                raise ValueError(
+                    f"bridle junction '{name}' connects to another "
+                    f"junction/free terminal '{cur}'; chained junctions "
+                    "are not supported"
+                )
+            # segments walked junction -> terminal; reorder bottom -> top:
+            # anchor legs run anchor -> junction, vessel legs run
+            # junction -> fairlead
+            seg_out = chain_segments(chain, name)
+            if term["type"] == "fixed":
+                # reverse to anchor->junction order; clump weights attach
+                # to the TOP node of each segment, so on reversal the Wp
+                # column shifts by one (the weight walked after crossing
+                # segment k sits at the junction-side end of the reversed
+                # segment k+1): Wp_rev = reversed(Wp[:-1]) + [0]
+                rev = [list(s) for s in seg_out[::-1]]
+                wps = [s[-1] for s in seg_out]
+                wps_rev = list(reversed(wps[:-1])) + [0.0]
+                for s, wp2 in zip(rev, wps_rev):
+                    s[-1] = wp2
+                legs.append((0, np.array(term["location"], float),
+                             [tuple(s) for s in rev]))
+            else:
+                legs.append((1, np.array(term["location"], float), seg_out))
+        bridle_legs.append(legs)
+        bridle_Wj.append(point_weight(points[name]))
+        bridle_p0.append(np.array(points[name]["location"], float))
+
     unused = set(range(len(mooring["lines"]))) - used
     if unused:
         bad = [mooring["lines"][j].get("name", f"line{j+1}") for j in unused]
@@ -144,39 +259,99 @@ def parse_mooring(mooring, rho_water=1025.0, g=9.81):
             f"lines {bad} are not part of any fixed-to-vessel chain"
         )
 
-    S = max(len(s) for s in segs)
-    nL = len(segs)
-    L = np.zeros((nL, S))
-    EA = np.ones((nL, S))
-    w = np.ones((nL, S))
-    Wp = np.zeros((nL, S))
-    for i, seg in enumerate(segs):
-        for k, (lk, ek, wk, wpk) in enumerate(seg):
-            L[i, k], EA[i, k], w[i, k], Wp[i, k] = lk, ek, wk, wpk
+    def seg_arrays(seg_lists, S):
+        n = len(seg_lists)
+        L = np.zeros((n, S))
+        EA = np.ones((n, S))
+        w = np.ones((n, S))
+        Wp = np.zeros((n, S))
+        cb = np.zeros(n)
+        for i, seg in enumerate(seg_lists):
+            # entries are seg_props(...) + (wp,) = (L, EA, w, cb, Wp)
+            for k, (lk, ek, wk, cbk, wpk) in enumerate(seg):
+                L[i, k], EA[i, k], w[i, k], Wp[i, k] = lk, ek, wk, wpk
+                if k == 0:      # friction acts on the grounded bottom segment
+                    cb[i] = cbk
+        return L, EA, w, Wp, cb
+
+    if segs:
+        S = max(len(s) for s in segs)
+        L, EA, w, Wp, cb = seg_arrays(segs, S)
+        anchors = np.array(anchors)
+        rFair = np.array(rFair)
+    else:
+        anchors = np.zeros((0, 3))
+        rFair = np.zeros((0, 3))
+        L = np.zeros((0, 1))
+        EA = np.ones((0, 1))
+        w = np.ones((0, 1))
+        Wp = np.zeros((0, 1))
+        cb = np.zeros(0)
+
+    bridles = None
+    if bridle_legs:
+        K = max(len(legs) for legs in bridle_legs)
+        Sb = max(len(seg) for legs in bridle_legs for _, _, seg in legs)
+        nB = len(bridle_legs)
+        kind = np.full((nB, K), -1.0)
+        ends = np.zeros((nB, K, 3))
+        bL = np.full((nB, K, Sb), 1.0)      # inert pad: L=1 (solved, masked)
+        bEA = np.ones((nB, K, Sb)) * 1e9
+        bw = np.ones((nB, K, Sb)) * 100.0
+        bWp = np.zeros((nB, K, Sb))
+        for ib, legs in enumerate(bridle_legs):
+            for ik, (kd, end, seg) in enumerate(legs):
+                kind[ib, ik] = kd
+                ends[ib, ik] = end
+                for ks, (lk, ek, wk, _cbk, wpk) in enumerate(seg):
+                    bL[ib, ik, ks] = lk
+                    bEA[ib, ik, ks] = ek
+                    bw[ib, ik, ks] = wk
+                    bWp[ib, ik, ks] = wpk
+                # pad extra segment slots inertly (L=0 span)
+                for ks in range(len(seg), Sb):
+                    bL[ib, ik, ks] = 0.0
+                    bEA[ib, ik, ks] = 1.0
+                    bw[ib, ik, ks] = 1.0
+            for ik in range(len(legs), K):
+                # inert padded leg: parked far below, force masked out
+                ends[ib, ik] = np.array([0.0, 0.0, -1.0])
+        bridles = BridleSet(
+            kind=kind, ends=ends, L=bL, EA=bEA, w=bw, Wp=bWp,
+            Wj=np.array(bridle_Wj), p0=np.array(bridle_p0),
+        )
 
     return MooringSystem(
-        anchors=np.array(anchors),
-        rFair=np.array(rFair),
+        anchors=anchors,
+        rFair=rFair,
         L=L, EA=EA, w=w, Wp=Wp,
         depth=float(mooring.get("water_depth", 0.0)),
         names=names,
+        cb=cb,
+        bridles=bridles,
     )
 
 
 # ---------------- elastic catenary ----------------
 
-def _profile(H, V, L, EA, w):
+def _profile(H, V, L, EA, w, cb=0.0):
     """Fairlead excursion (x, z) produced by fairlead tension components
     (H horizontal, V vertical) for a line of length L, stiffness EA, unit
-    submerged weight w.  Frictionless seabed.
+    submerged weight w, seabed friction coefficient ``cb`` (MoorPy's CB;
+    0 = frictionless, MoorPy's default for YAML-parsed systems and what
+    the reference consumes, raft/raft_model.py:58-59).
 
     Suspended (V >= wL):
       x = H/w [asinh(V/H) - asinh((V-wL)/H)] + HL/EA
       z = H/w [sqrt(1+(V/H)^2) - sqrt(1+((V-wL)/H)^2)] + (VL - wL^2/2)/EA
     Touchdown (V < wL, length LB = L - V/w on the seabed):
       x = LB + H/w asinh(V/H) + HL/EA
+          + cb w/(2 EA) (lam max(lam, 0) - LB^2),  lam = LB - H/(cb w)
       z = H/w (sqrt(1+(V/H)^2) - 1) + V^2/(2 EA w)
-    The two meet continuously at V = wL.
+    The friction term is MoorPy's catenary CB>0 branch: tension decays
+    along the grounded length (zero beyond ``lam``), reducing the elastic
+    stretch of the grounded portion; z is unchanged (friction acts
+    horizontally).  The branches meet continuously at V = wL.
     """
     W = w * L
     VA = V - W
@@ -188,7 +363,14 @@ def _profile(H, V, L, EA, w):
         + (V * L - 0.5 * w * L**2) / EA
     )
     LB = jnp.clip(L - V / w, 0.0, L)
-    xt = LB + H / w * jnp.arcsinh(vh) + H * L / EA
+    cb_s = jnp.maximum(cb, 1e-12)
+    lam = LB - H / (cb_s * w)
+    fric = jnp.where(
+        cb > 0.0,
+        cb_s * w / (2.0 * EA) * (lam * jnp.maximum(lam, 0.0) - LB**2),
+        0.0,
+    )
+    xt = LB + H / w * jnp.arcsinh(vh) + H * L / EA + fric
     zt = H / w * (jnp.sqrt(1 + vh**2) - 1.0) + V**2 / (2 * EA * w)
     suspended = VA >= 0
     return jnp.where(suspended, xs, xt), jnp.where(suspended, zs, zt)
@@ -220,25 +402,30 @@ def _segment_top_tensions(V, L, w, Wp):
     return V - above_seg - above_pt
 
 
-def _profile_composite(H, V, L, EA, w, Wp):
+def _profile_composite(H, V, L, EA, w, Wp, cb=0.0):
     """Fairlead excursion (x, z) of a composite line under fairlead tension
     (H, V): per-segment spans stacked anchor->fairlead.  The bottom segment
-    may rest on the seabed (touchdown branch of :func:`_profile`); upper
-    segments use the suspended expressions."""
+    may rest on the seabed (touchdown branch of :func:`_profile`, with
+    seabed friction ``cb``); upper segments use the suspended
+    expressions."""
     Vtop = _segment_top_tensions(V, L, w, Wp)
-    x0, z0 = _profile(H, Vtop[0], L[0], EA[0], w[0])
+    x0, z0 = _profile(H, Vtop[0], L[0], EA[0], w[0], cb)
     xu, zu = _profile_suspended(H, Vtop[1:], L[1:], EA[1:], w[1:])
     return x0 + jnp.sum(xu), z0 + jnp.sum(zu)
 
 
-def catenary_solve(XF, ZF, L, EA, w, Wp=None, iters=60, tol=1e-11):
+def catenary_solve(XF, ZF, L, EA, w, Wp=None, cb=0.0, iters=60,
+                   tol=1e-11, seabed=True):
     """Solve one (possibly composite) line for fairlead tension components
     (HF, VF) such that the catenary spans horizontal distance XF and
     vertical distance ZF.  ``L``/``EA``/``w`` may be scalars (one segment)
     or [S] segment arrays ordered anchor->fairlead with clump weights
     ``Wp`` at segment tops.
 
-    Damped Newton in (log HF, VF) — log keeps HF positive — from the
+    Damped Newton in (log HF, log VF) — log keeps both tensions
+    positive (a bottom->top oriented line always has positive fairlead
+    tensions; solving V linearly admits spurious negative-V roots of the
+    touchdown equations) — from the
     MoorPy-style initial guess, iterated to a relative-residual tolerance
     inside a ``while_loop`` (cap ``iters``).
 
@@ -267,15 +454,37 @@ def catenary_solve(XF, ZF, L, EA, w, Wp=None, iters=60, tol=1e-11):
     lam0 = jnp.where(L_tot <= d, 0.25, jnp.sqrt(slack))
     H0 = jnp.maximum(jnp.abs(0.5 * w_eff * XF / lam0), 10.0)
     V0 = 0.5 * w_eff * (ZF / jnp.tanh(lam0) + L_tot) + 0.5 * jnp.sum(Wp)
+    # taut (stretched) lines: the catenary-sag guess above is orders of
+    # magnitude off and the Newton can stall — start from the elastic-bar
+    # tension along the chord instead (bridle legs routinely go taut
+    # while the junction Newton explores)
+    EA_eff = L_tot / jnp.sum(L / EA)
+    T_el = EA_eff * jnp.maximum(d - L_tot, 0.0) / L_tot + 0.5 * W
+    taut = L_tot <= d
+    H0 = jnp.where(taut, jnp.maximum(T_el * XF / d, 10.0), H0)
+    V0 = jnp.where(taut, T_el * ZF / d + 0.5 * W + 0.5 * jnp.sum(Wp), V0)
     scale = jnp.maximum(jnp.abs(XF), jnp.abs(ZF))
     tol = jnp.asarray(tol, XF.dtype) + 30 * jnp.finfo(XF.dtype).eps
 
     def resid(p):
         # residual as a function of the unknowns only; XF/ZF/L/EA/w enter
-        # by closure, so custom_root's implicit derivative covers them
+        # by closure, so custom_root's implicit derivative covers them.
+        # Both unknowns live in log space: H > 0 always, and the fairlead
+        # (top-end) vertical tension V > 0 for every bottom->top oriented
+        # line — solving V directly admits spurious negative-V roots of
+        # the touchdown equations (found by the bridle junction Newton
+        # exploring slack anchor-leg geometries)
         H = jnp.exp(p[0])
-        V = p[1]
-        x, z = _profile_composite(H, V, L, EA, w, Wp)
+        V = jnp.exp(p[1])
+        if seabed:
+            x, z = _profile_composite(H, V, L, EA, w, Wp, cb)
+        else:
+            # fully-suspended composite (bridle vessel legs: the bottom
+            # end hangs at the junction, clear of the seabed; VA < 0
+            # sag-below-attachment is allowed)
+            Vtop = _segment_top_tensions(V, L, w, Wp)
+            xs, zs = _profile_suspended(H, Vtop, L, EA, w)
+            x, z = jnp.sum(xs), jnp.sum(zs)
         return jnp.stack([x - XF, z - ZF])
 
     def solve(f, p0):
@@ -289,9 +498,7 @@ def catenary_solve(XF, ZF, L, EA, w, Wp=None, iters=60, tol=1e-11):
             du = (J[1, 1] * r[0] - J[0, 1] * r[1]) / det
             dv = (-J[1, 0] * r[0] + J[0, 0] * r[1]) / det
             du = jnp.clip(du, -1.5, 1.5)
-            dv = jnp.clip(
-                dv, -0.5 * (jnp.abs(p[1]) + W), 0.5 * (jnp.abs(p[1]) + W)
-            )
+            dv = jnp.clip(dv, -1.5, 1.5)
             return p - jnp.stack([du, dv]), jnp.max(jnp.abs(r)) / scale
 
         def cond(state):
@@ -319,14 +526,158 @@ def catenary_solve(XF, ZF, L, EA, w, Wp=None, iters=60, tol=1e-11):
         ])
 
     p = jax.lax.custom_root(
-        resid, jnp.stack([jnp.log(H0), V0]), solve, tangent_solve
+        resid, jnp.stack([jnp.log(H0), jnp.log(jnp.maximum(V0, 1.0))]),
+        solve, tangent_solve
     )
-    return jnp.exp(p[0]), p[1]
+    return jnp.exp(p[0]), jnp.exp(p[1])
+
+
+# ---------------- bridle junctions ----------------
+
+def _bridle_leg_force(p, end_world, kind, L, EA, w, Wp):
+    """Force exerted ON the junction at ``p`` by one bridle leg, plus the
+    leg's top-end tension.  kind 0: anchor leg (junction on top, seabed
+    catenary); kind 1: vessel leg (junction on the bottom, fully
+    suspended); kind < 0: inert padding (solved on a fixed benign
+    geometry so no NaN can leak into the masked sum)."""
+    active = kind >= 0.0
+    is_anchor = kind == 0.0
+    # low/high ends of the bottom->top catenary
+    low = jnp.where(is_anchor, end_world, p)
+    high = jnp.where(is_anchor, p, end_world)
+    dxy = high[:2] - low[:2]
+    XF = jnp.sqrt(jnp.sum(dxy**2))
+    ZF = high[2] - low[2]
+    # padded legs solve a fixed well-conditioned configuration
+    XF = jnp.where(active, XF, 10.0)
+    ZF = jnp.where(active, ZF, 5.0)
+    H_a, V_a = catenary_solve(XF, ZF, L, EA, w, Wp)            # seabed
+    H_s, V_s = catenary_solve(XF, ZF, L, EA, w, Wp, seabed=False)
+    HF = jnp.where(is_anchor, H_a, H_s)
+    VF = jnp.where(is_anchor, V_a, V_s)
+    u = dxy / jnp.maximum(XF, 1e-9)
+    VA = VF - jnp.sum(w * L) - jnp.sum(Wp)
+    # anchor leg: junction is the top (fairlead) end -> pulled down/toward
+    # the anchor; vessel leg: junction is the bottom end -> the leg pulls
+    # it up/toward the fairlead with the bottom-end tension (HF, VA)
+    F = jnp.where(
+        is_anchor,
+        jnp.array([-HF * u[0], -HF * u[1], -VF]),
+        jnp.array([HF * u[0], HF * u[1], VA]),
+    )
+    T_top = jnp.sqrt(HF**2 + VF**2)
+    return jnp.where(active, F, 0.0), jnp.where(active, T_top, 0.0), HF, VF
+
+
+def _solve_bridle_junction(r6, bridle, iters=400):
+    """Equilibrium position of one bridle junction: Newton on the 3-DOF
+    force balance of its legs + junction weight.  The converged position
+    is stop-gradient'ed and polished with one differentiable Newton step,
+    so downstream jacfwd (stiffness, tension Jacobians) gets the
+    implicit-function derivative without unrolling the loop."""
+    kind, ends, L, EA, w, Wp, Wj, p0 = bridle
+    R = rotation_matrix(r6[3], r6[4], r6[5])
+    ends_world = jnp.where(
+        (kind == 1.0)[:, None],
+        r6[:3] + jnp.einsum("ij,kj->ki", R, ends),
+        ends,
+    )
+
+    def net(p):
+        F, _, _, _ = jax.vmap(
+            lambda e, kd, Lk, EAk, wk, Wpk: _bridle_leg_force(
+                p, e, kd, Lk, EAk, wk, Wpk),
+        )(ends_world, kind, L, EA, w, Wp)
+        return jnp.sum(F, axis=0) + jnp.array([0.0, 0.0, -Wj])
+
+    jac = jax.jacfwd(net)
+    # residual tolerance scaled by the legs' weight (the natural force
+    # scale of the junction balance)
+    f_scale = jnp.sum(jnp.sum(w * L, axis=-1) + jnp.sum(Wp, axis=-1)) + \
+        jnp.abs(Wj) + 1.0
+    tol = 1e-6 * f_scale
+
+    def cond(state):
+        i, p, lam, err = state
+        return (i < iters) & (err > tol)
+
+    def body(state):
+        i, p, lam, _ = state
+        F = net(p)
+        n0 = jnp.max(jnp.abs(F))
+        J = jac(p)
+        # adaptive Levenberg-Marquardt: the equilibrium often sits within
+        # centimetres of a leg's slack/taut stiffness kink (force slope
+        # jumps ~EA/L there), where a plain Newton zigzags on the
+        # ill-conditioned soft directions; rejected steps raise the
+        # damping (gradient-descent-like, short steps), accepted steps
+        # lower it back toward Newton
+        JtJ = J.T @ J
+        mu = lam * jnp.trace(JtJ) / 3.0
+        dp = jnp.linalg.solve(
+            JtJ + mu * jnp.eye(3, dtype=p.dtype), -J.T @ F)
+        dp = jnp.clip(dp, -8.0, 8.0)
+        n1 = jnp.max(jnp.abs(net(p + dp)))
+        accept = n1 < n0
+        p = jnp.where(accept, p + dp, p)
+        lam = jnp.clip(jnp.where(accept, lam / 2.0, lam * 2.0),
+                       1e-9, 30.0)
+        return i + 1, p, lam, jnp.minimum(n1, n0)
+
+    def solve(f, p_init):
+        _, p_star, _, _ = jax.lax.while_loop(
+            cond, body,
+            (jnp.array(0), p_init, jnp.asarray(1e-4, p_init.dtype),
+             jnp.asarray(jnp.inf, p_init.dtype)),
+        )
+        return p_star
+
+    def tangent_solve(g, y):
+        return jnp.linalg.solve(jax.jacfwd(g)(jnp.zeros_like(y)), y)
+
+    # custom_root: the primal is the LM loop's converged point untouched
+    # (an undamped Newton "polish" at a near-kink root can jump far along
+    # the soft directions), with exact implicit-function tangents
+    p = jax.lax.custom_root(net, p0, solve, tangent_solve)
+    return p, ends_world
+
+
+def bridle_forces(r6, bridle):
+    """6-DOF body reaction from every bridle group at pose r6, plus the
+    vessel-leg fairlead tensions [nB, K] (zero for anchor/padded legs)."""
+    kind, ends, L, EA, w, Wp, Wj, p0 = bridle
+
+    def one(kd, e, Lb, EAb, wb, Wpb, Wjb, p0b):
+        p, ends_world = _solve_bridle_junction(
+            r6, (kd, e, Lb, EAb, wb, Wpb, Wjb, p0b))
+        R = rotation_matrix(r6[3], r6[4], r6[5])
+
+        def leg(e_w, e_body, kdk, Lk, EAk, wk, Wpk):
+            _, T_top, HF, VF = _bridle_leg_force(
+                p, e_w, kdk, Lk, EAk, wk, Wpk)
+            # vessel legs pull the body at their fairlead
+            dxy = e_w[:2] - p[:2]
+            u = dxy / jnp.maximum(jnp.sqrt(jnp.sum(dxy**2)), 1e-9)
+            F3 = jnp.where(
+                kdk == 1.0,
+                jnp.array([-HF * u[0], -HF * u[1], -VF]),
+                jnp.zeros(3),
+            )
+            arm = jnp.einsum("ij,j->i", R, e_body)
+            f6 = translate_force_3to6(F3, arm)
+            return f6, jnp.where(kdk == 1.0, T_top, 0.0)
+
+        f6_legs, T = jax.vmap(leg)(ends_world, e, kd, Lb, EAb, wb, Wpb)
+        return jnp.sum(f6_legs, axis=0), T
+
+    f6_all, T_all = jax.vmap(one)(kind, ends, L, EA, w, Wp, Wj, p0)
+    return jnp.sum(f6_all, axis=0), T_all
 
 
 # ---------------- system-level forces ----------------
 
-def line_forces(r6, anchors, rFair, L, EA, w, Wp=None):
+def line_forces(r6, anchors, rFair, L, EA, w, Wp=None, cb=None,
+                bridles=None):
     """6-DOF mooring reaction on the body at pose r6, plus per-line fairlead
     force vectors and tension components.  Segment arrays are [nL, S]
     (anchor->fairlead; S=1 for simple lines).
@@ -335,27 +686,33 @@ def line_forces(r6, anchors, rFair, L, EA, w, Wp=None):
     """
     if Wp is None:
         Wp = jnp.zeros_like(L)
+    if cb is None:
+        cb = jnp.zeros_like(L[..., 0] if L.ndim > 1 else L)
     R = rotation_matrix(r6[3], r6[4], r6[5])
     arm = jnp.einsum("ij,lj->li", R, rFair)          # rotated fairlead offsets
     p = r6[:3] + arm                                  # fairlead world positions
     dxy = p[:, :2] - anchors[:, :2]
     XF = jnp.sqrt(jnp.sum(dxy**2, axis=1))
     ZF = p[:, 2] - anchors[:, 2]
-    HF, VF = jax.vmap(catenary_solve)(XF, ZF, L, EA, w, Wp)
+    HF, VF = jax.vmap(catenary_solve)(XF, ZF, L, EA, w, Wp, cb)
     # vertical-line guard: direction is irrelevant when XF ~ 0 since HF ~ 0
     u = dxy / jnp.maximum(XF, 1e-9)[:, None]
     F3 = jnp.stack([-HF * u[:, 0], -HF * u[:, 1], -VF], axis=1)  # [nL,3]
     f6 = jnp.sum(translate_force_3to6(F3, arm), axis=0)
+    if bridles is not None:
+        f6 = f6 + bridle_forces(r6, bridles)[0]
     return f6, HF, VF
 
 
-def line_tensions(r6, anchors, rFair, L, EA, w, Wp=None):
+def line_tensions(r6, anchors, rFair, L, EA, w, Wp=None, cb=None,
+                  bridles=None):
     """End tensions [TA..., TB...] (anchor ends first, then fairlead ends),
     matching MoorPy's getTensions ordering consumed at reference
     raft/raft_model.py:273-283."""
     if Wp is None:
         Wp = jnp.zeros_like(L)
-    _, HF, VF = line_forces(r6, anchors, rFair, L, EA, w, Wp)
+    _, HF, VF = line_forces(r6, anchors, rFair, L, EA, w, Wp, cb)
+    del bridles  # bridle leg tensions are reported via bridle_forces
     # vertical tension at the anchor end of the composite line (1-D legacy
     # [nL] inputs are per-line scalars — summing axis -1 would total ALL
     # lines' weights)
@@ -364,7 +721,15 @@ def line_tensions(r6, anchors, rFair, L, EA, w, Wp=None):
         Wp if Wp.ndim == 1 else jnp.sum(Wp, axis=-1))
     VA = VF - W
     TB = jnp.sqrt(HF**2 + VF**2)
-    TA = jnp.where(VA >= 0, jnp.sqrt(HF**2 + VA**2), HF)
+    # grounded case: seabed friction decays the horizontal tension along
+    # the grounded length, HA = max(HF - cb w0 LB, 0) (MoorPy's CB branch)
+    w0 = w if w.ndim == 1 else w[:, 0]
+    L0 = L if L.ndim == 1 else L[:, 0]
+    Vb = VF - (W - w0 * L0)    # vertical tension atop the bottom segment
+    LB = jnp.clip(L0 - Vb / w0, 0.0, L0)
+    cb_arr = jnp.zeros_like(HF) if cb is None else cb
+    HA = jnp.maximum(HF - cb_arr * w0 * LB, 0.0)
+    TA = jnp.where(VA >= 0, jnp.sqrt(HF**2 + VA**2), HA)
     return jnp.concatenate([TA, TB])
 
 
@@ -380,8 +745,9 @@ def body_hydrostatic_force(r6, m, v, rCG, rM, AWP, rho=1025.0, g=9.81):
 
 
 def solve_equilibrium(
-    f6_ext, body_props, anchors, rFair, L, EA, w, Wp=None, rho=1025.0, g=9.81,
-    iters=40, r6_init=None, step_tol=1e-8,
+    f6_ext, body_props, anchors, rFair, L, EA, w, Wp=None, cb=None,
+    bridles=None, rho=1025.0, g=9.81, iters=40, r6_init=None,
+    step_tol=1e-8,
 ):
     """Find the body pose r6 where mooring + hydrostatics + external mean
     loads balance (the reference's ms.solveEquilibrium3 call,
@@ -400,7 +766,8 @@ def solve_equilibrium(
         Wp = jnp.zeros_like(L)
 
     def total_force(r6):
-        f_lines, _, _ = line_forces(r6, anchors, rFair, L, EA, w, Wp)
+        f_lines, _, _ = line_forces(r6, anchors, rFair, L, EA, w, Wp, cb,
+                                    bridles)
         f_body = body_hydrostatic_force(r6, m, v, rCG, rM, AWP, rho, g)
         return f_lines + f_body + f6_ext
 
@@ -431,29 +798,31 @@ def solve_equilibrium(
     return r6
 
 
-def coupled_stiffness(r6, anchors, rFair, L, EA, w, Wp=None):
+def coupled_stiffness(r6, anchors, rFair, L, EA, w, Wp=None, cb=None,
+                      bridles=None):
     """Mooring-only 6x6 stiffness C = -d f6_lines / d r6 about pose r6
     (the reference's ms.getCoupledStiffness(lines_only=True),
     raft/raft_model.py:117, :366) — exact forward-mode autodiff through the
     catenary solves instead of MoorPy's finite differencing."""
 
     def f(r):
-        f6, _, _ = line_forces(r, anchors, rFair, L, EA, w, Wp)
+        f6, _, _ = line_forces(r, anchors, rFair, L, EA, w, Wp, cb, bridles)
         return f6
 
     return -jax.jacfwd(f)(r6)
 
 
-def tension_jacobian(r6, anchors, rFair, L, EA, w, Wp=None):
+def tension_jacobian(r6, anchors, rFair, L, EA, w, Wp=None, cb=None):
     """J_moor = d tensions / d r6  [2 nL, 6] (reference raft_model.py:366,
     consumed for tension FFTs at :273-283)."""
     return jax.jacfwd(
-        lambda r: line_tensions(r, anchors, rFair, L, EA, w, Wp)
+        lambda r: line_tensions(r, anchors, rFair, L, EA, w, Wp, cb)
     )(r6)
 
 
 def case_mooring(f6_ext, m, v, rCG, rM, AWP, anchors, rFair, L, EA, w,
-                 Wp=None, rho=1025.0, g=9.81, yawstiff=0.0):
+                 Wp=None, cb=None, bridles=None, rho=1025.0, g=9.81,
+                 yawstiff=0.0):
     """One-shot per-case mooring analysis: equilibrium pose plus all the
     linearized quantities the dynamics solve consumes
     (reference raft/raft_model.py:332-392 calcMooringAndOffsets).
@@ -468,14 +837,14 @@ def case_mooring(f6_ext, m, v, rCG, rM, AWP, anchors, rFair, L, EA, w,
     if Wp is None:
         Wp = jnp.zeros_like(L)
     r6 = solve_equilibrium(
-        f6_ext, (m, v, rCG, rM, AWP), anchors, rFair, L, EA, w, Wp,
-        rho=rho, g=g
+        f6_ext, (m, v, rCG, rM, AWP), anchors, rFair, L, EA, w, Wp, cb,
+        bridles, rho=rho, g=g
     )
-    C_moor = coupled_stiffness(r6, anchors, rFair, L, EA, w, Wp)
+    C_moor = coupled_stiffness(r6, anchors, rFair, L, EA, w, Wp, cb, bridles)
     C_moor = C_moor.at[5, 5].add(yawstiff)
-    F_moor = line_forces(r6, anchors, rFair, L, EA, w, Wp)[0]
-    T_moor = line_tensions(r6, anchors, rFair, L, EA, w, Wp)
-    J_moor = tension_jacobian(r6, anchors, rFair, L, EA, w, Wp)
+    F_moor = line_forces(r6, anchors, rFair, L, EA, w, Wp, cb, bridles)[0]
+    T_moor = line_tensions(r6, anchors, rFair, L, EA, w, Wp, cb)
+    J_moor = tension_jacobian(r6, anchors, rFair, L, EA, w, Wp, cb)
     return r6, C_moor, F_moor, T_moor, J_moor
 
 
@@ -493,10 +862,11 @@ def _case_mooring_flat(rho, g, yawstiff):
     """Positional-argument :func:`case_mooring` wrapper shared by the
     cached batch entry points below."""
 
-    def one(f6, m, v, rCG, rM, AWP, anchors, rFair, L, EA, w, Wp):
+    def one(f6, m, v, rCG, rM, AWP, anchors, rFair, L, EA, w, Wp, cb,
+            bridles):
         return case_mooring(
-            f6, m, v, rCG, rM, AWP, anchors, rFair, L, EA, w, Wp,
-            rho=rho, g=g, yawstiff=yawstiff,
+            f6, m, v, rCG, rM, AWP, anchors, rFair, L, EA, w, Wp, cb,
+            bridles, rho=rho, g=g, yawstiff=yawstiff,
         )
 
     return one
@@ -507,7 +877,7 @@ def case_mooring_batch_fn(rho, g, yawstiff):
     """Jitted :func:`case_mooring`, vmapped over the case axis of ``f6_ext``
     (body properties and line arrays are shared across cases)."""
     one = _case_mooring_flat(rho, g, yawstiff)
-    return jax.jit(jax.vmap(one, in_axes=(0,) + (None,) * 11))
+    return jax.jit(jax.vmap(one, in_axes=(0,) + (None,) * 13))
 
 
 @lru_cache(maxsize=None)
@@ -517,7 +887,7 @@ def case_mooring_design_batch_fn(rho, g, yawstiff):
     the sweep driver's batched mooring equilibrium (one compile serves the
     whole sweep)."""
     one = _case_mooring_flat(rho, g, yawstiff)
-    per_design = jax.vmap(one, in_axes=(0,) + (None,) * 11)
+    per_design = jax.vmap(one, in_axes=(0,) + (None,) * 13)
     return jax.jit(jax.vmap(per_design))
 
 
@@ -527,9 +897,9 @@ def unloaded_mooring_fn():
     linearization consumed by analyze_unloaded (reference
     raft/raft_model.py:117-118)."""
 
-    def f(r6, anchors, rFair, L, EA, w, Wp):
-        C0 = coupled_stiffness(r6, anchors, rFair, L, EA, w, Wp)
-        F0 = line_forces(r6, anchors, rFair, L, EA, w, Wp)[0]
+    def f(r6, anchors, rFair, L, EA, w, Wp, cb, bridles=None):  # noqa: D401
+        C0 = coupled_stiffness(r6, anchors, rFair, L, EA, w, Wp, cb, bridles)
+        F0 = line_forces(r6, anchors, rFair, L, EA, w, Wp, cb, bridles)[0]
         return C0, F0
 
     return jax.jit(f)

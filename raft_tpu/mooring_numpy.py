@@ -48,7 +48,7 @@ def segment_top_tensions_np(V, L, w, Wp):
     return V - (np.sum(c) - np.cumsum(c)) - (np.sum(Wp) - np.cumsum(Wp) + Wp)
 
 
-def _profile_comp_np(H, V, L, EA, w, Wp):
+def _profile_comp_np(H, V, L, EA, w, Wp, seabed=True):
     """Composite-line spans (segments anchor->fairlead; NumPy twin of
     mooring._profile_composite).  Upper segments use the suspended
     expressions (valid for sagging VA < 0 too); only the bottom segment
@@ -59,7 +59,15 @@ def _profile_comp_np(H, V, L, EA, w, Wp):
     Wp = np.atleast_1d(np.asarray(Wp, float))
     c = w * L
     Vtop = segment_top_tensions_np(V, L, w, Wp)
-    x, z = _profile_np(H, Vtop[0], L[0], EA[0], w[0])
+    if seabed:
+        x, z = _profile_np(H, Vtop[0], L[0], EA[0], w[0])
+    else:
+        # fully-suspended bottom segment (bridle vessel legs)
+        vh = Vtop[0] / H
+        vah = (Vtop[0] - c[0]) / H
+        x = H / w[0] * (np.arcsinh(vh) - np.arcsinh(vah)) + H * L[0] / EA[0]
+        z = (H / w[0] * (np.sqrt(1 + vh**2) - np.sqrt(1 + vah**2))
+             + (Vtop[0] * L[0] - 0.5 * w[0] * L[0]**2) / EA[0])
     for i in range(1, len(L)):
         if L[i] == 0.0:
             continue
@@ -71,7 +79,8 @@ def _profile_comp_np(H, V, L, EA, w, Wp):
     return x, z
 
 
-def catenary_solve_np(XF, ZF, L, EA, w, Wp=None, tol=1e-10, max_iter=60):
+def catenary_solve_np(XF, ZF, L, EA, w, Wp=None, tol=1e-10, max_iter=60,
+                      seabed=True):
     """Newton solve for one (possibly composite) line's fairlead tensions
     (HF, VF); L/EA/w/Wp may be scalars or [S] segment arrays."""
     L = np.atleast_1d(np.asarray(L, float))
@@ -91,17 +100,17 @@ def catenary_solve_np(XF, ZF, L, EA, w, Wp=None, tol=1e-10, max_iter=60):
     u = np.log(H)
     for _ in range(max_iter):
         H = np.exp(u)
-        x, z = _profile_comp_np(H, V, L, EA, w, Wp)
+        x, z = _profile_comp_np(H, V, L, EA, w, Wp, seabed)
         r = np.array([x - XF, z - ZF])
         if np.max(np.abs(r)) < tol * scale:
             break
         # Jacobian wrt (log H, V) by central differences of the profile
         eps_u, eps_v = 1e-7, 1e-7 * (abs(V) + W)
-        xp, zp = _profile_comp_np(np.exp(u + eps_u), V, L, EA, w, Wp)
-        xm, zm = _profile_comp_np(np.exp(u - eps_u), V, L, EA, w, Wp)
+        xp, zp = _profile_comp_np(np.exp(u + eps_u), V, L, EA, w, Wp, seabed)
+        xm, zm = _profile_comp_np(np.exp(u - eps_u), V, L, EA, w, Wp, seabed)
         J00, J10 = (xp - xm) / (2 * eps_u), (zp - zm) / (2 * eps_u)
-        xp, zp = _profile_comp_np(H, V + eps_v, L, EA, w, Wp)
-        xm, zm = _profile_comp_np(H, V - eps_v, L, EA, w, Wp)
+        xp, zp = _profile_comp_np(H, V + eps_v, L, EA, w, Wp, seabed)
+        xm, zm = _profile_comp_np(H, V - eps_v, L, EA, w, Wp, seabed)
         J01, J11 = (xp - xm) / (2 * eps_v), (zp - zm) / (2 * eps_v)
         det = J00 * J11 - J01 * J10
         if abs(det) < 1e-30:

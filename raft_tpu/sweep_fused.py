@@ -119,7 +119,12 @@ def _prepare_draft(base_design, s, rho_water, g):
         [_scale_fill(m, 0.0) for m in members], turbine, rho_water, g
     )
     ms = parse_mooring(d["mooring"], rho_water=rho_water, g=g)
-    moor = (ms.anchors, ms.rFair, ms.L, ms.EA, ms.w, ms.Wp)
+    if ms.bridles is not None:
+        raise NotImplementedError(
+            "bridled mooring systems are not supported in the fused sweep "
+            "paths yet; use Model.analyze_cases per design"
+        )
+    moor = (ms.anchors, ms.rFair, ms.L, ms.EA, ms.w, ms.Wp, ms.cb)
     A = np.asarray(_am_f64(put_cpu(nodes.astype(np.float64)), rho_water))
     v = _DraftVariant(
         nodes=nodes, moor=moor, A_morison=A,
@@ -381,12 +386,12 @@ def run_draft_ballast_sweep(
         [np.array([0.0, 0.0, v.zMeta]) for v in variants for _ in range(nB)]
     )
     moor_all = tuple(
-        rep(np.stack([v.moor[i] for v in variants])) for i in range(6)
+        rep(np.stack([v.moor[i] for v in variants])) for i in range(7)
     )
     F0g, inv = _mean_load_case_groups(F_prp, nc)
     F0 = np.broadcast_to(F0g[None], (nd, len(F0g), 6)).copy()
     out = moor_fn(*put_cpu((F0, mass_all, V_all, rCG_all, rM_all, AWP_all))
-                  , *put_cpu(moor_all))
+                  , *put_cpu(moor_all), None)
     expand = lambda a: np.asarray(a)[:, inv].copy()  # noqa: E731
     r6, C_moor, F_moor, T_moor, J_moor = (expand(o) for o in out)
     t_moor = time.perf_counter() - t0
@@ -572,6 +577,7 @@ class _GeomVariant:
 _variant_cache = {}
 _VARIANT_CACHE_BYTES = 512 * 1024 * 1024
 _variant_cache_held = [0]
+_variant_cache_lock = __import__("threading").Lock()
 
 
 def _variant_nbytes(v):
@@ -588,12 +594,15 @@ def _variant_cache_put(key, v):
     nb = _variant_nbytes(v)
     if nb > _VARIANT_CACHE_BYTES:
         return
-    while _variant_cache and (
-            _variant_cache_held[0] + nb > _VARIANT_CACHE_BYTES):
-        old = _variant_cache.pop(next(iter(_variant_cache)))
-        _variant_cache_held[0] -= _variant_nbytes(old)
-    _variant_cache[key] = v
-    _variant_cache_held[0] += nb
+    with _variant_cache_lock:   # prep runs in a thread pool
+        if key in _variant_cache:
+            return
+        while _variant_cache and (
+                _variant_cache_held[0] + nb > _VARIANT_CACHE_BYTES):
+            old = _variant_cache.pop(next(iter(_variant_cache)))
+            _variant_cache_held[0] -= _variant_nbytes(old)
+        _variant_cache[key] = v
+        _variant_cache_held[0] += nb
 
 
 def _design_key(design):
@@ -621,9 +630,15 @@ def _prepare_design_point(design, rho_water, g, need_trim):
     turbine = design["turbine"]
     S1 = compute_statics(members, turbine, rho_water, g)
     ms = parse_mooring(design["mooring"], rho_water=rho_water, g=g)
+    if ms.bridles is not None:
+        raise NotImplementedError(
+            "bridled mooring systems are not supported in the fused sweep "
+            "paths yet; use Model.analyze_cases per design"
+        )
     A = np.asarray(_am_f64(put_cpu(nodes.astype(np.float64)), rho_water))
     v = _GeomVariant(
-        nodes=nodes, moor=(ms.anchors, ms.rFair, ms.L, ms.EA, ms.w, ms.Wp),
+        nodes=nodes,
+        moor=(ms.anchors, ms.rFair, ms.L, ms.EA, ms.w, ms.Wp, ms.cb),
         A_morison=A, S1=S1,
     )
     if need_trim:
@@ -719,7 +734,7 @@ def run_design_sweep(
         ))
     moor_all = tuple(
         np.stack([np.asarray(v.moor[i], np.float64) for v in variants])
-        for i in range(6)
+        for i in range(7)
     )
     t_host = time.perf_counter() - t0
 
@@ -776,7 +791,7 @@ def run_design_sweep(
     F0g, inv = _mean_load_case_groups(F_prp, nc)
     F0 = np.broadcast_to(F0g[None], (nd, len(F0g), 6)).copy()
     out = moor_fn(*put_cpu((F0, mass_all, V_all, rCG_all, rM_all, AWP_all))
-                  , *put_cpu(moor_all))
+                  , *put_cpu(moor_all), None)
     expand = lambda a: np.asarray(a)[:, inv].copy()  # noqa: E731
     r6, C_moor, F_moor, T_moor, J_moor = (expand(o) for o in out)
     t_moor = time.perf_counter() - t0

@@ -229,8 +229,28 @@ def plot_model(model, ax=None, color="k", nodes=False, station_plot=None):
         )
         ax.plot(pts[:, 0], pts[:, 1], pts[:, 2], color="b", lw=1.0)
 
+    # bridle groups: draw straight chords junction-terminal per leg
+    if ms.bridles is not None:
+        for ib in range(ms.bridles.n):
+            p0 = np.asarray(ms.bridles.p0[ib])
+            for ik in range(ms.bridles.kind.shape[1]):
+                kd = ms.bridles.kind[ib, ik]
+                if kd < 0:
+                    continue
+                end = np.asarray(ms.bridles.ends[ib, ik], float)
+                if kd == 1:
+                    end = end + np.asarray(r6[:3])
+                seg = np.stack([p0, end])
+                ax.plot(seg[:, 0], seg[:, 1], seg[:, 2], color="b",
+                        lw=1.0, ls="--")
+
     # free surface
-    lim = max(float(np.abs(ms.anchors[:, :2]).max()), 20.0)
+    ext = [20.0]
+    if ms.n_lines:
+        ext.append(float(np.abs(ms.anchors[:, :2]).max()))
+    if ms.bridles is not None:
+        ext.append(float(np.abs(ms.bridles.ends[..., :2]).max()))
+    lim = max(ext)
     xs = np.linspace(-lim, lim, 2)
     X, Y = np.meshgrid(xs, xs)
     ax.plot_surface(X, Y, 0 * X, alpha=0.1, color="c")
@@ -238,7 +258,12 @@ def plot_model(model, ax=None, color="k", nodes=False, station_plot=None):
     ax.set_xlabel("x (m)")
     ax.set_ylabel("y (m)")
     ax.set_zlabel("z (m)")
-    zmin = float(ms.anchors[:, 2].min())
+    zs = []
+    if ms.n_lines:
+        zs.append(float(ms.anchors[:, 2].min()))
+    if ms.bridles is not None:
+        zs.append(float(ms.bridles.ends[..., 2].min()))
+    zmin = min(zs) if zs else -1.0
     ax.set_zlim(min(zmin, -1.0), max(float(model.hHub) + 10.0, 10.0))
     return fig, ax
 

@@ -254,21 +254,239 @@ def test_clump_weight_at_junction(oc3_mooring):
     assert 0.0 < dV < 3.0 * W_clump
 
 
-def test_parse_mooring_rejects_bad_topologies():
+def test_parse_mooring_bridles_and_bad_topologies():
     import copy
 
+    # a free point joining three lines now parses into a bridle group
     moor = copy.deepcopy(OC3_MOORING)
-    # free point joining three lines (a bridle) is out of scope
     moor["points"].append({"name": "Y", "type": "free",
-                           "location": [0.0, 0.0, -100.0]})
+                           "location": [200.0, 0.0, -150.0]})
+    anchor_names = [p["name"] for p in moor["points"]
+                    if p["type"] == "fixed"]
+    vessel_names = [p["name"] for p in moor["points"]
+                    if p["type"] == "vessel"]
     extra = [
-        {"name": "b1", "endA": moor["points"][0]["name"], "endB": "Y",
+        {"name": "b1", "endA": anchor_names[0], "endB": "Y",
          "type": moor["line_types"][0]["name"], "length": 300.0},
-        {"name": "b2", "endA": "Y", "endB": moor["points"][1]["name"],
-         "type": moor["line_types"][0]["name"], "length": 300.0},
-        {"name": "b3", "endA": "Y", "endB": moor["points"][2]["name"],
-         "type": moor["line_types"][0]["name"], "length": 300.0},
+        {"name": "b2", "endA": "Y", "endB": vessel_names[0],
+         "type": moor["line_types"][0]["name"], "length": 160.0},
+        {"name": "b3", "endA": "Y", "endB": vessel_names[1],
+         "type": moor["line_types"][0]["name"], "length": 160.0},
     ]
     moor["lines"] += extra
+    ms = parse_mooring(moor, rho_water=1025.0)
+    assert ms.bridles is not None and ms.bridles.n == 1
+    assert sorted(ms.bridles.kind[0].tolist()) == [0.0, 1.0, 1.0]
+
+    # a chain that dead-ends at a dangling free point still raises
+    moor2 = copy.deepcopy(OC3_MOORING)
+    moor2["points"].append({"name": "dangle", "type": "free",
+                            "location": [0.0, 0.0, -100.0]})
+    moor2["lines"].append(
+        {"name": "bad", "endA": moor2["points"][0]["name"],
+         "endB": "dangle", "type": moor2["line_types"][0]["name"],
+         "length": 300.0})
     with pytest.raises(ValueError):
-        parse_mooring(moor, rho_water=1025.0)
+        parse_mooring(moor2, rho_water=1025.0)
+
+
+def test_seabed_friction_profile():
+    """MoorPy-style CB seabed friction: the grounded portion's tension
+    decays at cb*w per meter, reducing its elastic stretch.  Validated
+    against direct numerical integration of T(s)/EA along the grounded
+    length (hand-catenary oracle)."""
+    from raft_tpu.mooring import _profile
+
+    H, V, L, EA, w, cb = 8.0e5, 4.0e5, 900.0, 3.84e8, 700.0, 0.3
+    assert V < w * L          # grounded configuration
+    x0, z0 = _profile(H, V, L, EA, w, 0.0)
+    x1, z1 = _profile(H, V, L, EA, w, cb)
+    LB = L - V / w
+    s = np.linspace(0.0, LB, 20001)
+    T = np.maximum(H - cb * w * (LB - s), 0.0)
+    corr = np.trapezoid((T - H) / EA, s)
+    assert float(z1) == pytest.approx(float(z0), rel=1e-12)
+    assert float(x1 - x0) == pytest.approx(corr, rel=1e-6)
+    # fully-mobilized case (lam > 0: tension hits zero before the anchor)
+    cb2 = 5.0
+    x2, _ = _profile(H, V, L, EA, w, cb2)
+    T2 = np.maximum(H - cb2 * w * (LB - s), 0.0)
+    corr2 = np.trapezoid((T2 - H) / EA, s)
+    assert float(x2 - x0) == pytest.approx(corr2, rel=1e-6)
+
+
+def test_seabed_friction_through_system(oc3_mooring):
+    """cb threads through parse/forces/tensions: the anchor tension drops
+    by cb*w*LB and the equilibrium shifts, while cb=0 reproduces the
+    frictionless path bit-for-bit."""
+    import dataclasses as dc
+
+    from raft_tpu.mooring import line_forces, line_tensions
+
+    z6 = jnp.zeros(6)
+    arr0 = oc3_mooring.arrays()
+    ms_cb = dc.replace(oc3_mooring,
+                       cb=np.full(oc3_mooring.n_lines, 0.25))
+    arr1 = ms_cb.arrays()
+    f0, H0, V0 = line_forces(z6, *arr0)
+    f1, H1, V1 = line_forces(z6, *arr1)
+    # same span/geometry -> same catenary force balance at the fairlead
+    # except through the grounded-stretch term (small but nonzero)
+    assert not np.allclose(np.asarray(H0), np.asarray(H1))
+    T0 = np.asarray(line_tensions(z6, *arr0))
+    T1 = np.asarray(line_tensions(z6, *arr1))
+    nL = oc3_mooring.n_lines
+    # anchor-end tensions drop with friction; fairlead ends barely move
+    assert np.all(T1[:nL] < T0[:nL])
+    np.testing.assert_allclose(T1[nL:], T0[nL:], rtol=5e-3)
+
+
+def test_bridle_junction_equilibrium():
+    """3-line bridle (one anchor leg + two vessel legs through a free
+    junction): the solved junction position balances the leg tensions
+    recomputed independently by the NumPy catenary twin, the symmetric
+    configuration keeps the junction on the symmetry plane, and the body
+    feels both fairlead pulls."""
+    from raft_tpu.mooring import (
+        BridleSet,
+        bridle_forces,
+        _solve_bridle_junction,
+    )
+    from raft_tpu.mooring_numpy import catenary_solve_np
+
+    # anchor at (-500, 0, -200); two fairleads symmetric about y=0
+    ends = np.array([
+        [[-500.0, 0.0, -200.0],        # anchor leg terminal (world)
+         [-20.0, 15.0, -10.0],         # vessel leg fairlead (body frame)
+         [-20.0, -15.0, -10.0]],
+    ])
+    kind = np.array([[0.0, 1.0, 1.0]])
+    L = np.array([[[550.0], [70.0], [70.0]]])
+    EA = np.full((1, 3, 1), 3.84e8)
+    w = np.full((1, 3, 1), 700.0)
+    Wp = np.zeros((1, 3, 1))
+    bridle = BridleSet(kind=kind, ends=ends, L=L, EA=EA, w=w, Wp=Wp,
+                       Wj=np.array([2000.0 * 9.81]),
+                       p0=np.array([[-60.0, 0.0, -60.0]]))
+    arrs = bridle.arrays()
+    r6 = jnp.zeros(6, dtype=jnp.float64)
+    p, ends_world = _solve_bridle_junction(
+        r6, tuple(a[0] for a in arrs))
+    p = np.asarray(p)
+    assert abs(p[1]) < 1e-6            # symmetry
+    assert -200.0 < p[2] < 0.0
+
+    # independent force balance via the NumPy catenary twin
+    F = np.zeros(3)
+    # anchor leg: junction on top
+    dxy = p[:2] - ends[0, 0, :2]
+    XF = np.hypot(*dxy)
+    H, V = catenary_solve_np(XF, p[2] - ends[0, 0, 2], 550.0, 3.84e8, 700.0)
+    u = dxy / XF
+    F += np.array([-H * u[0], -H * u[1], -V])
+    for kleg in (1, 2):
+        fair = ends[0, kleg]           # body frame == world at r6 = 0
+        dxy = fair[:2] - p[:2]
+        XF = np.hypot(*dxy)
+        H, V = catenary_solve_np(XF, fair[2] - p[2], 70.0, 3.84e8, 700.0,
+                                 seabed=False)
+        u = dxy / XF
+        VA = V - 700.0 * 70.0
+        F += np.array([H * u[0], H * u[1], VA])
+    F[2] -= 2000.0 * 9.81
+    scale = 700.0 * 550.0
+    assert np.max(np.abs(F)) < 1e-5 * scale
+
+    # body reaction: both fairleads pulled, net y cancels by symmetry
+    f6, T = bridle_forces(r6, arrs)
+    f6 = np.asarray(f6)
+    assert f6[0] < 0.0                 # pulled toward the anchor
+    assert abs(f6[1]) < 1e-5 * abs(f6[0])
+    assert np.asarray(T)[0, 1] > 0 and np.asarray(T)[0, 2] > 0
+    assert np.asarray(T)[0, 0] == 0.0  # anchor legs don't pull the body
+
+
+def test_bridled_model_end_to_end():
+    """A design whose mooring uses crow's-foot bridles (each anchor line
+    splits at a free junction into two vessel legs) runs the full
+    Model analysis: equilibrium offsets, stiffness, and the case solve."""
+    from raft_tpu.designs import deep_spar
+    from raft_tpu.model import Model
+
+    design = deep_spar(n_cases=2, nw_settings=(0.05, 0.5))
+    moor = design["mooring"]
+    pts, lines = [], []
+    for i, th in enumerate(np.deg2rad([60.0, 180.0, 300.0])):
+        c, s = np.cos(th), np.sin(th)
+        pts += [
+            {"name": f"anchor{i}", "type": "fixed",
+             "location": [850.0 * c, 850.0 * s, -300.0],
+             "anchor_type": "drag_embedment"},
+            {"name": f"junc{i}", "type": "free", "mass": 500.0,
+             "location": [80.0 * c, 80.0 * s, -120.0]},
+            {"name": f"fairA{i}", "type": "vessel",
+             "location": [5.2 * c - 2.0 * s, 5.2 * s + 2.0 * c, -70.0]},
+            {"name": f"fairB{i}", "type": "vessel",
+             "location": [5.2 * c + 2.0 * s, 5.2 * s - 2.0 * c, -70.0]},
+        ]
+        lines += [
+            {"name": f"main{i}", "endA": f"anchor{i}", "endB": f"junc{i}",
+             "type": "chain", "length": 820.0},
+            {"name": f"brA{i}", "endA": f"junc{i}", "endB": f"fairA{i}",
+             "type": "chain", "length": 110.0},
+            {"name": f"brB{i}", "endA": f"junc{i}", "endB": f"fairB{i}",
+             "type": "chain", "length": 110.0},
+        ]
+    moor["points"] = pts
+    moor["lines"] = lines
+
+    m = Model(design)
+    assert m.ms.bridles is not None and m.ms.bridles.n == 3
+    assert m.ms.n_lines == 0          # every line belongs to a bridle
+    m.analyze_unloaded()
+    # bridles carry the whole pretension: nonzero downward F_moor0 and
+    # positive surge/sway stiffness
+    assert m.F_moor0[2] < -1e4
+    assert m.C_moor0[0, 0] > 1e3 and m.C_moor0[1, 1] > 1e3
+    res = m.analyze_cases()
+    cm = res["case_metrics"]
+    assert np.isfinite(cm["surge_std"]).all()
+    assert (cm["surge_std"] > 0).all()
+
+
+def test_bridle_anchor_leg_clump_ordering():
+    """A bridle anchor leg containing a clumped intermediate free point:
+    parse must place the clump at the correct segment top after the
+    junction->anchor walk is reversed to anchor->junction order."""
+    moor = {
+        "water_depth": 200.0,
+        "line_types": [{"name": "ch", "diameter": 0.09,
+                        "mass_density": 77.7, "stiffness": 3.84e8}],
+        "points": [
+            {"name": "A", "type": "fixed", "location": [-500.0, 0.0, -200.0]},
+            {"name": "P", "type": "free", "mass": 3000.0,
+             "location": [-300.0, 0.0, -150.0]},
+            {"name": "Y", "type": "free", "location": [-60.0, 0.0, -60.0]},
+            {"name": "f1", "type": "vessel", "location": [-20.0, 15.0, -10.0]},
+            {"name": "f2", "type": "vessel", "location": [-20.0, -15.0, -10.0]},
+        ],
+        "lines": [
+            {"name": "a1", "endA": "A", "endB": "P", "type": "ch",
+             "length": 300.0},
+            {"name": "a2", "endA": "P", "endB": "Y", "type": "ch",
+             "length": 250.0},
+            {"name": "v1", "endA": "Y", "endB": "f1", "type": "ch",
+             "length": 110.0},
+            {"name": "v2", "endA": "Y", "endB": "f2", "type": "ch",
+             "length": 110.0},
+        ],
+    }
+    ms = parse_mooring(moor, rho_water=1025.0)
+    b = ms.bridles
+    assert b is not None and b.n == 1
+    ileg = int(np.where(b.kind[0] == 0.0)[0][0])
+    # anchor->junction order: segment 0 = a1 (300 m) with the clump at its
+    # TOP (the P node), segment 1 = a2 (250 m) with no clump
+    np.testing.assert_allclose(b.L[0, ileg], [300.0, 250.0])
+    W_P = 3000.0 * 9.81
+    np.testing.assert_allclose(b.Wp[0, ileg], [W_P, 0.0])
