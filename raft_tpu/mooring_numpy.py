@@ -11,7 +11,9 @@ design) without any JAX machinery in the timed path, and it doubles as an
 independent f64 oracle for the JAX mooring solver (tests/test_mooring.py).
 
 Formulation identical to raft_tpu.mooring (elastic catenary, frictionless
-seabed, damped Newton in (log HF, VF)); the body stiffness is obtained by
+seabed, damped Newton in (log HF, log VF) — log space in BOTH unknowns so
+the spurious negative-V roots of the touchdown equations are unreachable);
+the body stiffness is obtained by
 central finite differencing of the net line force like MoorPy does
 (MoorPy getCoupledStiffness is FD-based — SURVEY.md §2.2 row 1).
 """
@@ -96,32 +98,45 @@ def catenary_solve_np(XF, ZF, L, EA, w, Wp=None, tol=1e-10, max_iter=60,
     lam0 = 0.25 if L_tot <= d else np.sqrt(slack)
     H = max(abs(0.5 * w_eff * XF / lam0), 10.0)
     V = 0.5 * w_eff * (ZF / np.tanh(lam0) + L_tot) + 0.5 * float(np.sum(Wp))
+    if L_tot <= d:
+        # taut line: elastic-bar tension along the chord (matches the JAX
+        # solver's taut initial guess; the catenary-sag guess stalls here)
+        EA_eff = L_tot / float(np.sum(L / EA))
+        T_el = EA_eff * max(d - L_tot, 0.0) / L_tot + 0.5 * W
+        H = max(T_el * XF / d, 10.0)
+        V = T_el * ZF / d + 0.5 * W + 0.5 * float(np.sum(Wp))
     scale = max(abs(XF), abs(ZF))
+    # Both unknowns in log space — H > 0 always, and the fairlead (top-end)
+    # vertical tension V > 0 for every bottom->top oriented line.  Solving V
+    # linearly admits spurious negative-V roots of the touchdown equations
+    # (residual ~1e-10 but unphysical); same treatment as the JAX
+    # mooring.catenary_solve.
     u = np.log(H)
+    s = np.log(max(V, 1.0))
     for _ in range(max_iter):
-        H = np.exp(u)
+        H, V = np.exp(u), np.exp(s)
         x, z = _profile_comp_np(H, V, L, EA, w, Wp, seabed)
         r = np.array([x - XF, z - ZF])
         if np.max(np.abs(r)) < tol * scale:
             break
-        # Jacobian wrt (log H, V) by central differences of the profile
-        eps_u, eps_v = 1e-7, 1e-7 * (abs(V) + W)
-        xp, zp = _profile_comp_np(np.exp(u + eps_u), V, L, EA, w, Wp, seabed)
-        xm, zm = _profile_comp_np(np.exp(u - eps_u), V, L, EA, w, Wp, seabed)
-        J00, J10 = (xp - xm) / (2 * eps_u), (zp - zm) / (2 * eps_u)
-        xp, zp = _profile_comp_np(H, V + eps_v, L, EA, w, Wp, seabed)
-        xm, zm = _profile_comp_np(H, V - eps_v, L, EA, w, Wp, seabed)
-        J01, J11 = (xp - xm) / (2 * eps_v), (zp - zm) / (2 * eps_v)
+        # Jacobian wrt (log H, log V) by central differences of the profile
+        eps = 1e-7
+        xp, zp = _profile_comp_np(np.exp(u + eps), V, L, EA, w, Wp, seabed)
+        xm, zm = _profile_comp_np(np.exp(u - eps), V, L, EA, w, Wp, seabed)
+        J00, J10 = (xp - xm) / (2 * eps), (zp - zm) / (2 * eps)
+        xp, zp = _profile_comp_np(H, np.exp(s + eps), L, EA, w, Wp, seabed)
+        xm, zm = _profile_comp_np(H, np.exp(s - eps), L, EA, w, Wp, seabed)
+        J01, J11 = (xp - xm) / (2 * eps), (zp - zm) / (2 * eps)
         det = J00 * J11 - J01 * J10
         if abs(det) < 1e-30:
             det = 1e-30
         du = (J11 * r[0] - J01 * r[1]) / det
         dv = (-J10 * r[0] + J00 * r[1]) / det
         du = np.clip(du, -1.5, 1.5)
-        dv = np.clip(dv, -0.5 * (abs(V) + W), 0.5 * (abs(V) + W))
+        dv = np.clip(dv, -1.5, 1.5)
         u -= du
-        V -= dv
-    return np.exp(u), V
+        s -= dv
+    return np.exp(u), np.exp(s)
 
 
 def _rotmat(r4, r5, r6):

@@ -14,7 +14,8 @@ import jax.numpy as jnp
 from raft_tpu.designs import demo_semi
 from raft_tpu.model import Model
 from raft_tpu.mooring import case_mooring
-from raft_tpu.mooring_numpy import case_mooring_np, catenary_solve_np
+from raft_tpu.mooring_numpy import (
+    case_mooring_np, catenary_solve_np, line_forces_np)
 
 
 def test_catenary_matches_jax():
@@ -22,7 +23,14 @@ def test_catenary_matches_jax():
 
     for XF, ZF, L, EA, w in [
         (800.0, 186.0, 835.0, 7.5e8, 3000.0),   # taut-ish
-        (700.0, 186.0, 835.0, 7.5e8, 3000.0),   # seabed contact
+        (700.0, 186.0, 835.0, 7.5e8, 3000.0),   # seabed contact (the case
+        # where a linear-V Newton converges to a spurious negative-V root:
+        # H=203 kN, V=-733 kN satisfies the touchdown equations to 1e-10
+        # but is unphysical; log-V iteration finds H=86 kN, V=+638 kN)
+        (660.0, 186.0, 835.0, 7.5e8, 3000.0),   # deep touchdown (H=8.4 kN;
+        # XF <~ 650 enters the fully-slack regime where H underflows and V
+        # is indeterminate up to seabed-pile accounting — don't test there)
+        (760.0, 150.0, 837.6, 7.54e8, 1853.0),  # VolturnUS-S-like geometry
         (50.0, 300.0, 320.0, 5.0e8, 2000.0),    # steep
     ]:
         H_np, V_np = catenary_solve_np(XF, ZF, L, EA, w)
@@ -35,6 +43,17 @@ def test_catenary_matches_jax():
 
 
 def test_case_mooring_matches_jax():
+    """Oracle-vs-JAX parity at a GROUNDED equilibrium.
+
+    At this load the demo-semi equilibrium sits in the touchdown branch on
+    all three lines (VA = VF - wL in [-454, -224] kN) — like the flagship
+    VolturnUS-S sweep design, which grounds every line at every design
+    point (VA ~ -3 MN).  The grounded assertions below are the regression
+    guard for the spurious negative-V touchdown root a linear-V Newton
+    converges to (H=203 kN, V=-733 kN on the XF=700 case above): the
+    serial baseline must find the physical root wherever the sweep
+    benchmark exercises it.
+    """
     design = demo_semi()
     design["settings"] = {"min_freq": 0.02, "max_freq": 0.2}
     m = Model(design)
@@ -48,6 +67,13 @@ def test_case_mooring_matches_jax():
         f6, props, ms.anchors, ms.rFair, ms.L, ms.EA, ms.w,
         rho=m.rho_water, g=m.g, yawstiff=m.yawstiff,
     )
+    # the equilibrium must actually exercise the touchdown branch, with
+    # physical (positive-V) fairlead tensions on every line
+    _, HF, VF = line_forces_np(r6_np, ms.anchors, ms.rFair, ms.L, ms.EA, ms.w)
+    Lw = np.asarray(ms.w, float) * np.asarray(ms.L, float)
+    W = Lw if Lw.ndim == 1 else np.sum(Lw, axis=-1)
+    assert np.all(VF - W < 0.0), "equilibrium no longer grounds the lines"
+    assert np.all(VF > 0.0), "oracle found an unphysical negative-V root"
     out = case_mooring(
         jnp.asarray(f6), *[jnp.asarray(np.asarray(p, np.float64)) for p in props],
         *m._moor_arrays, rho=m.rho_water, g=m.g, yawstiff=m.yawstiff,
