@@ -40,6 +40,7 @@ from raft_tpu.mooring import (
     line_forces,
     parse_mooring,
     unloaded_mooring_fn,
+    warn_bridle_residual,
 )
 from raft_tpu.statics import compute_statics, member_inertia
 from raft_tpu.utils.placement import backend_sharding, put_cpu
@@ -505,7 +506,9 @@ class Model:
         # ---- mean offsets & linearized mooring, all cases in one jitted
         # vmapped CPU f64 call ----
         with timer("mooring_offsets"):
-            Xi0, C_moor, _, T_moor, J_moor = self._mooring_and_offsets(F_aero0)
+            Xi0, C_moor, _, T_moor, J_moor, moor_resid = (
+                self._mooring_and_offsets(F_aero0))
+        warn_bridle_residual(moor_resid, label="case")
         if verbose:
             for i in range(ncase):
                 print(
@@ -633,7 +636,9 @@ class Model:
         T_moor = aux["T_moor"]
         J_moor = aux["J_moor"]
         F_aero0 = aux["F_aero0"]
-        nLines = self.ms.n_lines
+        # tension channels: trunk lines plus bridle legs (padded slots
+        # report zeros) — T_moor is [ncase, 2 (nL + nB K)]
+        nLines = T_moor.shape[-1] // 2
 
         # ---- the batched device solve ----
         if self._pipeline is None:

@@ -60,6 +60,12 @@ class BridleSet:
     Wp: np.ndarray      # [nB, K, S]
     Wj: np.ndarray      # [nB] junction net weight (N; mass - buoyancy)
     p0: np.ndarray      # [nB, 3] junction position initial guess
+    cb: np.ndarray = None  # [nB, K] seabed friction of each leg's
+    #                        anchor-side segment (0 for vessel legs)
+
+    def __post_init__(self):
+        if self.cb is None:
+            self.cb = np.zeros(self.kind.shape)
 
     @property
     def n(self):
@@ -67,7 +73,7 @@ class BridleSet:
 
     def arrays(self, dtype=jnp.float64, device="cpu"):
         src = (self.kind.astype(float), self.ends, self.L, self.EA,
-               self.w, self.Wp, self.Wj, self.p0)
+               self.w, self.Wp, self.cb, self.Wj, self.p0)
         if device == "cpu":
             from raft_tpu.utils.placement import put_cpu
 
@@ -175,8 +181,15 @@ def parse_mooring(mooring, rho_water=1025.0, g=9.81):
         cur = start_node
         while points[cur]["type"] == "free" and cur not in junctions:
             at = attach[cur]
-            (j,) = [j for j, _ in at if j != chain[-1]]
-            chain.append(j)
+            nxt = [j for j, _ in at if j != chain[-1]]
+            if len(nxt) != 1:
+                raise ValueError(
+                    f"free point '{cur}' dead-ends the line chain (it "
+                    f"joins {len(at)} line(s)); a free point must join "
+                    "exactly two lines, or three-plus to form a bridle "
+                    "junction"
+                )
+            chain.append(nxt[0])
             cur = [o for j, o in at if j == chain[-1]][0]
         return chain, cur
 
@@ -299,10 +312,15 @@ def parse_mooring(mooring, rho_water=1025.0, g=9.81):
         bEA = np.ones((nB, K, Sb)) * 1e9
         bw = np.ones((nB, K, Sb)) * 100.0
         bWp = np.zeros((nB, K, Sb))
+        bcb = np.zeros((nB, K))
         for ib, legs in enumerate(bridle_legs):
             for ik, (kd, end, seg) in enumerate(legs):
                 kind[ib, ik] = kd
                 ends[ib, ik] = end
+                if kd == 0:
+                    # anchor leg (seg ordered anchor->junction): friction
+                    # acts on the grounded anchor-side bottom segment
+                    bcb[ib, ik] = seg[0][3]
                 for ks, (lk, ek, wk, _cbk, wpk) in enumerate(seg):
                     bL[ib, ik, ks] = lk
                     bEA[ib, ik, ks] = ek
@@ -317,7 +335,7 @@ def parse_mooring(mooring, rho_water=1025.0, g=9.81):
                 # inert padded leg: parked far below, force masked out
                 ends[ib, ik] = np.array([0.0, 0.0, -1.0])
         bridles = BridleSet(
-            kind=kind, ends=ends, L=bL, EA=bEA, w=bw, Wp=bWp,
+            kind=kind, ends=ends, L=bL, EA=bEA, w=bw, Wp=bWp, cb=bcb,
             Wj=np.array(bridle_Wj), p0=np.array(bridle_p0),
         )
 
@@ -534,12 +552,17 @@ def catenary_solve(XF, ZF, L, EA, w, Wp=None, cb=0.0, iters=60,
 
 # ---------------- bridle junctions ----------------
 
-def _bridle_leg_force(p, end_world, kind, L, EA, w, Wp):
+def _bridle_leg_force(p, end_world, kind, L, EA, w, Wp, cb=0.0):
     """Force exerted ON the junction at ``p`` by one bridle leg, plus the
-    leg's top-end tension.  kind 0: anchor leg (junction on top, seabed
-    catenary); kind 1: vessel leg (junction on the bottom, fully
+    leg's end tensions.  kind 0: anchor leg (junction on top, seabed
+    catenary with friction coefficient ``cb`` on the grounded bottom
+    segment); kind 1: vessel leg (junction on the bottom, fully
     suspended); kind < 0: inert padding (solved on a fixed benign
-    geometry so no NaN can leak into the masked sum)."""
+    geometry so no NaN can leak into the masked sum).
+
+    Returns (F_on_junction[3], T_top, T_bot, HF, VF) — T_top at the leg's
+    upper end (junction for anchor legs, fairlead for vessel legs), T_bot
+    at its lower end (anchor / junction), both zero for padded legs."""
     active = kind >= 0.0
     is_anchor = kind == 0.0
     # low/high ends of the bottom->top catenary
@@ -551,7 +574,7 @@ def _bridle_leg_force(p, end_world, kind, L, EA, w, Wp):
     # padded legs solve a fixed well-conditioned configuration
     XF = jnp.where(active, XF, 10.0)
     ZF = jnp.where(active, ZF, 5.0)
-    H_a, V_a = catenary_solve(XF, ZF, L, EA, w, Wp)            # seabed
+    H_a, V_a = catenary_solve(XF, ZF, L, EA, w, Wp, cb)        # seabed
     H_s, V_s = catenary_solve(XF, ZF, L, EA, w, Wp, seabed=False)
     HF = jnp.where(is_anchor, H_a, H_s)
     VF = jnp.where(is_anchor, V_a, V_s)
@@ -566,7 +589,19 @@ def _bridle_leg_force(p, end_world, kind, L, EA, w, Wp):
         jnp.array([HF * u[0], HF * u[1], VA]),
     )
     T_top = jnp.sqrt(HF**2 + VF**2)
-    return jnp.where(active, F, 0.0), jnp.where(active, T_top, 0.0), HF, VF
+    # bottom-end tension: suspended -> hypot(HF, VA); grounded anchor end
+    # -> horizontal only, friction-decayed along the grounded length
+    # (MoorPy's CB branch, same expression as line_tensions)
+    w0 = w[0] if w.ndim else w
+    L0 = L[0] if L.ndim else L
+    Vb = VF - (jnp.sum(w * L) + jnp.sum(Wp) - w0 * L0)
+    LB = jnp.clip(L0 - Vb / w0, 0.0, L0)
+    HA = jnp.maximum(HF - cb * w0 * LB, 0.0)
+    # vessel legs are fully suspended: VA < 0 is sag below the junction,
+    # where the bottom tension is still hypot (only anchor legs ground)
+    T_bot = jnp.where(is_anchor & (VA < 0), HA, jnp.sqrt(HF**2 + VA**2))
+    return (jnp.where(active, F, 0.0), jnp.where(active, T_top, 0.0),
+            jnp.where(active, T_bot, 0.0), HF, VF)
 
 
 def _solve_bridle_junction(r6, bridle, iters=400):
@@ -574,8 +609,13 @@ def _solve_bridle_junction(r6, bridle, iters=400):
     force balance of its legs + junction weight.  The converged position
     is stop-gradient'ed and polished with one differentiable Newton step,
     so downstream jacfwd (stiffness, tension Jacobians) gets the
-    implicit-function derivative without unrolling the loop."""
-    kind, ends, L, EA, w, Wp, Wj, p0 = bridle
+    implicit-function derivative without unrolling the loop.
+
+    Returns (p[3], ends_world[K, 3], resid) where ``resid`` is the final
+    force-balance residual relative to the legs' natural force scale —
+    callers surface it so an iteration-capped exit cannot silently feed
+    an unconverged junction into forces and stiffnesses."""
+    kind, ends, L, EA, w, Wp, cb, Wj, p0 = bridle
     R = rotation_matrix(r6[3], r6[4], r6[5])
     ends_world = jnp.where(
         (kind == 1.0)[:, None],
@@ -584,10 +624,10 @@ def _solve_bridle_junction(r6, bridle, iters=400):
     )
 
     def net(p):
-        F, _, _, _ = jax.vmap(
-            lambda e, kd, Lk, EAk, wk, Wpk: _bridle_leg_force(
-                p, e, kd, Lk, EAk, wk, Wpk),
-        )(ends_world, kind, L, EA, w, Wp)
+        F = jax.vmap(
+            lambda e, kd, Lk, EAk, wk, Wpk, cbk: _bridle_leg_force(
+                p, e, kd, Lk, EAk, wk, Wpk, cbk)[0],
+        )(ends_world, kind, L, EA, w, Wp, cb)
         return jnp.sum(F, axis=0) + jnp.array([0.0, 0.0, -Wj])
 
     jac = jax.jacfwd(net)
@@ -639,22 +679,31 @@ def _solve_bridle_junction(r6, bridle, iters=400):
     # (an undamped Newton "polish" at a near-kink root can jump far along
     # the soft directions), with exact implicit-function tangents
     p = jax.lax.custom_root(net, p0, solve, tangent_solve)
-    return p, ends_world
+    resid = jnp.max(jnp.abs(net(p))) / f_scale
+    return p, ends_world, resid
 
 
 def bridle_forces(r6, bridle):
-    """6-DOF body reaction from every bridle group at pose r6, plus the
-    vessel-leg fairlead tensions [nB, K] (zero for anchor/padded legs)."""
-    kind, ends, L, EA, w, Wp, Wj, p0 = bridle
+    """6-DOF body reaction from every bridle group at pose r6, plus per-leg
+    tension statistics and the junction convergence signal.
 
-    def one(kd, e, Lb, EAb, wb, Wpb, Wjb, p0b):
-        p, ends_world = _solve_bridle_junction(
-            r6, (kd, e, Lb, EAb, wb, Wpb, Wjb, p0b))
+    Returns (f6[6], TA[nB, K], TB[nB, K], resid[nB]):
+      TA — each leg's lower-end tension (anchor end for anchor legs,
+           friction-decayed when grounded; junction end for vessel legs),
+      TB — each leg's upper-end tension (junction end for anchor legs,
+           fairlead end for vessel legs); both zero for padded legs,
+      resid — each junction's relative force-balance residual (see
+           :func:`_solve_bridle_junction`)."""
+    kind, ends, L, EA, w, Wp, cb, Wj, p0 = bridle
+
+    def one(kd, e, Lb, EAb, wb, Wpb, cbb, Wjb, p0b):
+        p, ends_world, resid = _solve_bridle_junction(
+            r6, (kd, e, Lb, EAb, wb, Wpb, cbb, Wjb, p0b))
         R = rotation_matrix(r6[3], r6[4], r6[5])
 
-        def leg(e_w, e_body, kdk, Lk, EAk, wk, Wpk):
-            _, T_top, HF, VF = _bridle_leg_force(
-                p, e_w, kdk, Lk, EAk, wk, Wpk)
+        def leg(e_w, e_body, kdk, Lk, EAk, wk, Wpk, cbk):
+            _, T_top, T_bot, HF, VF = _bridle_leg_force(
+                p, e_w, kdk, Lk, EAk, wk, Wpk, cbk)
             # vessel legs pull the body at their fairlead
             dxy = e_w[:2] - p[:2]
             u = dxy / jnp.maximum(jnp.sqrt(jnp.sum(dxy**2)), 1e-9)
@@ -665,13 +714,15 @@ def bridle_forces(r6, bridle):
             )
             arm = jnp.einsum("ij,j->i", R, e_body)
             f6 = translate_force_3to6(F3, arm)
-            return f6, jnp.where(kdk == 1.0, T_top, 0.0)
+            return f6, T_bot, T_top
 
-        f6_legs, T = jax.vmap(leg)(ends_world, e, kd, Lb, EAb, wb, Wpb)
-        return jnp.sum(f6_legs, axis=0), T
+        f6_legs, TA, TB = jax.vmap(leg)(
+            ends_world, e, kd, Lb, EAb, wb, Wpb, cbb)
+        return jnp.sum(f6_legs, axis=0), TA, TB, resid
 
-    f6_all, T_all = jax.vmap(one)(kind, ends, L, EA, w, Wp, Wj, p0)
-    return jnp.sum(f6_all, axis=0), T_all
+    f6_all, TA_all, TB_all, resid = jax.vmap(one)(
+        kind, ends, L, EA, w, Wp, cb, Wj, p0)
+    return jnp.sum(f6_all, axis=0), TA_all, TB_all, resid
 
 
 # ---------------- system-level forces ----------------
@@ -704,15 +755,14 @@ def line_forces(r6, anchors, rFair, L, EA, w, Wp=None, cb=None,
     return f6, HF, VF
 
 
-def line_tensions(r6, anchors, rFair, L, EA, w, Wp=None, cb=None,
-                  bridles=None):
-    """End tensions [TA..., TB...] (anchor ends first, then fairlead ends),
-    matching MoorPy's getTensions ordering consumed at reference
-    raft/raft_model.py:273-283."""
+def _line_tensions_resid(r6, anchors, rFair, L, EA, w, Wp=None, cb=None,
+                         bridles=None):
+    """:func:`line_tensions` plus the worst bridle-junction residual from
+    the SAME bridle solve (so :func:`case_mooring` does not trace a second
+    junction LM loop just to read the convergence signal)."""
     if Wp is None:
         Wp = jnp.zeros_like(L)
     _, HF, VF = line_forces(r6, anchors, rFair, L, EA, w, Wp, cb)
-    del bridles  # bridle leg tensions are reported via bridle_forces
     # vertical tension at the anchor end of the composite line (1-D legacy
     # [nL] inputs are per-line scalars — summing axis -1 would total ALL
     # lines' weights)
@@ -730,7 +780,29 @@ def line_tensions(r6, anchors, rFair, L, EA, w, Wp=None, cb=None,
     cb_arr = jnp.zeros_like(HF) if cb is None else cb
     HA = jnp.maximum(HF - cb_arr * w0 * LB, 0.0)
     TA = jnp.where(VA >= 0, jnp.sqrt(HF**2 + VA**2), HA)
-    return jnp.concatenate([TA, TB])
+    resid = jnp.zeros((), L.dtype)
+    if bridles is not None:
+        _, TA_b, TB_b, resid_b = bridle_forces(r6, bridles)
+        TA = jnp.concatenate([TA, TA_b.reshape(-1)])
+        TB = jnp.concatenate([TB, TB_b.reshape(-1)])
+        resid = jnp.max(resid_b)
+    return jnp.concatenate([TA, TB]), resid
+
+
+def line_tensions(r6, anchors, rFair, L, EA, w, Wp=None, cb=None,
+                  bridles=None):
+    """End tensions [TA..., TB...] (anchor ends first, then fairlead ends),
+    matching MoorPy's getTensions ordering consumed at reference
+    raft/raft_model.py:273-283.  When the system has bridles, each bridle
+    leg contributes its own (TA, TB) pair after the trunk lines — the
+    reference consumes MoorPy tensions for *every* line object, and the
+    crow's-foot legs are routinely the tension-critical ones:
+
+        [TA_line 0..nL, TA_leg (b,k) row-major ..., TB_line ..., TB_leg ...]
+
+    Padded bridle slots report zero at both ends."""
+    return _line_tensions_resid(r6, anchors, rFair, L, EA, w, Wp, cb,
+                                bridles)[0]
 
 
 def body_hydrostatic_force(r6, m, v, rCG, rM, AWP, rho=1025.0, g=9.81):
@@ -812,11 +884,15 @@ def coupled_stiffness(r6, anchors, rFair, L, EA, w, Wp=None, cb=None,
     return -jax.jacfwd(f)(r6)
 
 
-def tension_jacobian(r6, anchors, rFair, L, EA, w, Wp=None, cb=None):
-    """J_moor = d tensions / d r6  [2 nL, 6] (reference raft_model.py:366,
-    consumed for tension FFTs at :273-283)."""
+def tension_jacobian(r6, anchors, rFair, L, EA, w, Wp=None, cb=None,
+                     bridles=None):
+    """J_moor = d tensions / d r6  [2 (nL + nB K), 6] (reference
+    raft_model.py:366, consumed for tension FFTs at :273-283); bridle leg
+    rows differentiate through the junction equilibrium via its
+    custom_root implicit tangents."""
     return jax.jacfwd(
-        lambda r: line_tensions(r, anchors, rFair, L, EA, w, Wp, cb)
+        lambda r: line_tensions(r, anchors, rFair, L, EA, w, Wp, cb,
+                                bridles)
     )(r6)
 
 
@@ -832,7 +908,11 @@ def case_mooring(f6_ext, m, v, rCG, rM, AWP, anchors, rFair, L, EA, w,
     the same compiled executable instead of retracing the autodiff-through-
     catenary graphs per case.
 
-    Returns (r6, C_moor, F_moor, T_moor, J_moor).
+    Returns (r6, C_moor, F_moor, T_moor, J_moor, moor_resid) —
+    ``moor_resid`` is the worst bridle-junction force-balance residual at
+    the converged pose (0 when the system has no bridles), surfaced so an
+    iteration-capped junction solve cannot feed forces silently (the
+    dynamics path reports ``converged`` the same way).
     """
     if Wp is None:
         Wp = jnp.zeros_like(L)
@@ -843,9 +923,34 @@ def case_mooring(f6_ext, m, v, rCG, rM, AWP, anchors, rFair, L, EA, w,
     C_moor = coupled_stiffness(r6, anchors, rFair, L, EA, w, Wp, cb, bridles)
     C_moor = C_moor.at[5, 5].add(yawstiff)
     F_moor = line_forces(r6, anchors, rFair, L, EA, w, Wp, cb, bridles)[0]
-    T_moor = line_tensions(r6, anchors, rFair, L, EA, w, Wp, cb)
-    J_moor = tension_jacobian(r6, anchors, rFair, L, EA, w, Wp, cb)
-    return r6, C_moor, F_moor, T_moor, J_moor
+    T_moor, moor_resid = _line_tensions_resid(
+        r6, anchors, rFair, L, EA, w, Wp, cb, bridles)
+    J_moor = tension_jacobian(r6, anchors, rFair, L, EA, w, Wp, cb, bridles)
+    return r6, C_moor, F_moor, T_moor, J_moor, moor_resid
+
+
+# bridle-junction convergence reporting shared by every consumer (Model's
+# per-case path and both fused sweeps): the junction solver iterates to
+# 1e-6 x the legs' force scale, so a relative residual above this is an
+# iteration-capped exit worth surfacing (warn-and-continue semantics,
+# like the dynamics `converged` output)
+BRIDLE_RESID_TOL = 1e-5
+
+
+def warn_bridle_residual(moor_resid, label="case"):
+    """Print a warning for every leading-axis entry of ``moor_resid``
+    (scalars per case/design; trailing axes reduced by max) whose bridle
+    force-balance residual exceeds :data:`BRIDLE_RESID_TOL`."""
+    r = np.asarray(moor_resid)
+    if r.ndim == 0:
+        r = r[None]
+    r = r.reshape(len(r), -1).max(axis=1)
+    for i in np.nonzero(r > BRIDLE_RESID_TOL)[0]:
+        print(
+            f"WARNING - {label} {i+1}: bridle junction solve residual "
+            f"{r[i]:.2e} exceeds tolerance; mooring linearization may "
+            "be off."
+        )
 
 
 # ---------------- cached jitted entry points ----------------

@@ -286,7 +286,7 @@ def test_parse_mooring_bridles_and_bad_topologies():
         {"name": "bad", "endA": moor2["points"][0]["name"],
          "endB": "dangle", "type": moor2["line_types"][0]["name"],
          "length": 300.0})
-    with pytest.raises(ValueError):
+    with pytest.raises(ValueError, match="dangle"):
         parse_mooring(moor2, rho_water=1025.0)
 
 
@@ -370,8 +370,9 @@ def test_bridle_junction_equilibrium():
                        p0=np.array([[-60.0, 0.0, -60.0]]))
     arrs = bridle.arrays()
     r6 = jnp.zeros(6, dtype=jnp.float64)
-    p, ends_world = _solve_bridle_junction(
+    p, ends_world, resid = _solve_bridle_junction(
         r6, tuple(a[0] for a in arrs))
+    assert float(resid) < 1e-5         # junction force balance converged
     p = np.asarray(p)
     assert abs(p[1]) < 1e-6            # symmetry
     assert -200.0 < p[2] < 0.0
@@ -398,12 +399,19 @@ def test_bridle_junction_equilibrium():
     assert np.max(np.abs(F)) < 1e-5 * scale
 
     # body reaction: both fairleads pulled, net y cancels by symmetry
-    f6, T = bridle_forces(r6, arrs)
+    f6, TA, TB, resid = bridle_forces(r6, arrs)
     f6 = np.asarray(f6)
+    TA, TB = np.asarray(TA), np.asarray(TB)
     assert f6[0] < 0.0                 # pulled toward the anchor
     assert abs(f6[1]) < 1e-5 * abs(f6[0])
-    assert np.asarray(T)[0, 1] > 0 and np.asarray(T)[0, 2] > 0
-    assert np.asarray(T)[0, 0] == 0.0  # anchor legs don't pull the body
+    assert float(np.max(resid)) < 1e-5
+    # every active leg reports both end tensions; the vessel-leg fairlead
+    # (top) tensions match by symmetry, the anchor leg's junction-end
+    # tension exceeds its grounded anchor-end tension
+    assert TB[0, 1] > 0 and TB[0, 2] > 0
+    np.testing.assert_allclose(TB[0, 1], TB[0, 2], rtol=1e-9)
+    assert TA[0, 1] > 0 and TA[0, 2] > 0
+    assert TB[0, 0] > TA[0, 0] >= 0.0
 
 
 def test_bridled_model_end_to_end():

@@ -78,7 +78,7 @@ def test_case_mooring_matches_jax():
         jnp.asarray(f6), *[jnp.asarray(np.asarray(p, np.float64)) for p in props],
         *m._moor_arrays, rho=m.rho_water, g=m.g, yawstiff=m.yawstiff,
     )
-    r6_j, C_j, F_j, T_j, J_j = (np.asarray(o) for o in out)
+    r6_j, C_j, F_j, T_j, J_j, _resid = (np.asarray(o) for o in out)
 
     np.testing.assert_allclose(r6_np, r6_j, rtol=1e-6, atol=1e-9)
     np.testing.assert_allclose(F_np, F_j, rtol=1e-5, atol=1.0)
