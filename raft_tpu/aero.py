@@ -202,7 +202,7 @@ def _induction(phi, cl, cd, sigma_p, F_args, usecd=True):
 
 
 def _solve_phi(theta, cl_tab, cd_tab, aoa_grid, sigma_p, F_args,
-               n_bisect=30, n_newton=2):
+               n_bisect=30, n_newton=2, phi0=None):
     """Inflow angle phi solving the BEM residual for one blade section.
 
     Bisection on Ning's primary bracket (eps, pi/2), with fallback brackets
@@ -212,6 +212,17 @@ def _solve_phi(theta, cl_tab, cd_tab, aoa_grid, sigma_p, F_args,
     ~1.5e-9 rad, deep inside the Newton basin; the polish then reaches
     f64 roundoff (validated against scipy brentq at 1e-12 by
     tests/test_aero.py's NumPy-twin comparison).
+
+    ``phi0`` (optional) supplies an externally-computed near-root initial
+    guess: the bracketing and bisection are skipped entirely and a damped
+    Newton polish runs from phi0 under ``lax.custom_root``, whose
+    implicit-function tangent (one linearization at the root) replaces
+    forward-mode propagation through the iterations — together ~6x
+    cheaper per lane.  The sweep's guided second pass exploits this with
+    guesses interpolated across neighbouring design lanes
+    (raft_tpu/sweep_fused.py); guesses are clipped away from the phi=0
+    branch discontinuity, and callers verify convergence against
+    fully-solved probe lanes.
     """
 
     def resid(phi):
@@ -221,6 +232,32 @@ def _solve_phi(theta, cl_tab, cd_tab, aoa_grid, sigma_p, F_args,
         return _induction(phi, cl, cd, sigma_p, F_args)[0]
 
     eps = 1e-6
+    if phi0 is not None:
+        # guided path: Newton polish from the supplied guess under
+        # custom_root — ONE implicit-function linearization at the root
+        # (tangent = y / dR/dphi) instead of forward-mode propagation
+        # through the polish iterations.  Measured ~6x cheaper per lane
+        # than the bracketed path below.  (custom_root does NOT pay off
+        # for the bracketed path: with the 30-iteration bisection in
+        # scope its closure conversion compiled ~4x slower.)
+        phi_init = jax.lax.stop_gradient(jnp.where(
+            phi0 >= 0.0, jnp.maximum(phi0, eps), jnp.minimum(phi0, -eps)
+        ))
+
+        def solve(f, x0):
+            df = jax.grad(f)
+            phi = x0
+            for _ in range(n_newton):
+                # damped: an interpolated guess can sit a polar-kink away
+                # from the root, where an undamped first step may overshoot
+                phi = phi - jnp.clip(f(phi) / df(phi), -0.05, 0.05)
+            return phi
+
+        def tangent_solve(g, y):
+            return y / jax.grad(g)(jnp.zeros_like(y))
+
+        return jax.lax.custom_root(resid, phi_init, solve, tangent_solve)
+
     r_lo = resid(eps)
     r_hi = resid(jnp.pi / 2)
     primary = r_lo * r_hi <= 0
@@ -254,7 +291,8 @@ def _solve_phi(theta, cl_tab, cd_tab, aoa_grid, sigma_p, F_args,
     return phi
 
 
-def rotor_evaluate(Uinf, Omega, pitch, geom, polars, env, nSector=4):
+def rotor_evaluate(Uinf, Omega, pitch, geom, polars, env, nSector=4,
+                   phi0=None, n_newton=2):
     """Steady rotor loads (CCBlade.evaluate equivalent).
 
     Parameters
@@ -265,9 +303,13 @@ def rotor_evaluate(Uinf, Omega, pitch, geom, polars, env, nSector=4):
         B, precone(rad), tilt(rad), yaw(rad), hubHt, shearExp
     polars : (aoa_grid_deg, cl[n_span,naoa], cd, cm)
     env : dict with rho, mu
+    phi0 : optional [nSector, n_span] inflow-angle initial guesses — skips
+        the bracketing/bisection per section (see :func:`_solve_phi`)
+    n_newton : Newton polish steps (raised by guided callers)
 
-    Returns dict with the hub loads T, Y, Z, Q, My, Mz, power P, and their
-    coefficients CT, CY, CZ, CQ, CMy, CMz, CP.
+    Returns dict with the hub loads T, Y, Z, Q, My, Mz, power P, their
+    coefficients CT, CY, CZ, CQ, CMy, CMz, CP, and the solved inflow
+    angles phi [nSector, n_span] (feedable back as ``phi0``).
     """
     aoa_grid, cl_tab, cd_tab, _ = polars
     r = geom["r"]
@@ -277,32 +319,41 @@ def rotor_evaluate(Uinf, Omega, pitch, geom, polars, env, nSector=4):
     sigma_p = B * chord / (2.0 * jnp.pi * r)
 
     azimuths = jnp.arange(nSector) * (2.0 * jnp.pi / nSector)
+    phi0_all = (jnp.full((nSector, r.shape[0]), jnp.nan)
+                if phi0 is None else phi0)
 
-    def one_azimuth(az):
+    def one_azimuth(az, phi0_row):
         Vx, Vy = _wind_components(
             Uinf, Omega, az, r, geom["precurve"], geom["presweep"],
             geom["precone"], geom["yaw"], geom["tilt"], geom["hubHt"],
             geom["shearExp"],
         )
 
-        def one_section(th, clt, cdt, sp, ri, ci, vx, vy):
+        def one_section(th, clt, cdt, sp, ri, ci, vx, vy, p0):
             F_args = (B, ri, geom["Rhub"], geom["Rtip"], vx, vy)
-            phi = _solve_phi(th, clt, cdt, aoa_grid, sp, F_args)
+            phi = _solve_phi(th, clt, cdt, aoa_grid, sp, F_args,
+                             phi0=None if phi0 is None else p0,
+                             n_newton=n_newton)
             alpha = phi - th
             cl = jnp.interp(alpha * _RAD2DEG, aoa_grid, clt)
             cd = jnp.interp(alpha * _RAD2DEG, aoa_grid, cdt)
-            _, a, ap, F = _induction(phi, cl, cd, sp, F_args)
+            # r_fin: the Ning residual AT the returned root — free (this
+            # _induction call is needed for the loads anyway) and the
+            # deterministic per-section convergence signal for the guided
+            # path (a guess trapped in the wrong bracket leaves |r| large)
+            r_fin, a, ap, F = _induction(phi, cl, cd, sp, F_args)
             W2 = (vx * (1 - a)) ** 2 + (vy * (1 + ap)) ** 2
             Np = (cl * jnp.cos(phi) + cd * jnp.sin(phi)) * 0.5 * env["rho"] * W2 * ci
             Tp = (cl * jnp.sin(phi) - cd * jnp.cos(phi)) * 0.5 * env["rho"] * W2 * ci
-            return Np, Tp
+            return Np, Tp, phi, jnp.abs(r_fin)
 
-        Np, Tp = jax.vmap(one_section)(
-            theta, cl_tab, cd_tab, sigma_p, r, chord, Vx, Vy
+        Np, Tp, phi, rfin = jax.vmap(one_section)(
+            theta, cl_tab, cd_tab, sigma_p, r, chord, Vx, Vy, phi0_row
         )
-        return Np, Tp
+        return Np, Tp, phi, rfin
 
-    Np_all, Tp_all = jax.vmap(one_azimuth)(azimuths)   # [nSector, n_span]
+    Np_all, Tp_all, phi_all, rfin_all = jax.vmap(one_azimuth)(
+        azimuths, phi0_all)
 
     # integrate distributed loads to the full hub force/moment vector with
     # zero-load extensions at hub and tip (CCBlade thrusttorque, extended
@@ -368,6 +419,8 @@ def rotor_evaluate(Uinf, Omega, pitch, geom, polars, env, nSector=4):
         "CY": Y / (q * A), "CZ": Z / (q * A),
         "CMy": My / (q * geom["Rtip"] * A),
         "CMz": Mz / (q * geom["Rtip"] * A),
+        "phi": phi_all,
+        "resid": jnp.max(rfin_all),
     }
 
 
@@ -491,29 +544,45 @@ class Rotor:
             polars = tuple(put_cpu(p) for p in self.polars)
             env = self.env
 
-            def loads_TQ(U, Om, pitch, tilt, yaw):
+            def loads_TQ(U, Om, pitch, tilt, yaw, phi0=None, n_newton=2):
                 g = dict(geom)
                 g["tilt"] = tilt
                 g["yaw"] = yaw
-                out = rotor_evaluate(U, Om, pitch, g, polars, env)
+                out = rotor_evaluate(U, Om, pitch, g, polars, env,
+                                     phi0=phi0, n_newton=n_newton)
                 return jnp.stack([out["T"], out["Q"], out["P"],
                                   out["CP"], out["CT"], out["CQ"],
                                   out["Y"], out["Z"], out["My"],
-                                  out["Mz"]])
+                                  out["Mz"]]), out["phi"], out["resid"]
 
             def loads_and_derivs(U, Om, pitch, tilt, yaw):
-                vals = loads_TQ(U, Om, pitch, tilt, yaw)
-                JT = jax.jacfwd(lambda a: loads_TQ(*a, tilt, yaw))(
+                vals, phi, _r = loads_TQ(U, Om, pitch, tilt, yaw)
+                JT = jax.jacfwd(lambda a: loads_TQ(*a, tilt, yaw)[0])(
                     jnp.stack([U, Om, pitch])
                 )  # [10 outputs, 3 inputs]
-                return vals, JT
+                return vals, JT, phi
+
+            def loads_and_derivs_guided(U, Om, pitch, tilt, yaw, phi0):
+                # phi0 skips bracketing/bisection; 3 damped Newton steps
+                # re-converge the exact residual (guesses interpolated
+                # across design lanes land ~1e-4 rad from the root).
+                # resid = worst per-section |Ning residual| at the
+                # returned roots — the caller's deterministic per-lane
+                # guard against a guess trapped in the wrong bracket.
+                vals, phi, resid = loads_TQ(U, Om, pitch, tilt, yaw,
+                                            phi0, 3)
+                JT = jax.jacfwd(
+                    lambda a: loads_TQ(*a, tilt, yaw, phi0, 3)[0]
+                )(jnp.stack([U, Om, pitch]))
+                return vals, JT, phi, resid
 
             cached = (
                 jax.jit(loads_and_derivs),
                 jax.jit(jax.vmap(loads_and_derivs)),
+                jax.jit(jax.vmap(loads_and_derivs_guided)),
             )
             _rotor_eval_cache[key] = cached
-        self._eval, self._eval_batch = cached
+        self._eval, self._eval_batch, self._eval_batch_guided = cached
 
     # -------------------------------------------------------------- control
 
@@ -561,7 +630,7 @@ class Rotor:
         tilt = np.deg2rad(self.shaft_tilt) + ptfm_pitch
 
         put = lambda x: put_cpu(np.float64(x))  # noqa: E731
-        vals, J = self._eval(
+        vals, J, _phi = self._eval(
             put(Uhub), put(Omega_rpm * np.pi / 30.0),
             put(np.deg2rad(pitch_deg)), put(tilt),
             put(np.deg2rad(yaw_misalign)),
@@ -585,7 +654,8 @@ class Rotor:
         )
         return loads, derivs
 
-    def run_bem_batch(self, Uhub, ptfm_pitch, yaw_misalign=None):
+    def run_bem_batch(self, Uhub, ptfm_pitch, yaw_misalign=None,
+                      phi0=None, return_phi=False, return_resid=False):
         """Batched steady loads + SI derivatives over a leading lane axis —
         the design sweep's second-pass rotor evaluation (one vmapped
         compiled CPU call instead of one serial :meth:`run_bem` per design
@@ -593,8 +663,16 @@ class Rotor:
         raft/parametersweep.py:56-100 via runRAFT -> raft_model.py:516-517).
 
         Uhub, ptfm_pitch, yaw_misalign : broadcastable arrays [nt]
-        Returns (vals [nt, 10], J [nt, 10, 3]) with the same layout as
-        :meth:`run_bem`'s stacked outputs, derivatives already SI.
+        phi0 : optional [nt, nSector, n_span] inflow-angle guesses — lanes
+            run the guided executable (no bracketing/bisection, ~6x
+            cheaper; see :func:`_solve_phi`)
+        return_phi : also return the solved phi [nt, nSector, n_span]
+        return_resid : also return the worst per-section |Ning residual|
+            at the returned roots per lane [nt] (guided path only; None
+            for the bracketed path)
+        Returns (vals [nt, 10], J [nt, 10, 3][, phi][, resid]) with the
+        same layout as :meth:`run_bem`'s stacked outputs, derivatives
+        already SI.
 
         The lane axis is padded to a multiple of 64 so sweeps of varying
         size share compiled executables (each distinct lane count would
@@ -611,19 +689,30 @@ class Rotor:
         n = Uhub.size
         nb = -(-n // 64) * 64
         pad = lambda a: np.concatenate(  # noqa: E731
-            [a, np.full(nb - n, a[-1])]
+            [a, np.repeat(a[-1:], nb - n, axis=0)]
         ) if nb > n else a
         Uhub_p, pitch_p, yaw_p = pad(Uhub), pad(ptfm_pitch), pad(yaw)
         Omega_rpm = np.interp(Uhub_p, self.Uhub, self.Omega_rpm)
         pitch_deg = np.interp(Uhub_p, self.Uhub, self.pitch_deg)
         tilt = np.deg2rad(self.shaft_tilt) + pitch_p
 
-        vals, J = self._eval_batch(
+        args = (
             put_cpu(Uhub_p), put_cpu(Omega_rpm * np.pi / 30.0),
             put_cpu(np.deg2rad(pitch_deg)), put_cpu(tilt),
             put_cpu(np.deg2rad(yaw_p)),
         )
-        return np.asarray(vals)[:n], np.asarray(J)[:n]
+        resid = None
+        if phi0 is None:
+            vals, J, phi = self._eval_batch(*args)
+        else:
+            vals, J, phi, resid = self._eval_batch_guided(
+                *args, put_cpu(pad(np.asarray(phi0, np.float64))))
+        out = [np.asarray(vals)[:n], np.asarray(J)[:n]]
+        if return_phi:
+            out.append(np.asarray(phi)[:n])
+        if return_resid:
+            out.append(None if resid is None else np.asarray(resid)[:n])
+        return tuple(out)
 
     # ---------------------------------------------------- aero-servo terms
 
