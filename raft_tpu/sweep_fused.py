@@ -51,7 +51,11 @@ from raft_tpu.geometry import pack_nodes, process_members
 from raft_tpu.hydro import added_mass_morison
 from raft_tpu.io.schema import cases_as_dicts
 from raft_tpu.model import Model, make_case_dynamics
-from raft_tpu.mooring import case_mooring_design_batch_fn, parse_mooring
+from raft_tpu.mooring import (
+    case_mooring_design_batch_fn,
+    parse_mooring,
+    warn_bridle_residual,
+)
 from raft_tpu.statics import compute_statics
 from raft_tpu.sweep import pad_and_stack_nodes
 from raft_tpu.utils.placement import put_cpu
@@ -88,6 +92,7 @@ class _DraftVariant:
 
     nodes: object            # HydroNodes (f64)
     moor: tuple              # mooring line arrays (numpy f64)
+    bridles: object          # BridleSet or None
     A_morison: np.ndarray    # [6, 6] f64
     # statics at ballast scale 0 and 1 (everything else by linearity)
     m0: float
@@ -119,15 +124,10 @@ def _prepare_draft(base_design, s, rho_water, g):
         [_scale_fill(m, 0.0) for m in members], turbine, rho_water, g
     )
     ms = parse_mooring(d["mooring"], rho_water=rho_water, g=g)
-    if ms.bridles is not None:
-        raise NotImplementedError(
-            "bridled mooring systems are not supported in the fused sweep "
-            "paths yet; use Model.analyze_cases per design"
-        )
     moor = (ms.anchors, ms.rFair, ms.L, ms.EA, ms.w, ms.Wp, ms.cb)
     A = np.asarray(_am_f64(put_cpu(nodes.astype(np.float64)), rho_water))
     v = _DraftVariant(
-        nodes=nodes, moor=moor, A_morison=A,
+        nodes=nodes, moor=moor, bridles=ms.bridles, A_morison=A,
         m0=S0.mass, m1=S1.mass,
         mCG0=S0.mass * S0.rCG_TOT, mCG1=S1.mass * S1.rCG_TOT,
         M0=S0.M_struc, M1=S1.M_struc,
@@ -138,11 +138,126 @@ def _prepare_draft(base_design, s, rho_water, g):
     return v
 
 
+_GUIDE_NODES = 8         # full-solve pitch samples per wind case
+_GUIDE_PROBES = 2        # verification lanes per wind case
+_GUIDE_RTOL = 1e-9       # probe tolerance; exceeded -> direct fallback
+
+
+def _guided_rotor_eval(rotor, U_case, yaw_case, pitch_dc):
+    """Rotor loads + derivatives over (design x wind-case) lanes, with the
+    per-section inflow-angle solves warm-started across designs.
+
+    On a single-core host the fully-bracketed BEM+jacfwd call costs
+    ~2.4 ms/lane, so 256 designs x 6 wind cases = 3.8 s — the fused
+    sweep's critical path.  Within one wind case only the platform pitch
+    varies across designs and the solved inflow angles phi vary smoothly
+    (piecewise-C1) with it, so a small number of pitch samples per case is
+    solved with the full bracketing path, every design lane's phi is
+    linearly interpolated from them, and the whole (design x case) batch
+    then runs the GUIDED executable: Newton polish of the exact residual
+    from the interpolated guess, skipping the ~34-evaluation bracketing/
+    bisection (aero._solve_phi).  The physics is exact — the same
+    residual converged to roundoff, the same jacfwd derivatives — only
+    the root-finder's starting point is informed.  Probe lanes solved
+    with BOTH paths verify the polish reconverges (loads and derivatives
+    agree to ``_GUIDE_RTOL``); a failing case falls back to the full
+    path for its lanes, so correctness is measured per run, not assumed.
+
+    U_case, yaw_case : [nwind] per-case wind speed / yaw misalignment
+    pitch_dc : [nd, nwind] platform pitch per design x case
+    Returns (vals [nd, nwind, 10], J [nd, nwind, 10, 3]).
+    """
+    nd, nwind = pitch_dc.shape
+    K, P = _GUIDE_NODES, _GUIDE_PROBES
+    if nd <= K + P + 1:
+        vals, J = rotor.run_bem_batch(
+            np.broadcast_to(U_case[None], (nd, nwind)).ravel(),
+            pitch_dc.ravel(),
+            np.broadcast_to(yaw_case[None], (nd, nwind)).ravel(),
+        )
+        return vals.reshape(nd, nwind, 10), J.reshape(nd, nwind, 10, 3)
+
+    # full-solve pitch samples per case (probes off the node grid)
+    lo = pitch_dc.min(axis=0)
+    hi = np.maximum(pitch_dc.max(axis=0), lo + 1e-6)
+    t_nodes = np.linspace(0.0, 1.0, K)
+    t_probe = np.array([0.317, 0.683])[:P]
+    t_all = np.concatenate([t_nodes, t_probe])           # [K+P]
+    batch_pitch = lo[:, None] + (hi - lo)[:, None] * t_all[None]
+    vals_n, J_n, phi_n = rotor.run_bem_batch(
+        np.repeat(U_case, K + P), batch_pitch.ravel(),
+        np.repeat(yaw_case, K + P), return_phi=True,
+    )
+    ns, nsp = phi_n.shape[-2:]
+    vals_n = vals_n.reshape(nwind, K + P, 10)
+    J_n = J_n.reshape(nwind, K + P, 10, 3)
+    phi_n = phi_n.reshape(nwind, K + P, ns, nsp)
+
+    # linear phi interpolation across the pitch axis, per case: guesses
+    # land ~1e-4 rad from the root — well inside the Newton basin
+    def interp_phi(x, j):
+        t = (x - lo[j]) / (hi[j] - lo[j])
+        i = np.clip((t * (K - 1)).astype(int), 0, K - 2)
+        f = (t * (K - 1) - i)[:, None, None]
+        return (1.0 - f) * phi_n[j, i] + f * phi_n[j, i + 1]
+
+    # guided batch: all design lanes + the probe lanes for verification
+    pitch_g = np.concatenate(
+        [pitch_dc.T.ravel(), batch_pitch[:, K:].ravel()])
+    U_g = np.concatenate(
+        [np.repeat(U_case, nd), np.repeat(U_case, P)])
+    yaw_g = np.concatenate(
+        [np.repeat(yaw_case, nd), np.repeat(yaw_case, P)])
+    phi0_g = np.concatenate([
+        np.concatenate([interp_phi(pitch_dc[:, j], j)
+                        for j in range(nwind)]),
+        np.concatenate([interp_phi(batch_pitch[j, K:], j)
+                        for j in range(nwind)]),
+    ])
+    vals_g, J_g, resid_g = rotor.run_bem_batch(
+        U_g, pitch_g, yaw_g, phi0=phi0_g, return_resid=True)
+    # .copy(): np.asarray of a jax.Array is a READ-ONLY view, and the
+    # fallback below assigns into these per failing case
+    vals = vals_g[:nd * nwind].reshape(nwind, nd, 10).copy()
+    J = J_g[:nd * nwind].reshape(nwind, nd, 10, 3).copy()
+    pv = vals_g[nd * nwind:].reshape(nwind, P, 10)
+    pj = J_g[nd * nwind:].reshape(nwind, P, 10, 3)
+    resid_l = resid_g[:nd * nwind].reshape(nwind, nd)
+
+    direct = []
+    for j in range(nwind):
+        sv = np.abs(vals_n[j]).max(axis=0) + 1e-30
+        sj = np.abs(J_n[j]).max(axis=(0,)) + 1e-30
+        err = max(
+            (np.abs(pv[j] - vals_n[j, K:]) / sv).max(),
+            (np.abs(pj[j] - J_n[j, K:]) / sj).max(),
+        )
+        # two guards, both failing CLOSED (a NaN comparison routes to the
+        # direct fallback): the probe lanes measure interpolation-guess
+        # quality at two pitches, and the per-lane post-polish Ning
+        # residual catches any single lane whose guess was trapped in the
+        # wrong bracket between probes (the polish leaves |r| large
+        # there, deterministically)
+        lane_ok = np.all(resid_l[j] <= 1e-8)
+        if not (err <= _GUIDE_RTOL and lane_ok):
+            direct.append(j)
+    if direct:
+        dd = np.array(direct)
+        v_d, J_d = rotor.run_bem_batch(
+            np.broadcast_to(U_case[dd][None], (nd, len(dd))).ravel(),
+            pitch_dc[:, dd].ravel(),
+            np.broadcast_to(yaw_case[dd][None], (nd, len(dd))).ravel(),
+        )
+        vals[dd] = v_d.reshape(nd, len(dd), 10).swapaxes(0, 1)
+        J[dd] = J_d.reshape(nd, len(dd), 10, 3).swapaxes(0, 1)
+    return vals.swapaxes(0, 1), J.swapaxes(0, 1)
+
+
 def _aero_second_pass(model0, cases, wind, pitch_mean):
     """Second-pass rotor loads + aero-servo transfer terms at each design's
-    mean platform pitch: ONE vmapped compiled CPU call over (design x
-    wind-case) lanes plus broadcast transfer-function algebra (the
-    reference re-runs CCBlade serially per sweep point,
+    mean platform pitch: phi-warm-started batched rotor evaluation (see
+    :func:`_guided_rotor_eval`) plus broadcast transfer-function algebra
+    (the reference re-runs CCBlade serially per sweep point,
     raft/raft_model.py:516-517 inside parametersweep.py:56-100's loop).
 
     pitch_mean : [nd, nc] mean platform pitch (rad) per design x case.
@@ -160,17 +275,11 @@ def _aero_second_pass(model0, cases, wind, pitch_mean):
     widx = np.where(wind > 0.0)[0]
     if len(widx) == 0 or rotor is None:
         return a, b, F0
-    nwind = len(widx)
-    U = np.broadcast_to(wind[widx][None], (nd, nwind))
     yaw = np.array(
         [float(cases[i].get("yaw_misalign", 0.0)) for i in widx]
     )
-    vals, J = rotor.run_bem_batch(
-        U.ravel(), pitch_mean[:, widx].ravel(),
-        np.broadcast_to(yaw[None], (nd, nwind)).ravel(),
-    )
-    vals = vals.reshape(nd, nwind, 10)
-    J = J.reshape(nd, nwind, 10, 3)
+    vals, J = _guided_rotor_eval(
+        rotor, wind[widx], yaw, pitch_mean[:, widx])
 
     # mean hub loads with the reference's ordering quirk [T, Y, Z, My, Q, Mz]
     # (raft/raft_rotor.py:350-351), shifted to the PRP
@@ -388,12 +497,16 @@ def run_draft_ballast_sweep(
     moor_all = tuple(
         rep(np.stack([v.moor[i] for v in variants])) for i in range(7)
     )
+    bridles_all = _stack_bridles(variants, rep)
     F0g, inv = _mean_load_case_groups(F_prp, nc)
     F0 = np.broadcast_to(F0g[None], (nd, len(F0g), 6)).copy()
     out = moor_fn(*put_cpu((F0, mass_all, V_all, rCG_all, rM_all, AWP_all))
-                  , *put_cpu(moor_all), None)
+                  , *put_cpu(moor_all),
+                  put_cpu(bridles_all) if bridles_all is not None else None)
     expand = lambda a: np.asarray(a)[:, inv].copy()  # noqa: E731
-    r6, C_moor, F_moor, T_moor, J_moor = (expand(o) for o in out)
+    r6, C_moor, F_moor, T_moor, J_moor, moor_resid = (
+        expand(o) for o in out)
+    warn_bridle_residual(moor_resid, label="design")
     t_moor = time.perf_counter() - t0
 
     # ---- aero second pass at the mean platform pitch of every design ----
@@ -470,6 +583,7 @@ def run_draft_ballast_sweep(
         "iters": iters.reshape(nD, nB, nc),
         "Xi0": r6.reshape(nD, nB, nc, 6),
         "T_moor": T_moor.reshape((nD, nB) + T_moor.shape[1:]),
+        "moor_resid": moor_resid.reshape(nD, nB, nc),
         # per-case aggregates (the omdao Max_Offset / Max_PtfmPitch view)
         "offset_max": np.hypot(surge_max, sway_max).max(axis=1).reshape(nD, nB),
         "pitch_max_deg": pitch_max.max(axis=1).reshape(nD, nB),
@@ -556,12 +670,36 @@ def _unit_fill(member):
     )
 
 
+def _stack_bridles(variants, rep=None):
+    """Stack per-variant BridleSet arrays along the design axis (order
+    matching BridleSet.arrays()) for the batched mooring solve; None when
+    the design family is unbridled.  ``rep`` optionally replicates each
+    design's arrays along a ballast axis (the draft x ballast sweep)."""
+    bs = [v.bridles for v in variants]
+    if all(b is None for b in bs):
+        return None
+    if any(b is None for b in bs):
+        raise ValueError(
+            "mixed sweep: every design must have bridles or none must "
+            "(the batched mooring solve shares one executable)"
+        )
+    fields = ("kind", "ends", "L", "EA", "w", "Wp", "cb", "Wj", "p0")
+    out = tuple(
+        np.stack([np.asarray(getattr(b, f), np.float64) for b in bs])
+        for f in fields
+    )
+    if rep is not None:
+        out = tuple(rep(a) for a in out)
+    return out
+
+
 @dataclasses.dataclass
 class _GeomVariant:
     """Host-side preprocessing of one general design point."""
 
     nodes: object
     moor: tuple
+    bridles: object            # BridleSet or None
     A_morison: np.ndarray
     S1: object                 # statics at the design's ballast densities
     S0: object = None          # fill scale 0 (for the density-trim algebra)
@@ -630,15 +768,11 @@ def _prepare_design_point(design, rho_water, g, need_trim):
     turbine = design["turbine"]
     S1 = compute_statics(members, turbine, rho_water, g)
     ms = parse_mooring(design["mooring"], rho_water=rho_water, g=g)
-    if ms.bridles is not None:
-        raise NotImplementedError(
-            "bridled mooring systems are not supported in the fused sweep "
-            "paths yet; use Model.analyze_cases per design"
-        )
     A = np.asarray(_am_f64(put_cpu(nodes.astype(np.float64)), rho_water))
     v = _GeomVariant(
         nodes=nodes,
         moor=(ms.anchors, ms.rFair, ms.L, ms.EA, ms.w, ms.Wp, ms.cb),
+        bridles=ms.bridles,
         A_morison=A, S1=S1,
     )
     if need_trim:
@@ -656,11 +790,14 @@ def _unloaded_forces_batch_fn():
     at module level like the other sweep executables)."""
     from raft_tpu.mooring import line_forces
 
-    def f(*arr):
+    def f(anchors, rFair, L, EA, w, Wp, cb, bridles=None):
         z6 = jnp.zeros(6, dtype=jnp.float64)
-        return line_forces(z6, *arr)[0]
+        return line_forces(z6, anchors, rFair, L, EA, w, Wp, cb,
+                           bridles)[0]
 
     return jax.jit(jax.vmap(f))
+
+
 
 
 def _mean_load_case_groups(F_prp, nc):
@@ -736,13 +873,15 @@ def run_design_sweep(
         np.stack([np.asarray(v.moor[i], np.float64) for v in variants])
         for i in range(7)
     )
+    bridles_all = _stack_bridles(variants)
     t_host = time.perf_counter() - t0
 
     # ---- optional closed-form ballast-density trim ----
     rho_w, grav = model0.rho_water, model0.g
     if trim_ballast_density:
         f6 = _unloaded_forces_batch_fn()(
-            *tuple(put_cpu(a) for a in moor_all))
+            *tuple(put_cpu(a) for a in moor_all),
+            put_cpu(bridles_all) if bridles_all is not None else None)
         Fz0 = np.asarray(f6)[:, 2]                          # [nd]
         m1 = np.array([v.S1.mass for v in variants])
         Vf = np.array([v.Su.mass - v.S0.mass for v in variants])
@@ -791,9 +930,12 @@ def run_design_sweep(
     F0g, inv = _mean_load_case_groups(F_prp, nc)
     F0 = np.broadcast_to(F0g[None], (nd, len(F0g), 6)).copy()
     out = moor_fn(*put_cpu((F0, mass_all, V_all, rCG_all, rM_all, AWP_all))
-                  , *put_cpu(moor_all), None)
+                  , *put_cpu(moor_all),
+                  put_cpu(bridles_all) if bridles_all is not None else None)
     expand = lambda a: np.asarray(a)[:, inv].copy()  # noqa: E731
-    r6, C_moor, F_moor, T_moor, J_moor = (expand(o) for o in out)
+    r6, C_moor, F_moor, T_moor, J_moor, moor_resid = (
+        expand(o) for o in out)
+    warn_bridle_residual(moor_resid, label="design")
     t_moor = time.perf_counter() - t0
 
     # ---- aero second pass at mean pitch ----
@@ -864,6 +1006,7 @@ def run_design_sweep(
         "Xi0": r6,
         "F_aero0": F_aero2,
         "T_moor": T_moor,
+        "moor_resid": moor_resid,
         "dynamics_flops": dyn_flops,
         "timing": {
             "host_prep_s": t_host,

@@ -241,3 +241,115 @@ def test_density_trim_zeroes_heave_imbalance():
     assert res["delta_rho"][0] == pytest.approx(delta_ref, rel=1e-6)
     m.analyze_unloaded()
     assert res["mass"][0] == pytest.approx(m.statics.mass, rel=1e-9)
+
+
+def _bridled_semi_design():
+    """demo_semi with line 1 replaced by a crow's-foot bridle (anchor leg
+    -> free junction -> two vessel legs); lines 2-3 stay plain trunk
+    lines, so the fused sweep must carry trunk AND bridle tensions."""
+    design = _base_design(n_cases=2)
+    moor = design["mooring"]
+    th = np.deg2rad(60.0)
+    c, s = np.cos(th), np.sin(th)
+    moor["points"] = [p for p in moor["points"] if p["name"] != "fair1"]
+    moor["points"] += [
+        {"name": "junc1", "type": "free", "mass": 800.0,
+         "location": [150.0 * c, 150.0 * s, -100.0]},
+        {"name": "fairA1", "type": "vessel",
+         "location": [5.2 * c - 2.0 * s, 5.2 * s + 2.0 * c, -14.0]},
+        {"name": "fairB1", "type": "vessel",
+         "location": [5.2 * c + 2.0 * s, 5.2 * s - 2.0 * c, -14.0]},
+    ]
+    moor["lines"] = [ln for ln in moor["lines"] if ln["name"] != "line1"]
+    moor["lines"] += [
+        {"name": "main1", "endA": "anchor1", "endB": "junc1",
+         "type": "chain", "length": 760.0},
+        {"name": "brA1", "endA": "junc1", "endB": "fairA1",
+         "type": "chain", "length": 150.0},
+        {"name": "brB1", "endA": "junc1", "endB": "fairB1",
+         "type": "chain", "length": 150.0},
+    ]
+    return design
+
+
+def test_bridled_design_sweep_matches_direct_model():
+    """A bridled mooring system runs the fused design sweep (round-3 gap:
+    both fused paths raised NotImplementedError) and matches the direct
+    per-design Model path, including the bridle-leg tension channels."""
+    from raft_tpu.sweep_fused import run_design_sweep
+
+    base = _bridled_semi_design()
+    d2 = copy.deepcopy(base)
+    for ln in d2["mooring"]["lines"]:
+        if ln["name"] == "main1":
+            ln["length"] = 770.0
+    designs = [base, d2]
+    res = run_design_sweep(designs, group=2, return_xi=True, verbose=False)
+    assert res["converged"].all()
+    assert (res["moor_resid"] < 1e-5).all()
+
+    for i in (0, 1):
+        m = Model(designs[i])
+        assert m.ms.bridles is not None and m.ms.n_lines == 2
+        m.analyze_unloaded()
+        args, aux = m.prepare_case_inputs(verbose=False)
+        out = jax.jit(m.case_pipeline_fn())(
+            *(jax.numpy.asarray(a) for a in args))
+        Xi_direct = (np.asarray(out[0], np.float64)
+                     + 1j * np.asarray(out[1], np.float64))
+        np.testing.assert_allclose(
+            res["Xi0"][i], aux["Xi0"], rtol=1e-6, atol=1e-10)
+        # tension channels: 2 trunk lines + 1 bridle x 3 legs (padded to
+        # K legs) at both ends, matching the Model path exactly
+        np.testing.assert_allclose(
+            res["T_moor"][i], aux["T_moor"], rtol=1e-8, atol=1e-6)
+        assert res["T_moor"][i].shape[-1] == aux["T_moor"].shape[-1]
+        np.testing.assert_allclose(
+            np.abs(res["Xi"][i]), np.abs(Xi_direct), rtol=2e-5, atol=1e-7)
+
+
+@pytest.mark.skipif(
+    not __import__("os").path.exists(VOLTURNUS),
+    reason="reference designs not mounted",
+)
+def test_guided_rotor_eval_matches_direct():
+    """The phi-warm-started rotor evaluation (sweep second pass) agrees
+    with the fully-bracketed path to roundoff — same residual, same
+    jacfwd derivatives, only the root-finder's starting point differs."""
+    from raft_tpu.io.schema import load_design
+    from raft_tpu.sweep_fused import _guided_rotor_eval
+
+    base = load_design(VOLTURNUS)
+    base["settings"] = {"min_freq": 0.05, "max_freq": 0.3}
+    m = Model(base)
+    if m.rotor is None:
+        pytest.skip("no blade data")
+    nd, nwind = 16, 2
+    U_case = np.array([10.0, 14.0])
+    yaw_case = np.zeros(2)
+    rng = np.random.default_rng(7)
+    pitch = 0.02 + 0.03 * rng.random((nd, nwind))
+    vals_g, J_g = _guided_rotor_eval(m.rotor, U_case, yaw_case, pitch)
+    v_d, J_d = m.rotor.run_bem_batch(
+        np.broadcast_to(U_case[None], (nd, nwind)).ravel(), pitch.ravel(),
+        np.broadcast_to(yaw_case[None], (nd, nwind)).ravel(),
+    )
+    v_d = v_d.reshape(nd, nwind, 10)
+    J_d = J_d.reshape(nd, nwind, 10, 3)
+    sv = np.abs(v_d).max(axis=(0, 1)) + 1e-30
+    sj = np.abs(J_d).max(axis=(0, 1)) + 1e-30
+    assert float((np.abs(vals_g - v_d) / sv).max()) < 1e-10
+    assert float((np.abs(J_g - J_d) / sj).max()) < 1e-9
+
+    # force the probe guard to fail so every case takes the direct
+    # fallback path (regression: the fallback used to assign into
+    # read-only views of jax outputs) and the result must still match
+    import raft_tpu.sweep_fused as sf
+    old = sf._GUIDE_RTOL
+    try:
+        sf._GUIDE_RTOL = -1.0
+        vals_f, J_f = _guided_rotor_eval(m.rotor, U_case, yaw_case, pitch)
+    finally:
+        sf._GUIDE_RTOL = old
+    assert float((np.abs(vals_f - v_d) / sv).max()) < 1e-12
+    assert float((np.abs(J_f - J_d) / sj).max()) < 1e-12
