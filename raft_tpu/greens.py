@@ -37,9 +37,10 @@ La = int e^{bt} J1(at) dt = (1 + b/s)/a:
     dF/db = L + F
     dF/da = -(La + F1)
 
-Finite depth is handled by the caller at the physics level (strip theory uses
-exact finite-depth kinematics; the BEM path documents its deep-water
-assumption — the reference's own verification cases are deep-water spars).
+Finite depth: :func:`finite_depth_correction` (below) adds the image-lattice
+wave-term correction for finite water depth, validated against Capytaine in
+tests/test_greens.py; strip theory separately uses exact finite-depth
+kinematics at the physics level.
 """
 
 import os
