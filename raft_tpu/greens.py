@@ -51,12 +51,20 @@ _TABLE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "data", "greens_tables.npz")
 
 # table extents: a = nu*R in [0, A_MAX] (uniform), b = nu*(z+zeta) in
-# [-B_MAX, 0] on a log grid y = log(-b), y in [Y_MIN, Y_MAX]
+# [-B_MAX, 0] on a log grid y = log(-b), y in [Y_MIN, Y_MAX].  The floor
+# reaches 1e-9 so the z = 0 irregular-frequency lid rows (b -> 0 for
+# lid-lid pairs) interpolate real table data instead of clamping at the
+# old 1e-5 floor (which carried up to ~1e-2 kernel error and a measured
+# ~0.5-1.2% valid-band bias on lidded CPU solves).  The tabulated
+# REGULARIZED remainder is smooth in y all the way down — it approaches
+# the b = 0 closed forms F(a,0) = -(pi/2)(H0+Y0) etc. with derivative
+# ~b — so the lower floor costs nothing but rows; NY keeps the per-decade
+# node density of the old grid.
 A_MAX = 100.0
 NA = 1001
 B_MAX = 40.0
-Y_MIN, Y_MAX = float(np.log(1e-5)), float(np.log(B_MAX))
-NY = 200
+Y_MIN, Y_MAX = float(np.log(1e-9)), float(np.log(B_MAX))
+NY = 320
 
 
 def _C(w):
@@ -151,12 +159,28 @@ _tables = None
 
 
 def load_tables():
-    """Load (building if needed) the F/F1 tables as float32 arrays."""
+    """Load (building if needed) the F/F1 tables as float32 arrays.
+
+    A cached file whose grid metadata disagrees with the module constants
+    (e.g. a stale npz from before the b-floor extension) is rebuilt —
+    interp_F_F1 indexes with the constants, so a silent mismatch would
+    shear the whole lookup."""
     global _tables
     if _tables is None:
-        if not os.path.exists(_TABLE_PATH):
+        if os.path.exists(_TABLE_PATH):
+            d = np.load(_TABLE_PATH)
+            ok = (
+                d["F"].shape == (NA, NY)
+                and float(d["y_min"]) == Y_MIN
+                and float(d["y_max"]) == Y_MAX
+                and float(d["a_max"]) == A_MAX
+            )
+            if not ok:
+                build_tables()
+                d = np.load(_TABLE_PATH)
+        else:
             build_tables()
-        d = np.load(_TABLE_PATH)
+            d = np.load(_TABLE_PATH)
         _tables = (d["F"], d["F1"])
     return _tables
 
@@ -171,8 +195,9 @@ def interp_F_F1(a, b, F_tab, F1_tab):
     (stationary-phase for large a; for deep b the e^b factor vanishes and
     the -1/s / -(1+b/s)/a terms are the exact leading Laplace-transform
     behavior — verified against quadrature in tests); b -> 0 clamps to the
-    log-grid floor y_min (the log-singular sliver above it is handled by the
-    caller's panel quadrature smoothing).
+    log-grid floor y_min = ln 1e-9 — deep enough that z = 0 lid rows
+    (b ~ 1e-9 after wave_term's own clamp) read real table data; the
+    singular parts are added back analytically at the true (a, b).
     """
     import jax.numpy as jnp
 

@@ -204,13 +204,16 @@ def test_irregular_frequency_removal():
     devL = abs(outL["A"][1, 0, 0] - trendL) / trendL
     assert dev0 > 0.03          # the lid-free solve shows the glitch
     assert devL < 0.005         # the lid removes it
-    # valid band: lid bias small
+    # valid band: lid bias small.  Since the table b-floor extension to
+    # 1e-9 the CPU path interpolates real kernel data on lid rows (the
+    # old 1e-5 clamp carried ~1e-2 kernel error and a ~0.5-1.2% band
+    # bias), so the CPU bound matches the TPU path's ~0.3%.
     nus_ok = np.array([0.8, 1.5])
     ws_ok = np.sqrt(nus_ok * g)
     a0 = bem_solver.solve_bem(cyl, ws_ok, rho=rho, g=g)["A"]
     aL = bem_solver.solve_bem(cyl, ws_ok, rho=rho, g=g,
                               lid_panels=lids)["A"]
-    assert np.abs(aL[:, 0, 0] - a0[:, 0, 0]).max() < 0.012 * np.abs(
+    assert np.abs(aL[:, 0, 0] - a0[:, 0, 0]).max() < 0.003 * np.abs(
         a0[:, 0, 0]).max()
-    assert np.abs(aL[:, 2, 2] - a0[:, 2, 2]).max() < 0.005 * np.abs(
+    assert np.abs(aL[:, 2, 2] - a0[:, 2, 2]).max() < 0.003 * np.abs(
         a0[:, 2, 2]).max()
