@@ -460,10 +460,19 @@ _RANKINE_CACHE_BYTES = 256 * 1024 * 1024
 # The TPU LU custom-call has a scoped-VMEM ceiling (observed on v5e:
 # clean compile failure at 2N=16k rows, runtime worker crash at 2N=5800,
 # i.e. ~2900 panels); above 1024 panels the solve switches to the blocked
-# Gauss-Jordan (_blocked_gj), which has no such ceiling.  The remaining
-# limit is HBM for the [N,N,Q] per-frequency influence assembly; above it
-# solve_bem falls back to the CPU backend with a warning.
-TPU_PANEL_LIMIT = 4096
+# Gauss-Jordan (_blocked_gj), which has no such ceiling.  The limits now:
+#  * HBM: the assembly is row-blocked (RB=32 chunks), so the live set is
+#    the [N,N] matrices — S0/K0 (f32) + S/K/lhs (c64) + the 2Nx2N real
+#    block system and its Gauss-Jordan double buffer, ~6 GB at N=8960
+#    against v5e's 16 GB — HBM would cap N around ~12k;
+#  * the axon tunnel's per-dispatch execution watchdog (~60-70 s) binds
+#    FIRST: one frequency costs ~(N/4864)^2 * 11 s on-device and cannot
+#    be subdivided across dispatches, so ~10k panels (~50 s/frequency)
+#    is the practical ceiling in this harness (measured: 8744 panels
+#    solve; a 12k-panel frequency would exceed the watchdog).  solve_bem
+#    already chunks multi-frequency requests to stay under it.
+# Above the limit solve_bem falls back to the CPU backend with a warning.
+TPU_PANEL_LIMIT = 10240
 
 
 # lid-row jump coefficient of the extended integral equation: the
@@ -608,14 +617,47 @@ def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81,
         np.asarray(a, np.float32), backend_sharding(backend))
     tables = jax.tree.map(put, tables)
 
-    call_args = (
-        put(omegas), put(betas), put(pa.cen), put(pa.nrm), put(pa.area),
-        put(pa_wave.qpts), put(pa_wave.qwts), put(S0), put(K0), put(vmodes),
-        put(jump), tables, float(g), float(rho), real_block,
+    # frequency-independent arrays transfer ONCE (S0/K0 alone are ~94 MB
+    # each at N=4858 — re-putting them per chunk would multiply tunnel
+    # traffic by the chunk count)
+    static_pre = (
+        put(betas), put(pa.cen), put(pa.nrm), put(pa.area),
+        put(pa_wave.qpts), put(pa_wave.qwts), put(S0), put(K0),
+        put(vmodes), put(jump), tables, float(g), float(rho), real_block,
         put(depth if np.isfinite(depth) else 0.0), put(kmax_geom),
         bool(np.isfinite(depth)),
     )
-    A, B, Xr, Xi = _solve_all_jit(*call_args)
+
+    def call_args(om):
+        return (put(om),) + static_pre
+
+    # Large TPU meshes: keep each dispatch under the tunnel worker's
+    # execution watchdog.  At N=4864 one frequency runs ~10.6 s hot
+    # on-device; an 8-frequency lax.map in a single dispatch (~85 s)
+    # reproducibly crashes the axon worker where 6 survives, with ample
+    # HBM headroom — the wall is dispatch TIME, not memory.  Host-side
+    # frequency chunks reuse ONE compiled executable (the last chunk is
+    # padded by repeating its final frequency so every dispatch keeps the
+    # same shape) at ~0.1 s dispatch overhead per chunk — negligible
+    # against the ~10 s/frequency compute.
+    chunk = len(omegas)
+    if real_block and pa.n > 2048:
+        per_freq_s = (pa.n / 4864.0) ** 2 * 11.0
+        chunk = max(1, min(len(omegas), int(45.0 / max(per_freq_s, 1e-9))))
+    if chunk >= len(omegas):
+        A, B, Xr, Xi = _solve_all_jit(*call_args(omegas))
+    else:
+        nw_all = len(omegas)
+        parts = []
+        for i in range(0, nw_all, chunk):
+            om = omegas[i:i + chunk]
+            if len(om) < chunk:        # repeat-pad: same compiled shape
+                om = np.concatenate([om, np.full(chunk - len(om), om[-1])])
+            parts.append(_solve_all_jit(*call_args(om)))
+        A, B, Xr, Xi = (
+            np.concatenate([np.asarray(p[j]) for p in parts])[:nw_all]
+            for j in range(4)
+        )
     out = {
         "w": np.asarray(omegas, float),
         "A": np.asarray(A, np.float64),
@@ -628,7 +670,12 @@ def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81,
     if report_cost:
         from raft_tpu.utils.profiling import compiled_flops
 
-        out["flops"] = compiled_flops(_solve_all_jit, call_args)
+        # lower the shape that actually executed (the per-chunk shape when
+        # chunking; flops scale linearly in frequencies either way)
+        nrep = min(chunk, len(omegas))
+        out["flops"] = compiled_flops(
+            _solve_all_jit, call_args(omegas[:nrep])
+        ) * (len(omegas) / nrep)
     return out
 
 

@@ -163,7 +163,8 @@ def test_volturnus_native_bem_mixed_geometry():
     """Native panel solver on the full VolturnUS-S hull (potModMaster=2):
     three circular columns + rectangular pontoons in one mesh — physically
     sane coefficients (surge added mass of order rho*V, vanishing
-    low-frequency damping, finite excitation)."""
+    low-frequency damping, finite excitation).  Quick smoke bounds; the
+    quantitative anchor is test_volturnus_full_hull_mesh_convergence."""
     d = load_design(os.path.join(DESIGNS, "VolturnUS-S.yaml"))
     d["turbine"]["aeroServoMod"] = 0
     d["platform"]["potModMaster"] = 2
@@ -176,6 +177,63 @@ def test_volturnus_native_bem_mixed_geometry():
     # radiation damping vanishes toward w -> 0 and is positive mid-band
     assert abs(coeffs.B[0, 0, 0]) < 1e-3 * coeffs.B[1, 0, 0]
     assert coeffs.B[1, 0, 0] > 0
+
+
+def test_volturnus_full_hull_mesh_convergence():
+    """Quantitative mesh-convergence anchor for the flagship VolturnUS-S
+    full-hull potential-flow solve (round-2/3 carryover: replaces the
+    order-of-magnitude rho*V bounds with a measured bound, the analogue
+    of the reference's WAMIT-file verification for its hulls, reference
+    tests/verification.py:240-271; no published IEA-15MW potential-flow
+    tables ship with the reference mirror, so the anchor is Richardson-
+    style refinement of our own solve).
+
+    Study (recorded in docs/parity.md): 4 meshes, 884/1482/3170/4858
+    panels (dz=da 4.0/2.8/2.0/1.5), 8 frequencies across the wave band,
+    lid-free, 200 m depth.  Pitch/roll added mass converges cleanly
+    (successive diffs 4.1% -> 2.6% -> 0.2%, p ~ 1.6); surge/heave carry
+    a +-2.4% waterline-row layout scatter between refinements (backends
+    agree to <=8e-4 on identical meshes, so the scatter is the mesh,
+    not the solver).  This test re-solves the two finest meshes on the
+    TPU — exercising the >4096-panel blocked-GJ path and the dispatch
+    watchdog chunking — and asserts every A diagonal within 5% and
+    significant B entries within 10% between them at all 8 frequencies.
+    """
+    import jax
+
+    if jax.default_backend() == "cpu":
+        # the suite's conftest forces the CPU platform (virtual 8-device
+        # mesh); this anchor runs standalone against the real TPU, and
+        # bench.py records the same two-mesh study in BENCH_r{N}.json on
+        # every driver run
+        pytest.skip("needs the TPU backend (CPU pair runs ~30 min)")
+    from raft_tpu.bem_solver import solve_bem
+    from raft_tpu.mesh import mesh_platform
+
+    d = load_design(os.path.join(DESIGNS, "VolturnUS-S.yaml"))
+    d["turbine"]["aeroServoMod"] = 0
+    d["platform"]["potModMaster"] = 2
+    m = Model(d)
+    mem = [mm for mm in m.members if mm.potMod]
+    w = np.linspace(0.25, 0.9, 8)
+    out = {}
+    for tag, sz in (("fine", 2.0), ("xfine", 1.5)):
+        pans = mesh_platform(mem, dz_max=sz, da_max=sz)
+        out[tag] = solve_bem(pans, w, rho=m.rho_water, g=m.g,
+                             backend="tpu", depth=m.depth)
+    Af, Ax = out["fine"]["A"], out["xfine"]["A"]
+    assert out["xfine"]["npanels"] > 4096       # past the old TPU limit
+    for dof in range(5):
+        rel = np.abs(Af[:, dof, dof] - Ax[:, dof, dof]) / np.abs(
+            Ax[:, dof, dof])
+        assert rel.max() < 0.05, (dof, rel)
+    Bf, Bx = out["fine"]["B"], out["xfine"]["B"]
+    for dof in (0, 2, 4):
+        sc = np.abs(Bx[:, dof, dof]).max()
+        sig = np.abs(Bx[:, dof, dof]) > 0.05 * sc
+        rel = np.abs(Bf[:, dof, dof] - Bx[:, dof, dof])[sig] / np.abs(
+            Bx[:, dof, dof])[sig]
+        assert rel.max() < 0.10, (dof, rel)
 
 
 def test_volturnus_aero_servo_case():
