@@ -272,53 +272,33 @@ def bench_bem(nw=8, nw_large=4):
             np.abs(out_dev_l["A"] - out_cpu_l["A"]).max()
             / np.abs(out_cpu_l["A"]).max()
         )
-        res.update(_bench_bem_converge())
+        res.update(_bench_bem_converge(backend))
     return res
 
 
-def _bench_bem_converge():
-    """Flagship full-hull mesh-convergence anchor on the real TPU
-    (tests/test_reference_designs.py::test_volturnus_full_hull_mesh_
-    convergence is the same study; the suite's conftest forces CPU, so
-    the driver-run bench records the measured numbers): the two finest
-    VolturnUS-S meshes (3170 / 4858 panels — the latter past the old
-    4096-panel TPU ceiling, dispatched in watchdog-sized frequency
-    chunks), 8 frequencies, every A diagonal within 5%."""
+def _bench_bem_converge(backend):
+    """Flagship full-hull mesh-convergence anchor on the accelerator
+    (the same study as tests/test_reference_designs.py::
+    test_volturnus_full_hull_mesh_convergence, via the shared
+    raft_tpu.validate.full_hull_convergence helper; the suite's conftest
+    forces CPU, so the driver-run bench records the measured numbers):
+    the two finest VolturnUS-S meshes (3170 / 4858 panels — the latter
+    past the old 4096-panel TPU ceiling, dispatched in watchdog-sized
+    frequency chunks), 8 frequencies, every A diagonal within 5%."""
     import os
 
-    from raft_tpu.bem_solver import solve_bem
-    from raft_tpu.io.schema import load_design
-    from raft_tpu.mesh import mesh_platform
-    from raft_tpu.model import Model
+    from raft_tpu.validate import full_hull_convergence
 
     path = "/root/reference/designs/VolturnUS-S.yaml"
     if not os.path.exists(path):
         return {}
-    d = load_design(path)
-    d["turbine"]["aeroServoMod"] = 0
-    d["platform"]["potModMaster"] = 2
-    m = Model(d)
-    mem = [mm for mm in m.members if mm.potMod]
-    w = np.linspace(0.25, 0.9, 8)
-    sols = {}
-    t = {}
-    for tag, sz in (("fine", 2.0), ("xfine", 1.5)):
-        panels = mesh_platform(mem, dz_max=sz, da_max=sz)
-        t0 = time.perf_counter()
-        sols[tag] = solve_bem(panels, w, rho=m.rho_water, g=m.g,
-                              backend="tpu", depth=m.depth)
-        t[tag] = time.perf_counter() - t0
-    Af, Ax = sols["fine"]["A"], sols["xfine"]["A"]
-    rel = [
-        float(np.max(np.abs(Af[:, i, i] - Ax[:, i, i])
-                     / np.abs(Ax[:, i, i])))
-        for i in range(5)
-    ]
+    t0 = time.perf_counter()
+    sols, rel = full_hull_convergence(path, backend=backend)
     return {
         "bem_conv_panels": [sols["fine"]["npanels"],
                             sols["xfine"]["npanels"]],
         "bem_conv_nw": 8,
-        "bem_conv_s": [round(t["fine"], 1), round(t["xfine"], 1)],
+        "bem_conv_s": round(time.perf_counter() - t0, 1),
         "bem_conv_A_rel_max_by_dof": [round(r, 4) for r in rel],
         "bem_conv_A_within_5pct": bool(max(rel) < 0.05),
     }

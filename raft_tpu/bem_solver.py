@@ -545,8 +545,10 @@ def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81,
         from raft_tpu.utils.profiling import logger
 
         logger.warning(
-            "solve_bem: %d panels exceeds the TPU backend's %d-panel LU "
-            "limit; solving on CPU instead",
+            "solve_bem: %d panels exceeds the TPU backend's %d-panel "
+            "ceiling (the tunnel's per-dispatch watchdog bounds one "
+            "frequency's assembly+solve time; see TPU_PANEL_LIMIT); "
+            "solving on CPU instead",
             pa.n, TPU_PANEL_LIMIT,
         )
         backend = "cpu"
@@ -640,10 +642,14 @@ def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81,
     # padded by repeating its final frequency so every dispatch keeps the
     # same shape) at ~0.1 s dispatch overhead per chunk — negligible
     # against the ~10 s/frequency compute.
+    # gate on ESTIMATED TOTAL DISPATCH TIME, not mesh size alone: many
+    # frequencies on a moderate mesh run over the watchdog just as surely
+    # as few frequencies on a huge one
     chunk = len(omegas)
-    if real_block and pa.n > 2048:
-        per_freq_s = (pa.n / 4864.0) ** 2 * 11.0
-        chunk = max(1, min(len(omegas), int(45.0 / max(per_freq_s, 1e-9))))
+    if real_block:
+        per_freq_s = max((pa.n / 4864.0) ** 2 * 11.0, 1e-3)
+        if len(omegas) * per_freq_s > 45.0:
+            chunk = max(1, min(len(omegas), int(45.0 / per_freq_s)))
     if chunk >= len(omegas):
         A, B, Xr, Xi = _solve_all_jit(*call_args(omegas))
     else:

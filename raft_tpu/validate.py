@@ -160,3 +160,42 @@ def checked_pipeline(model):
         return out
 
     return run
+
+
+def full_hull_convergence(design_path, backend="tpu", sizes=(2.0, 1.5),
+                          nw=8, w_lo=0.25, w_hi=0.9):
+    """Two-mesh potential-flow convergence study of a full hull — the
+    flagship VolturnUS-S verification anchor (no published IEA-15MW
+    potential-flow tables ship with the reference mirror, so the solve is
+    anchored by refinement; study recorded in docs/parity.md).  Shared by
+    tests/test_reference_designs.py::test_volturnus_full_hull_mesh_convergence
+    and bench.py's ``bem_conv_*`` block so the two cannot drift apart.
+
+    Returns (sols, rel_A) — the two solve dicts keyed "fine"/"xfine" and
+    the per-DOF max relative A-diagonal difference [6].
+    """
+    import numpy as np
+
+    from raft_tpu.bem_solver import solve_bem
+    from raft_tpu.io.schema import load_design
+    from raft_tpu.mesh import mesh_platform
+    from raft_tpu.model import Model
+
+    d = load_design(design_path)
+    d["turbine"]["aeroServoMod"] = 0
+    d["platform"]["potModMaster"] = 2
+    m = Model(d)
+    mem = [mm for mm in m.members if mm.potMod]
+    w = np.linspace(w_lo, w_hi, nw)
+    sols = {}
+    for tag, sz in zip(("fine", "xfine"), sizes):
+        panels = mesh_platform(mem, dz_max=sz, da_max=sz)
+        sols[tag] = solve_bem(panels, w, rho=m.rho_water, g=m.g,
+                              backend=backend, depth=m.depth)
+    Af, Ax = sols["fine"]["A"], sols["xfine"]["A"]
+    rel_A = [
+        float(np.max(np.abs(Af[:, i, i] - Ax[:, i, i])
+                     / np.abs(Ax[:, i, i])))
+        for i in range(6)
+    ]
+    return sols, rel_A

@@ -97,11 +97,18 @@ def test_oc4semi_native_bem_vs_marin_wamit():
     reference tests/verification.py:240-254): multi-column geometry with
     tapered base columns, honoring the design's own per-member potMod
     flags.  Measured agreement: added mass <= 3.0% (surge/heave/roll),
-    surge damping <= 2.1% where it is significant; asserted at 3.5% / 10%
-    (round-1 verdict target <=3%/<=10%).  The residual ~3% is
-    mesh-converged (dz 3->2 m changes A22 by <0.4% and not toward the
-    data): the design's potMod flags panel only the 4 columns while the
-    MARIN coefficients include the 16 cross braces."""
+    surge damping <= 9.4% where significant; asserted at 3.25% / 10%.
+
+    The round-3 hypothesis that the residual ~3% comes from the MARIN
+    data including the 16 cross braces the potMod flags exclude was
+    TESTED and FALSIFIED (round 4): paneling every submerged brace/
+    pontoon member (potMod forced True, same mesh density) moves surge
+    added mass AWAY from the data (+2.9% -> +5.3%; interpenetrating
+    slender members through the columns over-count displaced fluid) and
+    leaves the ~-3% heave residual unchanged (the near-vertical braces
+    contribute negligible heave).  The residual is therefore a
+    method/data-provenance floor (mesh-converged: dz 3->2 m changes A22
+    by <0.4% and not toward the data), not missing brace panels."""
     if not os.path.exists(MARIN1):
         pytest.skip("marin_semi.1 not mounted")
     from raft_tpu.bem import read_wamit_1
@@ -117,7 +124,7 @@ def test_oc4semi_native_bem_vs_marin_wamit():
         i = int(np.argmin(np.abs(w_ref - wv)))
         for dof in (0, 2, 4):
             ref = A_ref[i, dof, dof]
-            assert abs(coeffs.A[k, dof, dof] - ref) / abs(ref) < 0.035, (
+            assert abs(coeffs.A[k, dof, dof] - ref) / abs(ref) < 0.0325, (
                 f"A{dof}{dof} at w={wv:.2f}"
             )
         refB = B_ref[i, 0, 0]
@@ -207,26 +214,14 @@ def test_volturnus_full_hull_mesh_convergence():
         # bench.py records the same two-mesh study in BENCH_r{N}.json on
         # every driver run
         pytest.skip("needs the TPU backend (CPU pair runs ~30 min)")
-    from raft_tpu.bem_solver import solve_bem
-    from raft_tpu.mesh import mesh_platform
+    from raft_tpu.validate import full_hull_convergence
 
-    d = load_design(os.path.join(DESIGNS, "VolturnUS-S.yaml"))
-    d["turbine"]["aeroServoMod"] = 0
-    d["platform"]["potModMaster"] = 2
-    m = Model(d)
-    mem = [mm for mm in m.members if mm.potMod]
-    w = np.linspace(0.25, 0.9, 8)
-    out = {}
-    for tag, sz in (("fine", 2.0), ("xfine", 1.5)):
-        pans = mesh_platform(mem, dz_max=sz, da_max=sz)
-        out[tag] = solve_bem(pans, w, rho=m.rho_water, g=m.g,
-                             backend="tpu", depth=m.depth)
-    Af, Ax = out["fine"]["A"], out["xfine"]["A"]
+    out, rel_A = full_hull_convergence(
+        os.path.join(DESIGNS, "VolturnUS-S.yaml"),
+        backend=jax.default_backend())
     assert out["xfine"]["npanels"] > 4096       # past the old TPU limit
-    for dof in range(5):
-        rel = np.abs(Af[:, dof, dof] - Ax[:, dof, dof]) / np.abs(
-            Ax[:, dof, dof])
-        assert rel.max() < 0.05, (dof, rel)
+    # every A diagonal (incl. yaw) within 5% between the two finest meshes
+    assert max(rel_A) < 0.05, rel_A
     Bf, Bx = out["fine"]["B"], out["xfine"]["B"]
     for dof in (0, 2, 4):
         sc = np.abs(Bx[:, dof, dof]).max()
