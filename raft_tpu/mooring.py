@@ -547,7 +547,41 @@ def catenary_solve(XF, ZF, L, EA, w, Wp=None, cb=0.0, iters=60,
         resid, jnp.stack([jnp.log(H0), jnp.log(jnp.maximum(V0, 1.0))]),
         solve, tangent_solve
     )
-    return jnp.exp(p[0]), jnp.exp(p[1])
+    HF, VF = jnp.exp(p[0]), jnp.exp(p[1])
+    if seabed:
+        # fully-slack regime: with more unstretched line than the vertical
+        # drop plus the horizontal span (L > XF + ZF), the physical
+        # profile is a vertical hang of length ZF with the excess lying
+        # on the seabed — H = 0 exactly and V = the hanging weight
+        # (MoorPy's zero-horizontal-tension profile).  The touchdown
+        # equations have no positive-H root there; the Newton bottoms out
+        # at H -> 0 with V indeterminate between the true hanging weight
+        # and the full suspended weight, so the closed form replaces it.
+        # The branches meet continuously at L = XF + ZF (both give
+        # H -> 0, V -> hanging weight); elastic stretch of the hanging
+        # part (~V/EA) is neglected, consistent with the quasi-static
+        # seabed treatment.
+        # relative margin 2e-4: just BELOW the boundary the log-H Newton
+        # passes through a NaN-producing sliver (measured ~8e-5 wide in
+        # relative L on the reference chain) before it converges to the
+        # tiny-but-finite H regime; inside the margin the closed form's
+        # H = 0 differs from the true H by < 1e-4 of V.  A residual
+        # non-finite Newton escape (geometry-dependent sliver width)
+        # falls back to the closed form as well — no NaN leaves the
+        # touchdown solver for ZF >= 0 geometries.
+        near = (ZF >= 0.0) & (L_tot >= (XF + ZF) * (1.0 - 2e-4))
+        # the NaN escape only covers SLACK-side geometries (more line than
+        # the chord): a taut line whose Newton diverged must keep its NaN
+        # (detectable) rather than silently report zero tension
+        bad = (ZF >= 0.0) & (L_tot >= d) & (
+            ~jnp.isfinite(HF) | ~jnp.isfinite(VF))
+        fully_slack = near | bad
+        above = jnp.sum(L) - jnp.cumsum(L)   # line length above each seg
+        hang = jnp.clip(ZF - above, 0.0, L)  # hanging part per segment
+        V_hang = jnp.sum(w * hang) + jnp.sum(jnp.where(above < ZF, Wp, 0.0))
+        HF = jnp.where(fully_slack, 0.0, HF)
+        VF = jnp.where(fully_slack, V_hang, VF)
+    return HF, VF
 
 
 # ---------------- bridle junctions ----------------
@@ -859,7 +893,15 @@ def solve_equilibrium(
         i, r6, _ = state
         F = total_force(r6)
         J = jac(r6)
-        dx = jnp.linalg.solve(J, -F)
+        # tiny Tikhonov damping: an all-slack mooring (every line in the
+        # H = 0 closed-form regime) has EXACTLY zero horizontal stiffness
+        # — a physically neutral equilibrium whose Jacobian is singular.
+        # The corresponding force components are also zero there, so the
+        # damped solve correctly returns a zero step in the neutral
+        # directions while perturbing well-conditioned systems at the
+        # 1e-8 relative level.
+        lam = 1e-8 * jnp.max(jnp.abs(jnp.diag(J))) + 1e-30
+        dx = jnp.linalg.solve(J + lam * jnp.eye(6, dtype=J.dtype), -F)
         dx = jnp.clip(dx, -step_cap, step_cap)
         return i + 1, r6 + dx, jnp.max(jnp.abs(dx))
 

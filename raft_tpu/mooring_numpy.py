@@ -136,7 +136,20 @@ def catenary_solve_np(XF, ZF, L, EA, w, Wp=None, tol=1e-10, max_iter=60,
         dv = np.clip(dv, -1.5, 1.5)
         u -= du
         s -= dv
-    return np.exp(u), np.exp(s)
+    H, V = np.exp(u), np.exp(s)
+    if seabed and ZF >= 0.0 and (
+            L_tot >= (XF + ZF) * (1.0 - 2e-4)
+            or (L_tot >= d
+                and not (np.isfinite(H) and np.isfinite(V)))):
+        # fully-slack regime (twin of mooring.catenary_solve): vertical
+        # hang of length ZF, excess line on the seabed — H = 0 exactly,
+        # V = hanging weight (the touchdown equations have no positive-H
+        # root here and the Newton bottoms out with V indeterminate)
+        above = np.sum(L) - np.cumsum(L)
+        hang = np.clip(ZF - above, 0.0, L)
+        H = 0.0
+        V = float(np.sum(w * hang) + np.sum(Wp[above < ZF]))
+    return H, V
 
 
 def _rotmat(r4, r5, r6):
@@ -222,7 +235,12 @@ def solve_equilibrium_np(
             e = np.zeros(6)
             e[j] = h[j]
             J[:, j] = (total(r6 + e) - total(r6 - e)) / (2 * h[j])
-        dx = np.linalg.solve(J, -F)
+        # tiny Tikhonov damping (twin of mooring.solve_equilibrium): an
+        # all-slack mooring has exactly zero horizontal stiffness AND
+        # zero horizontal force — the damped solve returns a zero step
+        # in the neutral directions instead of raising on singularity
+        lam = 1e-8 * np.max(np.abs(np.diag(J))) + 1e-30
+        dx = np.linalg.solve(J + lam * np.eye(6), -F)
         dx = np.clip(dx, -step_cap, step_cap)
         r6 = r6 + dx
         if np.max(np.abs(dx)) < tol:

@@ -97,6 +97,19 @@ def line_profile(anchor, fairlead, HF, VF, L, EA, w, n=40, touchdown=True):
     u = dxy / XF
     s = np.linspace(0.0, L, n)
     VA = VF - w * L
+    if HF <= 0.0 and touchdown:
+        # fully-slack closed form (catenary_solve's H = 0 regime): the
+        # line runs along the seabed then hangs vertically below the
+        # fairlead — the catenary expressions divide by HF
+        ZF = fairlead[2] - anchor[2]
+        LB = max(L - max(ZF, 0.0), 0.0)
+        x = np.minimum(s, LB) / max(LB, 1e-9) * XF
+        z = np.maximum(s - LB, 0.0)
+        pts = np.zeros((n, 3))
+        pts[:, 0] = anchor[0] + u[0] * x
+        pts[:, 1] = anchor[1] + u[1] * x
+        pts[:, 2] = anchor[2] + z
+        return pts
     if VA >= 0 or not touchdown:  # suspended (incl. sagging segments)
         Vs = VA + w * s
         x = HF / w * (np.arcsinh(Vs / HF) - np.arcsinh(VA / HF)) + HF * s / EA

@@ -27,9 +27,12 @@ def test_catenary_matches_jax():
         # where a linear-V Newton converges to a spurious negative-V root:
         # H=203 kN, V=-733 kN satisfies the touchdown equations to 1e-10
         # but is unphysical; log-V iteration finds H=86 kN, V=+638 kN)
-        (660.0, 186.0, 835.0, 7.5e8, 3000.0),   # deep touchdown (H=8.4 kN;
-        # XF <~ 650 enters the fully-slack regime where H underflows and V
-        # is indeterminate up to seabed-pile accounting — don't test there)
+        (660.0, 186.0, 835.0, 7.5e8, 3000.0),   # deep touchdown (H=8.4 kN)
+        (600.0, 186.0, 835.0, 7.5e8, 3000.0),   # fully slack: L > XF+ZF,
+        # closed-form zero-H profile (H=0, V = hanging weight w*ZF)
+        (600.0, 186.0, 786.0, 7.5e8, 3000.0),   # exactly AT the slack
+        # boundary L = XF+ZF: the closed form must engage (the Newton
+        # branch NaNs in a ~1e-2-wide sliver around it)
         (760.0, 150.0, 837.6, 7.54e8, 1853.0),  # VolturnUS-S-like geometry
         (50.0, 300.0, 320.0, 5.0e8, 2000.0),    # steep
     ]:
@@ -40,6 +43,9 @@ def test_catenary_matches_jax():
         )
         assert float(H_j) == pytest.approx(H_np, rel=1e-7)
         assert float(V_j) == pytest.approx(V_np, rel=1e-7)
+        if L >= (XF + ZF) * (1.0 - 1e-6):   # fully slack closed form
+            assert H_np == 0.0 and float(H_j) == 0.0
+            assert V_np == pytest.approx(w * ZF, rel=1e-12)
 
 
 def test_case_mooring_matches_jax():
