@@ -104,6 +104,9 @@ except ImportError:  # pragma: no cover - exercised when openmdao installed
             )
             return self._outputs
 
+        def declare_partials(self, of, wrt, method="exact"):
+            pass
+
         def initialize(self):
             pass
 
@@ -116,6 +119,22 @@ _STAT_CHANNELS = [
     "AxRNA", "Mbase", "omega", "torque", "power", "bPitch", "Tmoor",
 ]
 _STATS = ["avg", "std", "max", "PSD", "DEL"]
+
+# differentiable design-scale inputs (modeling option ``derivatives``) and
+# the aggregate outputs they carry exact partials for, mapped onto the
+# traced parametric pipeline's parameter/metric names
+_SCALE_INPUTS = {
+    "design_scale_draft": "draft",
+    "design_scale_ballast": "ballast",
+    "design_scale_col_diam": "col_diam",
+    "design_scale_line_length": "line_length",
+}
+_PARTIAL_OUTPUTS = {
+    # the WEIS optimization constraints (omdao compute aggregates)
+    "Max_PtfmPitch": "pitch_max_deg",
+    "Max_Offset": "offset_max",
+    "max_tower_base": "Mbase_max",
+}
 
 _PROPERTY_OUTPUTS = [
     # (name, shape factory, units)  — shapes use closures over option counts
@@ -386,6 +405,21 @@ class RAFT_OMDAO(_ComponentBase):
         self.add_output("platform_displacement", 0.0, units="m**3")
         self.add_output("platform_mass", 0.0, units="kg")
         self.add_output("platform_I_total", np.zeros(6), units="kg*m**2")
+
+        # ---- differentiable design-scale inputs (beyond the reference:
+        # the reference component declares NO partials anywhere, so WEIS
+        # finite-differences around it, reference raft/omdao_raft.py).
+        # With modeling option ``derivatives`` on, four multiplicative
+        # design-trim variables are exposed and the aggregate response
+        # outputs get EXACT partials from the traced parametric pipeline
+        # (raft_tpu/parametric.py, jax.jacfwd end to end).
+        if modeling_opt.get("derivatives"):
+            for p in _SCALE_INPUTS:
+                self.add_input(p, val=1.0)
+            self.declare_partials(
+                list(_PARTIAL_OUTPUTS), list(_SCALE_INPUTS),
+                method="exact")
+        self._param_fn_cache = {}
 
         self.i_design = 0
         if modeling_opt.get("save_designs"):
@@ -673,12 +707,23 @@ class RAFT_OMDAO(_ComponentBase):
         return design, np.array(case_mask)
 
     # ----------------------------------------------------------- compute
+    def _scale_theta(self, inputs):
+        """Current design-scale vector from the derivative inputs."""
+        return np.array([
+            float(np.asarray(inputs[p]).reshape(-1)[0])
+            for p in _SCALE_INPUTS
+        ])
+
     def compute(self, inputs, outputs, discrete_inputs, discrete_outputs):
         from raft_tpu.model import Model
 
         modeling_opt = self.options["modeling_options"]
         analysis_options = self.options["analysis_options"]
         design, case_mask = self._rebuild_design(inputs, discrete_inputs)
+        if modeling_opt.get("derivatives"):
+            from raft_tpu.parametric import apply_design_scales
+
+            design = apply_design_scales(design, self._scale_theta(inputs))
 
         if modeling_opt.get("save_designs"):
             path = os.path.join(
@@ -751,3 +796,46 @@ class RAFT_OMDAO(_ComponentBase):
             outputs["properties_yaw inertia at subCG"][0],
         ]
         self._last_model = model
+
+    # --------------------------------------------------------- derivatives
+    def compute_partials(self, inputs, partials, discrete_inputs=None):
+        """Exact partials of the aggregate response outputs w.r.t. the
+        design-scale inputs, by jax.jacfwd through the traced parametric
+        pipeline (raft_tpu/parametric.py) — no finite differencing
+        anywhere.  The reference component has no compute_partials at all
+        (reference raft/omdao_raft.py), so WEIS wraps it in FD; here an
+        optimizer can consume analytic design gradients.
+
+        Requires modeling option ``derivatives``; only the
+        (_PARTIAL_OUTPUTS x _SCALE_INPUTS) block is exact — every other
+        partial remains undeclared, exactly like the reference.
+        """
+        import pickle as _pickle
+
+        import jax
+
+        from raft_tpu.parametric import PARAM_NAMES, build_design_response
+
+        if not self.options["modeling_options"].get("derivatives"):
+            raise RuntimeError(
+                "compute_partials needs modeling option 'derivatives'")
+        if discrete_inputs is None:
+            discrete_inputs = self._discrete_inputs \
+                if hasattr(self, "_discrete_inputs") else {}
+        design, _mask = self._rebuild_design(inputs, discrete_inputs)
+        key = hash(_pickle.dumps(
+            design, protocol=_pickle.HIGHEST_PROTOCOL))
+        hit = self._param_fn_cache.get(key)
+        if hit is None:
+            f, _theta0 = build_design_response(
+                design, metrics=tuple(_PARTIAL_OUTPUTS.values()))
+            hit = jax.jit(jax.jacfwd(f))
+            self._param_fn_cache = {key: hit}   # one design topology live
+        theta = jax.device_put(
+            self._scale_theta(inputs), jax.devices("cpu")[0])
+        J = hit(theta)
+        for out_name, metric in _PARTIAL_OUTPUTS.items():
+            row = np.asarray(J[metric])
+            for in_name, pname in _SCALE_INPUTS.items():
+                partials[out_name, in_name] = row[
+                    PARAM_NAMES.index(pname)]

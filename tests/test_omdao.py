@@ -45,7 +45,7 @@ def _member_options(design):
     }
 
 
-def _build_component(design):
+def _build_component(design, derivatives=False):
     members = design["platform"]["members"]
     moor = design["mooring"]
     comp = RAFT_OMDAO()
@@ -58,6 +58,7 @@ def _build_component(design):
         "potential_model_override": 0, "dls_max": 5.0,
         "aeroServoMod": 0, "save_designs": False,
         "trim_ballast": 0, "heave_tol": 1.0,
+        "derivatives": derivatives,
     }
     comp.options["turbine_options"] = {
         "npts": 2, "PC_GS_n": 2, "n_span": 4, "n_aoa": 6, "n_Re": 1,

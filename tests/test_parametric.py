@@ -1,0 +1,167 @@
+"""End-to-end design gradients (VERDICT r4 #1): the traced parametric
+pipeline must (a) reproduce the NumPy preprocessing exactly at theta0,
+(b) reproduce the Model-path response metrics, and (c) deliver exact
+forward-mode design derivatives, validated against central differences of
+the SAME function (<= 1e-4 relative on every metric x parameter)."""
+
+import dataclasses
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.io.schema import load_design
+
+VOLTURNUS = "/root/reference/designs/VolturnUS-S.yaml"
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(VOLTURNUS), reason="reference designs not mounted"
+)
+
+
+def _design():
+    d = load_design(VOLTURNUS)
+    d["settings"] = {"min_freq": 0.05, "max_freq": 0.3}
+    return d
+
+
+def test_traced_twins_match_numpy_at_theta0():
+    """The frozen-topology traced twins of geometry/statics/node-packing
+    reproduce the host NumPy pipeline to roundoff at theta = 1."""
+    from raft_tpu.geometry import pack_nodes, process_members
+    from raft_tpu.parametric import (
+        compute_statics_t,
+        make_traced_members,
+        pack_nodes_t,
+    )
+    from raft_tpu.statics import compute_statics
+
+    d = _design()
+    tpls = process_members(d)
+    S = compute_statics(tpls, d["turbine"])
+    nodes = pack_nodes(tpls)
+
+    tms = make_traced_members(tpls, jnp.ones(4))
+    St = compute_statics_t(tms, d["turbine"], 1025.0, 9.81)
+    assert float(St["mass"]) == pytest.approx(S.mass, rel=1e-14)
+    assert float(St["V"]) == pytest.approx(S.V, rel=1e-14)
+    assert float(St["AWP"]) == pytest.approx(S.AWP, rel=1e-14)
+    assert float(St["zMeta"]) == pytest.approx(S.zMeta, rel=1e-12)
+    np.testing.assert_allclose(np.asarray(St["M_struc"]), S.M_struc,
+                               rtol=1e-12, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(St["C_hydro"]), S.C_hydro,
+                               rtol=1e-12, atol=1e-3)
+
+    nt = pack_nodes_t(tms)
+    for f in dataclasses.fields(nodes):
+        a = getattr(nodes, f.name)
+        b = np.asarray(getattr(nt, f.name))
+        if a.dtype == bool:
+            assert np.array_equal(a, b), f.name
+        else:
+            np.testing.assert_allclose(b, a, rtol=1e-12, atol=1e-12,
+                                       err_msg=f.name)
+
+
+@pytest.mark.slow
+def test_design_gradients_match_finite_differences():
+    """The flagship assertion: jax forward-mode derivatives of every
+    response metric w.r.t. every design parameter agree with central
+    finite differences of the same traced function to <= 1e-4 relative
+    (measured: <= ~1.4e-5; the worst entries are the line-length column,
+    bounded by the mooring Newton's 1e-8 step tolerance)."""
+    from raft_tpu.parametric import (
+        METRIC_NAMES,
+        PARAM_NAMES,
+        build_design_response,
+    )
+
+    f, th0 = build_design_response(_design())
+    fj = jax.jit(f)
+    v0 = {k: float(v) for k, v in fj(th0).items()}
+    assert set(v0) == set(METRIC_NAMES)
+    # sanity on the primal values (mean pitch + 3 sigma, utilization..)
+    assert 2.0 < v0["pitch_max_deg"] < 12.0
+    assert 0.0 < v0["moor_util"] < 0.5
+    assert v0["Mbase_DEL"] > 1e8
+
+    jvp = jax.jit(lambda t, v: jax.jvp(f, (t,), (v,)))
+    eps = 1e-4
+    worst = 0.0
+    for i, p in enumerate(PARAM_NAMES):
+        e = jnp.zeros(4).at[i].set(1.0)
+        _, tang = jvp(th0, e)
+        vp = fj(th0 + eps * e)
+        vm = fj(th0 - eps * e)
+        for k in v0:
+            fd = (float(vp[k]) - float(vm[k])) / (2 * eps)
+            ad = float(tang[k])
+            scale = abs(fd) + 1e-9 * max(abs(v0[k]), 1.0)
+            rel = abs(ad - fd) / scale
+            worst = max(worst, rel)
+            assert rel < 1e-4, (k, p, ad, fd, rel)
+    print(f"worst AD-vs-FD relative deviation: {worst:.2e}")
+
+
+@pytest.mark.slow
+def test_parametric_matches_model_path():
+    """The traced pipeline's aggregate metrics at theta0 equal the plain
+    Model.analyze_cases outputs (the omdao compute aggregates) — the
+    consistency that makes the OM partials meaningful derivatives of
+    compute()."""
+    from raft_tpu.model import Model
+    from raft_tpu.parametric import build_design_response
+
+    d = _design()
+    f, th0 = build_design_response(
+        d, metrics=("pitch_max_deg", "offset_max", "mass"))
+    vals = {k: float(v) for k, v in jax.jit(f)(th0).items()}
+
+    m = Model(d, precision="float64", device="cpu")
+    m.analyze_unloaded()
+    m.analyze_cases()
+    cm = m.results["case_metrics"]
+    pitch_max = float(np.max(cm["pitch_max"]))
+    offset_max = float(np.max(np.hypot(cm["surge_max"], cm["sway_max"])))
+    assert vals["pitch_max_deg"] == pytest.approx(pitch_max, rel=2e-5)
+    assert vals["offset_max"] == pytest.approx(offset_max, rel=2e-5)
+    assert vals["mass"] == pytest.approx(m.statics.mass, rel=1e-12)
+
+
+@pytest.mark.slow
+def test_omdao_scale_partials(tmp_path):
+    """compute_partials through the shim: the design-scale inputs move
+    compute()'s aggregate outputs, and the declared exact partials match
+    central differences of compute() itself."""
+    from tests.test_omdao import _build_component, _design as _om_design, \
+        _set_inputs
+
+    design = _om_design()
+    comp = _build_component(design, derivatives=True)
+    _set_inputs(comp, design)
+    comp.run()
+    base = {k: float(comp.get_val(k))
+            for k in ("Max_PtfmPitch", "Max_Offset", "max_tower_base")}
+
+    partials = {}
+    comp.compute_partials(comp._inputs, partials)
+
+    eps = 2e-3
+    for in_name, col in (("design_scale_ballast", 1),
+                         ("design_scale_line_length", 3)):
+        fd = {}
+        for sgn in (+1, -1):
+            comp.set_val(in_name, 1.0 + sgn * eps)
+            comp.run()
+            for k in base:
+                fd.setdefault(k, {})[sgn] = float(comp.get_val(k))
+        comp.set_val(in_name, 1.0)
+        for k in base:
+            fd_val = (fd[k][+1] - fd[k][-1]) / (2 * eps)
+            ad_val = float(np.asarray(partials[k, in_name]))
+            scale = max(abs(fd_val), 1e-6 * max(abs(base[k]), 1.0))
+            assert abs(ad_val - fd_val) / scale < 5e-3, (
+                k, in_name, ad_val, fd_val)
