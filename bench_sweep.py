@@ -335,6 +335,48 @@ def run(baseline_limit=None, verbose=True):
     return out
 
 
+def run_scaling(verbose=True):
+    """Throughput-knee measurement (VERDICT r4 #2): hot wall-clock of the
+    fused sweep at 1024 and 4096 designs (256 is the headline in run()),
+    holding the per-dispatch-step lane count constant (gd*nB*nc = 768
+    lanes/step, the memory knob) so what varies is purely the number of
+    designs streamed through the pipeline.  Reveals where fixed overheads
+    (aero lanes, mooring equilibria, host prep) stop dominating and the
+    dynamics dispatch sets the designs/sec slope."""
+    from raft_tpu.sweep_fused import run_draft_ballast_sweep
+
+    base, _aero_on = _flagship_wind_design()
+    out = {}
+    for name, nD, nB, gd in (("sweep1024", 64, 16, 4),
+                             ("sweep4096", 64, 64, 1)):
+        drafts = np.linspace(DRAFT_LO, DRAFT_HI, nD)
+        ballasts = np.linspace(BALLAST_LO, BALLAST_HI, nB)
+        try:
+            run_draft_ballast_sweep(base, drafts, ballasts,
+                                    draft_group=gd, verbose=False)
+            t0 = time.perf_counter()
+            res = run_draft_ballast_sweep(base, drafts, ballasts,
+                                          draft_group=gd, verbose=False)
+            t_hot = time.perf_counter() - t0
+        except Exception as exc:   # pragma: no cover - driver guard
+            out[f"{name}_error"] = f"{type(exc).__name__}: {exc}"
+            continue
+        n = nD * nB
+        out[f"{name}_n_designs"] = n
+        out[f"{name}_wall_s"] = round(t_hot, 3)
+        out[f"{name}_per_design_ms"] = round(t_hot / n * 1000, 3)
+        out[f"{name}_designs_per_s"] = round(n / t_hot, 1)
+        out[f"{name}_converged_frac"] = float(np.mean(res["converged"]))
+        out[f"{name}_timing_breakdown"] = {
+            k: round(v, 3) for k, v in res["timing"].items()
+        }
+        util = _utilization(f"{name}_dynamics", res)
+        out.update(util)
+    if verbose:
+        print(json.dumps(out))
+    return out
+
+
 # v5e single-chip peak (bf16 systolic); the dynamics/BEM matmuls run at
 # forced-f32 ("highest") precision, i.e. multiple bf16 passes, so MFU
 # against this peak understates the arithmetic actually performed

@@ -141,6 +141,7 @@ def _prepare_draft(base_design, s, rho_water, g):
 _GUIDE_NODES = 8         # full-solve pitch samples per wind case
 _GUIDE_PROBES = 2        # verification lanes per wind case
 _GUIDE_RTOL = 1e-9       # probe tolerance; exceeded -> direct fallback
+_GUIDE_PHI_TOL = 1e-2    # rad; max polish displacement of an in-basin lane
 
 
 def _guided_rotor_eval(rotor, U_case, yaw_case, pitch_dc):
@@ -214,8 +215,9 @@ def _guided_rotor_eval(rotor, U_case, yaw_case, pitch_dc):
         np.concatenate([interp_phi(batch_pitch[j, K:], j)
                         for j in range(nwind)]),
     ])
-    vals_g, J_g, resid_g = rotor.run_bem_batch(
-        U_g, pitch_g, yaw_g, phi0=phi0_g, return_resid=True)
+    vals_g, J_g, phi_g, resid_g = rotor.run_bem_batch(
+        U_g, pitch_g, yaw_g, phi0=phi0_g, return_phi=True,
+        return_resid=True)
     # .copy(): np.asarray of a jax.Array is a READ-ONLY view, and the
     # fallback below assigns into these per failing case
     vals = vals_g[:nd * nwind].reshape(nwind, nd, 10).copy()
@@ -223,6 +225,16 @@ def _guided_rotor_eval(rotor, U_case, yaw_case, pitch_dc):
     pv = vals_g[nd * nwind:].reshape(nwind, P, 10)
     pj = J_g[nd * nwind:].reshape(nwind, P, 10, 3)
     resid_l = resid_g[:nd * nwind].reshape(nwind, nd)
+    # per-lane polish displacement |phi_solved - phi0|: a lane whose
+    # interpolated guess crossed a bracket switch between the K pitch
+    # nodes can converge to a DIFFERENT valid root of the multi-root Ning
+    # residual with a tiny residual (so the resid guard passes) at a
+    # pitch the 2 probes never sample — but only by moving phi far
+    # beyond the ~1e-4 rad interpolation error of an in-basin guess, so
+    # the displacement itself is the detector
+    dphi_l = np.abs(
+        phi_g[:nd * nwind] - np.asarray(phi0_g[:nd * nwind])
+    ).max(axis=(-2, -1)).reshape(nwind, nd)
 
     direct = []
     for j in range(nwind):
@@ -232,14 +244,19 @@ def _guided_rotor_eval(rotor, U_case, yaw_case, pitch_dc):
             (np.abs(pv[j] - vals_n[j, K:]) / sv).max(),
             (np.abs(pj[j] - J_n[j, K:]) / sj).max(),
         )
-        # two guards, both failing CLOSED (a NaN comparison routes to the
-        # direct fallback): the probe lanes measure interpolation-guess
-        # quality at two pitches, and the per-lane post-polish Ning
-        # residual catches any single lane whose guess was trapped in the
-        # wrong bracket between probes (the polish leaves |r| large
-        # there, deterministically)
+        # three guards, all failing CLOSED (a NaN comparison routes to
+        # the direct fallback): the probe lanes measure interpolation-
+        # guess quality at two pitches; the per-lane post-polish Ning
+        # residual catches any single lane whose guess was trapped in
+        # the wrong bracket between probes (the polish leaves |r| large
+        # there, deterministically); and the per-lane phi displacement
+        # catches the remaining hole — a lane that crossed a bracket
+        # switch and converged cleanly to a DIFFERENT valid root, which
+        # has small residual but moved phi far beyond interpolation
+        # error (guesses land ~1e-4 rad from the intended root)
         lane_ok = np.all(resid_l[j] <= 1e-8)
-        if not (err <= _GUIDE_RTOL and lane_ok):
+        phi_ok = np.all(dphi_l[j] <= _GUIDE_PHI_TOL)
+        if not (err <= _GUIDE_RTOL and lane_ok and phi_ok):
             direct.append(j)
     if direct:
         dd = np.array(direct)
@@ -319,6 +336,26 @@ def _ballast_combine(v, b):
     M_struc = v.M0[None] + b[:, None, None] * (v.M1 - v.M0)
     C_struc = v.C0[None] + b[:, None, None] * (v.C1 - v.C0)
     return dict(mass=mass, rCG=rCG, M_struc=M_struc, C_struc=C_struc)
+
+
+def _shard_pipeline_args(dev_args, mesh):
+    """Place the dynamics-pipeline operands over a 1-D ``('design',)``
+    mesh: every per-design operand is sharded along the within-group
+    design axis (axis 1 — the lax.map group axis 0 stays serial on every
+    device), the case/frequency operands are replicated.  The jitted
+    pipeline then runs SPMD: each device solves its slice of the designs
+    with zero communication (the design axis is embarrassingly parallel,
+    SURVEY.md §2.4), exactly like the generic driver's design mesh
+    (sweep.py) but on the fused path that produces the headline number."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    s_d = NamedSharding(mesh, P(None, "design"))
+    s_r = NamedSharding(mesh, P())
+    nodes_g, zeta, beta, C, M0, a, b = dev_args
+    nodes_s = jax.tree.map(lambda x: jax.device_put(x, s_d), nodes_g)
+    return (nodes_s, jax.device_put(zeta, s_r), jax.device_put(beta, s_r),
+            jax.device_put(C, s_d), jax.device_put(M0, s_d),
+            jax.device_put(a, s_d), jax.device_put(b, s_d))
 
 
 def _dynamics_pipeline(model0, return_xi):
@@ -402,6 +439,7 @@ def run_draft_ballast_sweep(
     draft_group=4,
     return_xi=False,
     verbose=True,
+    mesh=None,
 ):
     """Run the fused draft x ballast sweep.
 
@@ -417,9 +455,15 @@ def run_draft_ballast_sweep(
     draft_scales : [nD] multipliers on submerged member depths.
     ballast_scales : [nB] multipliers on ballast fill density.
     draft_group : drafts per lax.map step (bounds device memory:
-        gd * nB * nc wave-kinematics lanes live at once).
+        gd * nB * nc wave-kinematics lanes live at once — per device when
+        a mesh is given).
     return_xi : also return the full complex response amplitudes
         [nD, nB, nc, 6, nw] (extra device->host transfer).
+    mesh : jax.sharding.Mesh | None
+        Optional 1-D ``('design',)`` mesh: the dynamics dispatch shards
+        the within-group draft axis across devices (``draft_group`` must
+        be divisible by the mesh size); results are identical to the
+        single-device path (asserted by the multichip dryrun).
 
     Returns dict with metrics [nD, nB, ...], timing breakdown, and the
     mooring/statics intermediates the benchmark asserts against.
@@ -539,14 +583,23 @@ def run_draft_ballast_sweep(
 
     pipeline = _dynamics_pipeline(model0, return_xi)
     dev_args = (
-        jax.device_put(nodes_g),
-        jnp.asarray(zeta.astype(dtype)),
-        jnp.asarray(np.asarray(beta, dtype)),
-        jnp.asarray(shp(C_lin.astype(dtype))),
-        jnp.asarray(shp(M0_all.astype(dtype))),
-        jnp.asarray(shp(a_hub.reshape(nD, nB, nc, model0.nw).astype(dtype))),
-        jnp.asarray(shp(b_hub.reshape(nD, nB, nc, model0.nw).astype(dtype))),
+        nodes_g,
+        zeta.astype(dtype),
+        np.asarray(beta, dtype),
+        shp(C_lin.astype(dtype)),
+        shp(M0_all.astype(dtype)),
+        shp(a_hub.reshape(nD, nB, nc, model0.nw).astype(dtype)),
+        shp(b_hub.reshape(nD, nB, nc, model0.nw).astype(dtype)),
     )
+    if mesh is not None:
+        if draft_group % mesh.size:
+            raise ValueError(
+                f"draft_group ({draft_group}) must be divisible by the "
+                f"design-mesh size ({mesh.size})")
+        dev_args = _shard_pipeline_args(dev_args, mesh)
+    else:
+        dev_args = (jax.device_put(dev_args[0]),) + tuple(
+            jnp.asarray(a) for a in dev_args[1:])
     t0 = time.perf_counter()
     dyn = pipeline(*dev_args)
     jax.block_until_ready(dyn)
@@ -821,6 +874,7 @@ def run_design_sweep(
     return_xi=False,
     trim_ballast_density=False,
     verbose=True,
+    mesh=None,
 ):
     """Fused sweep over an arbitrary list of design dicts — the general
     form of the reference's 5-parameter geometry study
@@ -835,6 +889,10 @@ def run_design_sweep(
         the reference sweep runs its incremental adjustBallast per point;
         the closed form is applied symmetrically by the benchmark's
         serial baseline).
+    mesh : optional 1-D ``('design',)`` mesh; the dynamics dispatch
+        shards the within-group design axis across its devices
+        (``group`` must be divisible by the mesh size), results
+        identical to the single-device path.
 
     All designs must share the cases table and frequency settings of
     ``designs[0]``.
@@ -972,14 +1030,23 @@ def run_design_sweep(
 
     pipeline = _dynamics_pipeline(model0, return_xi)
     dev_args = (
-        jax.device_put(nodes_g),
-        jnp.asarray(zeta.astype(dtype)),
-        jnp.asarray(np.asarray(beta, dtype)),
-        jnp.asarray(shp(C_lin.astype(dtype))),
-        jnp.asarray(shp(M0_all.astype(dtype))),
-        jnp.asarray(shp(a_hub[pad_idx].astype(dtype))),
-        jnp.asarray(shp(b_hub[pad_idx].astype(dtype))),
+        nodes_g,
+        zeta.astype(dtype),
+        np.asarray(beta, dtype),
+        shp(C_lin.astype(dtype)),
+        shp(M0_all.astype(dtype)),
+        shp(a_hub[pad_idx].astype(dtype)),
+        shp(b_hub[pad_idx].astype(dtype)),
     )
+    if mesh is not None:
+        if gd % mesh.size:
+            raise ValueError(
+                f"group ({gd}) must be divisible by the design-mesh "
+                f"size ({mesh.size})")
+        dev_args = _shard_pipeline_args(dev_args, mesh)
+    else:
+        dev_args = (jax.device_put(dev_args[0]),) + tuple(
+            jnp.asarray(a) for a in dev_args[1:])
     t0 = time.perf_counter()
     dyn = pipeline(*dev_args)
     jax.block_until_ready(dyn)

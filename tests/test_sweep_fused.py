@@ -49,6 +49,36 @@ def _apply_point(design, draft, ballast):
     return d
 
 
+def test_fused_sweep_sharded_matches_single_device():
+    """The fused sweep's dynamics dispatch on a ('design',) mesh (the
+    headline-number path sharded across chips, VERDICT r4 #2) must give
+    results identical to the unsharded dispatch — the design axis is
+    embarrassingly parallel, so sharding changes placement only."""
+    from jax.sharding import Mesh
+
+    ndev = len(jax.devices())
+    if ndev < 2:
+        pytest.skip("needs the multi-device CPU mesh from conftest")
+    mesh = Mesh(np.array(jax.devices()), ("design",))
+    base = _base_design(n_cases=2)
+    drafts = list(np.linspace(0.9, 1.1, ndev))
+    ballasts = [0.8, 1.2]
+    res_1 = run_draft_ballast_sweep(
+        base, drafts, ballasts, draft_group=ndev, verbose=False)
+    res_n = run_draft_ballast_sweep(
+        base, drafts, ballasts, draft_group=ndev, verbose=False, mesh=mesh)
+    for key in ("std", "Xi0", "offset", "pitch_deg", "mass", "T_moor"):
+        np.testing.assert_allclose(
+            res_1[key], res_n[key], rtol=1e-10, atol=1e-12, err_msg=key)
+    assert res_n["converged"].all()
+
+    # group size must tile the mesh
+    with pytest.raises(ValueError, match="divisible"):
+        run_draft_ballast_sweep(
+            base, drafts[:1], ballasts, draft_group=1, verbose=False,
+            mesh=mesh)
+
+
 def test_fused_sweep_matches_direct_model():
     """Every fused-sweep shortcut (ballast linearity, shared node bundles,
     batched mooring, in-graph statistics) must reproduce the plain
@@ -353,3 +383,15 @@ def test_guided_rotor_eval_matches_direct():
         sf._GUIDE_RTOL = old
     assert float((np.abs(vals_f - v_d) / sv).max()) < 1e-12
     assert float((np.abs(J_f - J_d) / sj).max()) < 1e-12
+
+    # force the phi-displacement guard to fail (guards against a lane
+    # converging to a DIFFERENT valid Ning root after a bracket switch):
+    # same direct-fallback routing, same exact results
+    old_phi = sf._GUIDE_PHI_TOL
+    try:
+        sf._GUIDE_PHI_TOL = -1.0
+        vals_p, J_p = _guided_rotor_eval(m.rotor, U_case, yaw_case, pitch)
+    finally:
+        sf._GUIDE_PHI_TOL = old_phi
+    assert float((np.abs(vals_p - v_d) / sv).max()) < 1e-12
+    assert float((np.abs(J_p - J_d) / sj).max()) < 1e-12
