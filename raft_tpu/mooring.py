@@ -570,10 +570,15 @@ def catenary_solve(XF, ZF, L, EA, w, Wp=None, cb=0.0, iters=60,
         # falls back to the closed form as well — no NaN leaves the
         # touchdown solver for ZF >= 0 geometries.
         near = (ZF >= 0.0) & (L_tot >= (XF + ZF) * (1.0 - 2e-4))
-        # the NaN escape only covers SLACK-side geometries (more line than
-        # the chord): a taut line whose Newton diverged must keep its NaN
+        # the NaN escape only covers geometries within 1% of the fully-
+        # slack boundary L = XF + ZF (where the Newton's measured NaN
+        # sliver lives and the closed form is within ~1e-2 of truth): a
+        # line whose Newton diverged anywhere else — taut, or slack but
+        # far from the boundary where the true H is large (e.g.
+        # XF=700/ZF=186/L=835 has H ~ 86 kN) — must keep its NaN
         # (detectable) rather than silently report zero tension
         bad = (ZF >= 0.0) & (L_tot >= d) & (
+            L_tot >= (XF + ZF) * (1.0 - 1e-2)) & (
             ~jnp.isfinite(HF) | ~jnp.isfinite(VF))
         fully_slack = near | bad
         above = jnp.sum(L) - jnp.cumsum(L)   # line length above each seg
@@ -980,18 +985,22 @@ BRIDLE_RESID_TOL = 1e-5
 
 
 def warn_bridle_residual(moor_resid, label="case"):
-    """Print a warning for every leading-axis entry of ``moor_resid``
-    (scalars per case/design; trailing axes reduced by max) whose bridle
-    force-balance residual exceeds :data:`BRIDLE_RESID_TOL`."""
+    """Warn (via the package logger, the same diagnostic channel as the
+    BEM panel-limit warning) for every leading-axis entry of
+    ``moor_resid`` (scalars per case/design; trailing axes reduced by
+    max) whose bridle force-balance residual exceeds
+    :data:`BRIDLE_RESID_TOL`."""
+    from raft_tpu.utils.profiling import logger
+
     r = np.asarray(moor_resid)
     if r.ndim == 0:
         r = r[None]
     r = r.reshape(len(r), -1).max(axis=1)
     for i in np.nonzero(r > BRIDLE_RESID_TOL)[0]:
-        print(
-            f"WARNING - {label} {i+1}: bridle junction solve residual "
-            f"{r[i]:.2e} exceeds tolerance; mooring linearization may "
-            "be off."
+        logger.warning(
+            "%s %d: bridle junction solve residual %.2e exceeds "
+            "tolerance; mooring linearization may be off.",
+            label, i + 1, r[i],
         )
 
 

@@ -49,6 +49,37 @@ def test_catenary_roundtrip(oc3_mooring):
         assert float(H) > 0
 
 
+def test_catenary_mid_slack_large_h(oc3_mooring):
+    """Slack-side geometry FAR from the fully-slack boundary (d <= L but
+    L well below XF+ZF) must converge to its large finite H — and must
+    never be eligible for the closed-form H=0 escape, which is banded to
+    within 1% of L = XF+ZF (the advisor's XF=700/ZF=186/L=835 case:
+    d ~ 724 < L = 835 < XF+ZF = 886, true H ~ 86 kN)."""
+    ms = oc3_mooring
+    L1 = ms.L[0] * (835.0 / float(jnp.sum(ms.L[0])))
+    H, V = catenary_solve(700.0, 186.0, L1, ms.EA[0], ms.w[0])
+    assert np.isfinite(float(H)) and np.isfinite(float(V))
+    assert float(H) > 1e4          # large, NOT the fully-slack H = 0
+    x, z = _profile(H, V, L1[0], ms.EA[0, 0], ms.w[0, 0])
+    assert float(abs(x - 700.0)) < 1e-5
+    assert float(abs(z - 186.0)) < 1e-5
+
+
+def test_bridle_residual_warning_uses_logger(caplog):
+    """warn_bridle_residual routes through the package logger (the same
+    diagnostic channel as the BEM panel-limit warning), so logging-based
+    consumers can capture/filter it."""
+    import logging
+
+    from raft_tpu.mooring import warn_bridle_residual
+
+    with caplog.at_level(logging.WARNING, logger="raft_tpu"):
+        warn_bridle_residual(np.array([1e-9, 3e-4]), label="design")
+    assert len(caplog.records) == 1
+    assert "design 2" in caplog.records[0].getMessage()
+    assert "3.00e-04" in caplog.records[0].getMessage()
+
+
 def test_catenary_touchdown_continuity():
     # crossing the touchdown boundary changes nothing discontinuously
     L, EA, w = 500.0, 1e9, 500.0
