@@ -20,10 +20,40 @@ import time
 
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _ROOT)
 
 NW_MIN, NW_MAX = 0.00625, 0.8   # arange -> exactly 128 bins
 N_CASES = 12
+
+# Full results land here every run (the driver's BENCH_r{N}.json artifact
+# keeps only the final printed line, truncated to its last ~2000 chars —
+# rounds 3-4 lost their headline keys to exactly that); PERF.md and the
+# marked README headline are regenerated from this file so the published
+# numbers can never drift from a measurement again (VERDICT r4 #5).
+BENCH_FULL = os.path.join(_ROOT, "BENCH_FULL.json")
+PERF_MD = os.path.join(_ROOT, "PERF.md")
+README = os.path.join(_ROOT, "README.md")
+
+# keys of the compact driver line (kept well under the artifact's 2000-char
+# tail so the recorded JSON parses; everything else goes to BENCH_FULL.json)
+_COMPACT_KEYS = (
+    "metric", "value", "unit", "vs_baseline", "baseline_numpy_s",
+    "on_device_per_solve_s", "vs_baseline_on_device",
+    "pipelined_per_solve_s", "vs_baseline_pipelined", "rao_linf_err",
+    "backend",
+    "sweep_n_designs", "sweep_wall_s", "sweep_per_design_ms",
+    "sweep_vs_baseline", "sweep_rao_linf_err", "sweep_converged_frac",
+    "sweep243_vs_baseline", "sweep243_per_design_ms",
+    "sweep1024_per_design_ms", "sweep4096_per_design_ms",
+    "bem_panels", "bem_device_vs_cpu", "bem_large_panels",
+    "bem_large_device_vs_cpu", "bem_conv_A_within_5pct",
+    "bem_conv_X_within_5pct",
+    "grad_metrics", "grad_fd_rel_err",
+    "sweep_error", "sweep243_error", "bem_error", "grad_error",
+    "perf_docs_error", "sweep_scaling_error", "sweep1024_error",
+    "sweep4096_error",
+)
 
 
 def main():
@@ -185,6 +215,12 @@ def main():
     except Exception as exc:  # pragma: no cover - defensive for the driver
         out["sweep_error"] = f"{type(exc).__name__}: {exc}"
 
+    # ---- throughput knee: 1024- and 4096-design fused sweeps ----
+    try:
+        out.update(bench_sweep.run_scaling(verbose=False))
+    except Exception as exc:  # pragma: no cover - defensive for the driver
+        out["sweep_scaling_error"] = f"{type(exc).__name__}: {exc}"
+
     # ---- the reference's 5-parameter geometry study: 3^5 = 243 points
     # with dependent geometry, fairlead repositioning, and ballast trim
     # (reference raft/parametersweep.py:40-100) ----
@@ -201,7 +237,219 @@ def main():
     except Exception as exc:  # pragma: no cover - defensive for the driver
         out["bem_error"] = f"{type(exc).__name__}: {exc}"
 
-    print(json.dumps(out))
+    # ---- end-to-end design-gradient validation (the differentiable-
+    # design capability; full validation lives in tests/test_parametric,
+    # this records a 2-column AD-vs-FD spot check in the artifact) ----
+    try:
+        out.update(bench_gradients())
+    except Exception as exc:  # pragma: no cover - defensive for the driver
+        out["grad_error"] = f"{type(exc).__name__}: {exc}"
+
+    # full results to disk + regenerated docs, compact line to the driver
+    try:
+        update_perf_docs(out)
+    except Exception as exc:  # pragma: no cover - defensive for the driver
+        out["perf_docs_error"] = f"{type(exc).__name__}: {exc}"
+    with open(BENCH_FULL, "w") as fh:
+        json.dump(out, fh, indent=1)
+    print(json.dumps(compact_results(out)))
+
+
+def bench_gradients(params=(1, 3), eps=1e-4):
+    """AD-vs-FD spot check of the traced design-gradient pipeline on the
+    flagship design (reduced frequency band): jvp columns for the
+    ``params`` axes vs central differences, every metric.  The pipeline
+    is CPU-committed f64 (the statics cancellations need it), so this
+    runs identically under the driver's TPU default backend."""
+    import jax
+
+    from raft_tpu.io.schema import load_design
+    from raft_tpu.parametric import METRIC_NAMES, build_design_response
+
+    path = "/root/reference/designs/VolturnUS-S.yaml"
+    if not os.path.exists(path):
+        return {}
+    design = load_design(path)
+    design["settings"] = {"min_freq": 0.05, "max_freq": 0.3}
+    t0 = time.perf_counter()
+    f, th0 = build_design_response(design)
+    cpu0 = jax.devices("cpu")[0]
+    th0 = jax.device_put(th0, cpu0)
+    fj = jax.jit(f)
+    jvp = jax.jit(lambda t, v: jax.jvp(f, (t,), (v,)))
+    v0 = fj(th0)
+    worst = 0.0
+    for i in params:
+        e = jax.device_put(
+            np.eye(4)[i], cpu0)
+        _, tang = jvp(th0, e)
+        vp = fj(th0 + eps * e)
+        vm = fj(th0 - eps * e)
+        for k in v0:
+            fd = (float(vp[k]) - float(vm[k])) / (2 * eps)
+            ad = float(tang[k])
+            worst = max(worst, abs(ad - fd) / (
+                abs(fd) + 1e-9 * max(abs(float(v0[k])), 1.0)))
+    return {
+        "grad_metrics": len(METRIC_NAMES),
+        "grad_params_checked": len(params),
+        "grad_fd_rel_err": worst,
+        "grad_wall_s": round(time.perf_counter() - t0, 1),
+    }
+
+
+# --------------------------------------------------------------- perf docs
+
+def compact_results(out):
+    """The driver-facing subset of the results (kept short enough that the
+    recorded artifact tail stays a parseable JSON line)."""
+    return {k: out[k] for k in _COMPACT_KEYS if k in out}
+
+
+def _fmt(x, nd=2):
+    if isinstance(x, float):
+        return f"{x:.{nd}f}" if abs(x) >= 0.01 else f"{x:.2e}"
+    return str(x)
+
+
+def perf_md_text(d):
+    """PERF.md content generated purely from a bench results dict."""
+    rows = []
+
+    def row(label, *cells):
+        rows.append((label, " — ".join(str(c) for c in cells)))
+
+    if "sweep_vs_baseline" in d:
+        row(
+            "**256-design draft×ballast sweep, full aero-servo physics "
+            "(12 cases × 128 freq)**",
+            f"**{_fmt(d.get('sweep_wall_s'))} s total, "
+            f"{_fmt(d.get('sweep_per_design_ms'))} ms/design — "
+            f"{_fmt(d.get('sweep_vs_baseline'), 1)}× vs the serial NumPy "
+            f"baseline** ({_fmt(d.get('sweep_baseline_s', d.get('sweep_baseline_numpy_s', 0.0)))} s over "
+            f"{d.get('sweep_baseline_designs_timed', '?')} designs, scaled)",
+        )
+        row("sweep RAO L∞ parity vs the serial path",
+            _fmt(d.get("sweep_rao_linf_err", float("nan"))))
+    for key, label in (("sweep1024", "1024-design sweep"),
+                       ("sweep4096", "4096-design sweep")):
+        if f"{key}_per_design_ms" in d:
+            row(label,
+                f"{_fmt(d.get(f'{key}_wall_s'))} s total, "
+                f"{_fmt(d.get(f'{key}_per_design_ms'))} ms/design")
+    if "sweep243_vs_baseline" in d:
+        row("3⁵ = 243-point 5-parameter geometry study",
+            f"{_fmt(d.get('sweep243_wall_s'))} s total — "
+            f"{_fmt(d.get('sweep243_vs_baseline'), 1)}× vs the serial loop")
+    if "value" in d:
+        row("single-dispatch RAO solve wall-clock (128 ω × 12 cases)",
+            f"{_fmt(d['value'], 3)} s ({_fmt(d.get('vs_baseline', 0.0), 1)}× "
+            "vs serial NumPy; tunnel-latency-bound in this harness)")
+        row("on-device per-solve (amortized, in-graph repeats)",
+            f"{_fmt(1e3 * d.get('on_device_per_solve_s', 0.0), 2)} ms "
+            f"({_fmt(d.get('vs_baseline_on_device', 0.0), 1)}×)")
+    if "pipelined_per_solve_s" in d:
+        b, dd = d.get("pipelined_batch", ["?", "?"])
+        row(
+            f"**pipelined streaming ({b}-solve vmapped dispatches × {dd} "
+            "in flight, one combined fetch)**",
+            f"**{_fmt(1e3 * d['pipelined_per_solve_s'], 2)} ms/solve — "
+            f"{_fmt(d.get('vs_baseline_pipelined', 0.0), 1)}× vs baseline**",
+        )
+    if "rao_linf_err" in d:
+        row("RAO L∞ error vs the f64 NumPy reference",
+            f"{d['rao_linf_err']:.1e} (target ≤ 1e-4)")
+    if "bem_device_vs_cpu" in d:
+        row(f"native BEM, {d.get('bem_panels')} panels × "
+            f"{d.get('bem_nw')} freq",
+            f"device {_fmt(d.get('bem_device_s'))} s vs CPU "
+            f"{_fmt(d.get('bem_cpu_s'))} s "
+            f"({_fmt(d.get('bem_device_vs_cpu'), 1)}×)")
+    if "bem_large_device_vs_cpu" in d:
+        row(f"native BEM, {d.get('bem_large_panels')} panels × "
+            f"{d.get('bem_large_nw')} freq",
+            f"device {_fmt(d.get('bem_large_device_s'))} s vs CPU "
+            f"{_fmt(d.get('bem_large_cpu_s'))} s "
+            f"({_fmt(d.get('bem_large_device_vs_cpu'), 1)}×)")
+    if "bem_conv_A_rel_max_by_dof" in d:
+        cell = (f"A diagonals within "
+                f"{_fmt(100 * max(d['bem_conv_A_rel_max_by_dof']), 1)}%")
+        if "bem_conv_X_rel_max_surge_heave_pitch" in d:
+            cell += (", |X| surge/heave/pitch within "
+                     f"{_fmt(100 * max(d['bem_conv_X_rel_max_surge_heave_pitch']), 1)}%")
+        row(f"full-hull mesh-convergence anchor "
+            f"({'/'.join(str(p) for p in d.get('bem_conv_panels', []))} "
+            "panels)", cell)
+    if "grad_fd_rel_err" in d:
+        row("end-to-end design gradients (jacfwd vs central differences)",
+            f"worst relative deviation {d['grad_fd_rel_err']:.1e} over "
+            f"{d.get('grad_metrics', '?')} metrics × "
+            f"{d.get('grad_params_checked', '?')} parameter columns "
+            "(all 4 columns in tests/test_parametric.py)")
+
+    lines = [
+        "# PERF — measured numbers (generated)",
+        "",
+        "<!-- GENERATED by `python bench.py` (or `python bench.py "
+        "--write-perf`) from BENCH_FULL.json; DO NOT EDIT BY HAND — "
+        "tests/test_perf_docs.py asserts this file matches the "
+        "measurement. -->",
+        "",
+        f"Source: `BENCH_FULL.json` (backend: {d.get('backend', '?')}); "
+        "the driver records the compact subset of the same run as "
+        "`BENCH_r{N}.json`.  Analysis and roofline discussion: "
+        "`docs/performance.md`.",
+        "",
+        "| Figure | Value |",
+        "|---|---|",
+    ]
+    lines += [f"| {a} | {b} |" for a, b in rows]
+    return "\n".join(lines) + "\n"
+
+
+README_MARK_BEGIN = "<!-- bench-headline -->"
+README_MARK_END = "<!-- /bench-headline -->"
+
+
+def readme_headline_text(d):
+    """The README's generated performance sentence."""
+    sweep = d.get("sweep_vs_baseline")
+    pipe = d.get("vs_baseline_pipelined")
+    parts = []
+    if sweep:
+        parts.append(
+            f"the fused 256-design × 12-case VolturnUS-S sweep with the "
+            f"full aero-servo physics in both paths measures "
+            f"**{sweep:.0f}×** a serial NumPy baseline on one TPU chip"
+        )
+    if pipe:
+        parts.append(
+            f"the pipelined streaming RAO-solve driver metric reaches "
+            f"**{pipe:.0f}×** with all results host-visible"
+        )
+    return (
+        f"{README_MARK_BEGIN}\n"
+        + ("; ".join(parts) if parts else "benchmark pending")
+        + " (measured: `PERF.md`, generated from `BENCH_FULL.json`).\n"
+        + README_MARK_END
+    )
+
+
+def update_perf_docs(d):
+    """Write PERF.md and patch the marked README headline from results
+    dict ``d`` — called at the end of every bench run so published
+    numbers always trace to the latest measurement."""
+    with open(PERF_MD, "w") as fh:
+        fh.write(perf_md_text(d))
+    with open(README) as fh:
+        txt = fh.read()
+    a = txt.find(README_MARK_BEGIN)
+    b = txt.find(README_MARK_END)
+    if a >= 0 and b > a:
+        txt = (txt[:a] + readme_headline_text(d)
+               + txt[b + len(README_MARK_END):])
+        with open(README, "w") as fh:
+            fh.write(txt)
 
 
 def bench_bem(nw=8, nw_large=4):
@@ -296,7 +544,7 @@ def _bench_bem_converge(backend):
     if not os.path.exists(path):
         return {}
     t0 = time.perf_counter()
-    sols, rel = full_hull_convergence(path, backend=backend)
+    sols, rel, rel_X = full_hull_convergence(path, backend=backend)
     return {
         "bem_conv_panels": [sols["fine"]["npanels"],
                             sols["xfine"]["npanels"]],
@@ -304,8 +552,15 @@ def _bench_bem_converge(backend):
         "bem_conv_s": round(time.perf_counter() - t0, 1),
         "bem_conv_A_rel_max_by_dof": [round(r, 4) for r in rel],
         "bem_conv_A_within_5pct": bool(max(rel) < 0.05),
+        "bem_conv_X_rel_max_surge_heave_pitch": [
+            round(r, 4) for r in rel_X],
+        "bem_conv_X_within_5pct": bool(max(rel_X) < 0.05),
     }
 
 
 if __name__ == "__main__":
-    main()
+    if "--write-perf" in sys.argv:
+        with open(BENCH_FULL) as _fh:
+            update_perf_docs(json.load(_fh))
+    else:
+        main()

@@ -16,3 +16,11 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: compile-heavy test (> ~1 min); excluded from the fast lane "
+        "`pytest -m 'not slow'`, always run in CI/driver full suites",
+    )
