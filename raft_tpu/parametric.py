@@ -85,32 +85,28 @@ def apply_design_scales(design, theta):
     and the plain-model FD are derivatives of the same function)."""
     import copy
 
+    def scaled(v, s):
+        """Scalar/list/array-robust multiplicative scale."""
+        if v is None:
+            return v
+        if np.isscalar(v):
+            return float(v) * s
+        return (np.asarray(v, float) * s).tolist()
+
     s_draft, s_ball, s_diam, s_line = (float(t) for t in np.asarray(theta))
     d = copy.deepcopy(design)
     for mem in d["platform"]["members"]:
         for key in ("rA", "rB"):
-            v = [float(x) for x in mem[key]]
+            v = [float(x) for x in np.asarray(mem[key]).reshape(-1)]
             if v[2] < 0.0:
                 v[2] = v[2] * s_draft
             mem[key] = v
-        if "rho_fill" in mem and mem["rho_fill"] is not None:
-            rf = mem["rho_fill"]
-            mem["rho_fill"] = (
-                [float(x) * s_ball for x in rf]
-                if isinstance(rf, (list, tuple)) else float(rf) * s_ball
-            )
+        if mem.get("rho_fill") is not None:
+            mem["rho_fill"] = scaled(mem["rho_fill"], s_ball)
         if str(mem["shape"])[0].lower() == "c":
-            dd = mem["d"]
-            mem["d"] = (
-                [float(x) * s_diam for x in dd]
-                if isinstance(dd, (list, tuple)) else float(dd) * s_diam
-            )
-            if "cap_d_in" in mem and mem["cap_d_in"] is not None:
-                ci = mem["cap_d_in"]
-                mem["cap_d_in"] = (
-                    [float(x) * s_diam for x in ci]
-                    if isinstance(ci, (list, tuple)) else float(ci) * s_diam
-                )
+            mem["d"] = scaled(mem["d"], s_diam)
+            if mem.get("cap_d_in") is not None:
+                mem["cap_d_in"] = scaled(mem["cap_d_in"], s_diam)
     for ln in d["mooring"]["lines"]:
         ln["length"] = float(ln["length"]) * s_line
     return d

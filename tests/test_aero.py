@@ -85,6 +85,7 @@ def test_derivatives_match_finite_differences(rotor):
     assert d["dT_dPi"] < 0
 
 
+@pytest.mark.slow
 def test_linear_vs_spline_polar_bound(rotor):
     """Quantified bound on the one numeric-method divergence in the rotor
     chain vs the reference (VERDICT r4 #7): the reference evaluates polars
@@ -185,6 +186,7 @@ def test_aero_servo_transfer_functions(rotor):
     assert np.abs(f2[0]) > np.abs(f2[-1])
 
 
+@pytest.mark.slow
 def test_side_loads_symmetry_and_shear(rotor):
     """Hub side forces/moments (CCBlade's Y, Z, My, Mz, consumed into
     F_aero0 at reference raft_rotor.py:350-351): symmetric inflow must

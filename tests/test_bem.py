@@ -108,6 +108,7 @@ def test_interp_to_grid_heading_interpolation():
     np.testing.assert_allclose(X15b, X15)
 
 
+@pytest.mark.slow
 def test_model_heading_interpolation_end_to_end():
     """A case at 15 deg between spar.3's 10/20 deg tabulation gets blended
     excitation through the full prepare_case_inputs path: its BEM force

@@ -84,6 +84,7 @@ def test_symmetry_and_damping_sign():
         assert B[dof, dof] > 0
 
 
+@pytest.mark.slow
 def test_model_run_bem_end_to_end():
     import yaml
 
@@ -117,22 +118,40 @@ def test_model_run_bem_end_to_end():
     assert rao.max() > 0.1  # spar surge RAO approaches ~1 at low frequency
 
 
-def test_backend_param_and_panel_limit_fallback(caplog, monkeypatch):
+def test_backend_param_and_streamed_large_mesh(monkeypatch):
     """solve_bem(backend=...) places the solve on the requested backend;
-    meshes above TPU_PANEL_LIMIT fall back to CPU with a warning instead
-    of crashing the accelerator (observed v5e LU VMEM ceiling)."""
-    import logging
+    meshes above TPU_PANEL_LIMIT take the streamed out-of-core path
+    (multi-dispatch band assembly + one solve dispatch per frequency)
+    and must reproduce the direct solve.  Exercised here on the CPU
+    backend with the panel limit and band budget shrunk so a small spar
+    mesh streams in several bands."""
+    import raft_tpu.utils.placement as placement
 
     panels = spar_panels(12.0, 12.0)
     out_default = bem_solver.solve_bem(panels, [0.5])
-    out_cpu = bem_solver.solve_bem(panels, [0.5], backend="cpu")
-    np.testing.assert_allclose(out_cpu["A"], out_default["A"], rtol=1e-6)
+    out_cpu = bem_solver.solve_bem(panels, [0.5, 0.9], backend="cpu")
+    np.testing.assert_allclose(
+        out_cpu["A"][:1], out_default["A"], rtol=1e-6)
 
+    orig = placement.backend_sharding
+    monkeypatch.setattr(placement, "backend_sharding",
+                        lambda b: orig("cpu"))
     monkeypatch.setattr(bem_solver, "TPU_PANEL_LIMIT", 4)
-    with caplog.at_level(logging.WARNING, logger="raft_tpu"):
-        out_fb = bem_solver.solve_bem(panels, [0.5], backend="tpu")
-    assert "panel" in caplog.text and "CPU" in caplog.text
-    np.testing.assert_allclose(out_fb["A"], out_default["A"], rtol=1e-6)
+    monkeypatch.setattr(bem_solver, "STREAM_BAND_BUDGET_S", 1e-4)
+    panels_l = spar_panels(4.0, 3.0)    # pads past 512: several bands
+    out_ref = bem_solver.solve_bem(panels_l, [0.5, 0.9], backend="cpu")
+    out_s = bem_solver.solve_bem(panels_l, [0.5, 0.9], backend="tpu")
+    assert out_s.get("streamed") is True
+    # multi-band streaming actually exercised (budget forces D = units)
+    assert out_s["npanels_solved"] >= 512
+    scaleA = np.abs(out_ref["A"]).max()
+    scaleB = np.abs(out_ref["B"]).max()
+    scaleX = np.abs(out_ref["X"]).max()
+    assert np.abs(out_s["A"] - out_ref["A"]).max() < 2e-4 * scaleA
+    # B comes from the small imaginary parts (f32 cancellation); band-
+    # split fusion order moves it ~5e-4 of scale vs the one-sweep path
+    assert np.abs(out_s["B"] - out_ref["B"]).max() < 1e-3 * scaleB
+    assert np.abs(out_s["X"] - out_ref["X"]).max() < 2e-4 * scaleX
 
 
 def test_blocked_gj_matches_dense_solve():
@@ -180,6 +199,7 @@ def test_padded_real_block_solve_inert(monkeypatch):
     assert np.abs(out_pad["X"] - out_cpu["X"]).max() < 2e-4 * scaleX
 
 
+@pytest.mark.slow
 def test_irregular_frequency_removal():
     """Extended-boundary-condition lid (z=0 interior waterplane panels,
     doubled-jump diagonal): the truncated cylinder's first irregular

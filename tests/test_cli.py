@@ -5,9 +5,11 @@ import os
 import subprocess
 import sys
 
+import pytest
 import yaml
 
 
+@pytest.mark.slow
 def test_cli_runs_full_analysis(tmp_path):
     from raft_tpu.designs import deep_spar
 

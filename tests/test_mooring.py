@@ -116,6 +116,7 @@ def test_c_moor0(oc3_mooring):
     np.testing.assert_allclose(C, expected, rtol=0.1, atol=1e5)
 
 
+@pytest.mark.slow
 def test_stiffness_matches_finite_difference(oc3_mooring):
     """Autodiff stiffness equals central finite differences of line forces."""
     arr = oc3_mooring.arrays()
@@ -219,6 +220,7 @@ def _two_seg_mooring(split=0.4, scale_mid=1.0):
     return moor
 
 
+@pytest.mark.slow
 def test_split_line_matches_unsplit(oc3_mooring):
     """A line split into two chained segments with identical properties
     must reproduce the single-segment solution exactly (forces, stiffness,
@@ -372,6 +374,7 @@ def test_seabed_friction_through_system(oc3_mooring):
     np.testing.assert_allclose(T1[nL:], T0[nL:], rtol=5e-3)
 
 
+@pytest.mark.slow
 def test_bridle_junction_equilibrium():
     """3-line bridle (one anchor leg + two vessel legs through a free
     junction): the solved junction position balances the leg tensions
@@ -445,6 +448,7 @@ def test_bridle_junction_equilibrium():
     assert TB[0, 0] > TA[0, 0] >= 0.0
 
 
+@pytest.mark.slow
 def test_bridled_model_end_to_end():
     """A design whose mooring uses crow's-foot bridles (each anchor line
     splits at a free junction into two vessel legs) runs the full

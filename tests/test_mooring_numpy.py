@@ -48,6 +48,7 @@ def test_catenary_matches_jax():
             assert V_np == pytest.approx(w * ZF, rel=1e-12)
 
 
+@pytest.mark.slow
 def test_case_mooring_matches_jax():
     """Oracle-vs-JAX parity at a GROUNDED equilibrium.
 

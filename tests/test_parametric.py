@@ -28,6 +28,7 @@ def _design():
     return d
 
 
+@pytest.mark.slow
 def test_traced_twins_match_numpy_at_theta0():
     """The frozen-topology traced twins of geometry/statics/node-packing
     reproduce the host NumPy pipeline to roundoff at theta = 1."""

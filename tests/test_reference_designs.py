@@ -48,6 +48,7 @@ def test_oc3_eigen_frequencies(oc3):
     assert abs(modes[5, 5]) > 0.99
 
 
+@pytest.mark.slow
 def test_oc3_full_case_run(oc3):
     oc3.analyze_cases()
     r = oc3.calc_outputs()
@@ -91,6 +92,7 @@ def test_oc4semi_with_wamit_import():
     assert not np.allclose(np.abs(Xi), np.abs(m2.Xi), rtol=1e-3)
 
 
+@pytest.mark.slow
 def test_oc4semi_native_bem_vs_marin_wamit():
     """Native panel solver vs the MARIN/WAMIT golden coefficients for the
     OC4 semi (reference tests/marin_semi.1, the truth data used at
@@ -151,6 +153,7 @@ def test_oc4semi_native_bem_vs_marin_wamit():
                 f"B11 at w={wv:.2f}")
 
 
+@pytest.mark.slow
 def test_oc3_native_excitation_vs_spar3():
     """Native diffraction excitation X vs the reference's spar.3 WAMIT
     golden file (the DOF selection the reference verification uses,
@@ -185,6 +188,7 @@ def test_oc3_native_excitation_vs_spar3():
             )
 
 
+@pytest.mark.slow
 def test_volturnus_native_bem_mixed_geometry():
     """Native panel solver on the full VolturnUS-S hull (potModMaster=2):
     three circular columns + rectangular pontoons in one mesh — physically

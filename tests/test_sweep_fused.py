@@ -49,6 +49,7 @@ def _apply_point(design, draft, ballast):
     return d
 
 
+@pytest.mark.slow
 def test_fused_sweep_sharded_matches_single_device():
     """The fused sweep's dynamics dispatch on a ('design',) mesh (the
     headline-number path sharded across chips, VERDICT r4 #2) must give
@@ -79,6 +80,7 @@ def test_fused_sweep_sharded_matches_single_device():
             mesh=mesh)
 
 
+@pytest.mark.slow
 def test_fused_sweep_matches_direct_model():
     """Every fused-sweep shortcut (ballast linearity, shared node bundles,
     batched mooring, in-graph statistics) must reproduce the plain
@@ -118,6 +120,7 @@ VOLTURNUS = "/root/reference/designs/VolturnUS-S.yaml"
     not __import__("os").path.exists(VOLTURNUS),
     reason="reference designs not mounted",
 )
+@pytest.mark.slow
 def test_fused_sweep_with_wind_matches_direct_model():
     """Operating-wind cases through the fused sweep (first-pass sharing,
     batched mean-pitch rotor re-evaluation, rank-1 hub a/b profiles in the
@@ -181,6 +184,7 @@ def test_scale_draft_only_touches_submerged_z():
             assert list(map(float, m0[key][:2])) == list(map(float, m1[key][:2]))
 
 
+@pytest.mark.slow
 def test_wind_cases_without_rotor_warn():
     """Operating-wind cases on an aero-off design run wind-free (the
     reference's aeroServoMod gate) but must warn loudly."""
@@ -201,6 +205,7 @@ def test_wind_cases_without_rotor_warn():
     not __import__("os").path.exists(VOLTURNUS),
     reason="reference designs not mounted",
 )
+@pytest.mark.slow
 def test_general_design_sweep_matches_direct_model():
     """The general design-list sweep (per-design geometry bundles, padded
     design axis, closed-form density trim) matches the direct Model path
@@ -302,6 +307,7 @@ def _bridled_semi_design():
     return design
 
 
+@pytest.mark.slow
 def test_bridled_design_sweep_matches_direct_model():
     """A bridled mooring system runs the fused design sweep (round-3 gap:
     both fused paths raised NotImplementedError) and matches the direct
@@ -342,6 +348,7 @@ def test_bridled_design_sweep_matches_direct_model():
     not __import__("os").path.exists(VOLTURNUS),
     reason="reference designs not mounted",
 )
+@pytest.mark.slow
 def test_guided_rotor_eval_matches_direct():
     """The phi-warm-started rotor evaluation (sweep second pass) agrees
     with the fully-bracketed path to roundoff — same residual, same
