@@ -13,6 +13,7 @@ Prints ONE JSON line:
    "vs_baseline": <numpy_seconds / jax_seconds>, ...}
 """
 
+import argparse
 import json
 import os
 import sys
@@ -22,6 +23,13 @@ import numpy as np
 
 _ROOT = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, _ROOT)
+
+# wall-clock budget for the whole bench run: once exceeded, remaining
+# sections are SKIPPED (recorded as "<name>_error": "skipped: ...") so
+# the driver-parseable line still prints before any external `timeout`
+# kills the process (round 5's rc=124 lost the entire run to exactly
+# that).  Override with --budget or BENCH_BUDGET_S.
+BENCH_BUDGET_S_DEFAULT = 840.0
 
 NW_MIN, NW_MAX = 0.00625, 0.8   # arange -> exactly 128 bins
 N_CASES = 12
@@ -50,14 +58,137 @@ _COMPACT_KEYS = (
     "bem_large_device_vs_cpu", "bem_conv_A_within_5pct",
     "bem_conv_X_within_5pct", "bem_stream_panels",
     "bem_stream_A_within_5pct", "bem_stream_error",
+    "bem_shard_devices", "bem_shard_speedup", "bem_shard_s",
     "grad_metrics", "grad_fd_rel_err",
-    "sweep_error", "sweep243_error", "bem_error", "grad_error",
+    "rao_error", "sweep_error", "sweep243_error", "bem_error",
+    "bem_sharded_error", "grad_error",
     "perf_docs_error", "sweep_scaling_error", "sweep1024_error",
     "sweep4096_error",
 )
 
 
-def main():
+def _write_full(out, path=None):
+    """Atomic (write-then-rename) dump of the accumulated results: called
+    after EVERY section so an external `timeout` kill loses at most the
+    section in flight, never the file (VERDICT r5 top_next)."""
+    path = path or BENCH_FULL
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(out, fh, indent=1)
+    os.replace(tmp, path)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny-mesh 2-frequency smoke run (tier-1 CI "
+                         "guard for the bench driver itself); does not "
+                         "touch BENCH_FULL.json/PERF.md unless --out "
+                         "points at them")
+    ap.add_argument("--budget", type=float,
+                    default=float(os.environ.get(
+                        "BENCH_BUDGET_S", BENCH_BUDGET_S_DEFAULT)),
+                    help="wall-clock seconds before remaining sections "
+                         "are skipped (<=0 disables the guard)")
+    ap.add_argument("--out", default=None,
+                    help="results JSON path (default BENCH_FULL.json; "
+                         "--smoke defaults to BENCH_SMOKE.json in the "
+                         "working directory)")
+    ap.add_argument("--write-perf", action="store_true",
+                    help="regenerate PERF.md + README headline from the "
+                         "recorded BENCH_FULL.json and exit")
+    args = ap.parse_args(argv)
+
+    if args.write_perf:
+        with open(BENCH_FULL) as fh:
+            update_perf_docs(json.load(fh))
+        return
+
+    full_path = args.out or (
+        os.path.join(os.getcwd(), "BENCH_SMOKE.json") if args.smoke
+        else BENCH_FULL)
+    t0 = time.monotonic()
+    deadline = t0 + args.budget if args.budget > 0 else None
+
+    if args.smoke:
+        sections = [("smoke", bench_smoke)]
+    else:
+        import bench_sweep
+
+        sections = [
+            # headline first: whatever the budget kills later, the
+            # driver line has its primary metric
+            ("rao", bench_rao),
+            ("sweep", lambda: bench_sweep.run(baseline_limit=48,
+                                              verbose=False)),
+            ("sweep_scaling", lambda: bench_sweep.run_scaling(
+                verbose=False)),
+            ("sweep243", lambda: bench_sweep.run_geometry(
+                baseline_limit=12, verbose=False)),
+            ("bem", bench_bem),
+            ("bem_sharded", bench_bem_sharded),
+            ("bem_stream", bench_bem_stream),
+            ("grad", bench_gradients),
+        ]
+
+    out = {}
+    for name, fn in sections:
+        if deadline is not None and time.monotonic() > deadline:
+            out[f"{name}_error"] = (
+                f"skipped: wall-clock budget "
+                f"({args.budget:.0f}s) exhausted")
+            _write_full(out, full_path)
+            continue
+        t_sec = time.monotonic()
+        try:
+            out.update(fn() or {})
+        except Exception as exc:
+            out[f"{name}_error"] = f"{type(exc).__name__}: {exc}"
+        out.setdefault("section_seconds", {})[name] = round(
+            time.monotonic() - t_sec, 1)
+        _write_full(out, full_path)
+
+    # regenerated docs (full runs only), compact line to the driver
+    if not args.smoke:
+        try:
+            update_perf_docs(out)
+        except Exception as exc:  # pragma: no cover - defensive
+            out["perf_docs_error"] = f"{type(exc).__name__}: {exc}"
+    out["bench_wall_s"] = round(time.monotonic() - t0, 1)
+    _write_full(out, full_path)
+    print(json.dumps(compact_results(out)))
+
+
+def bench_smoke(nw=2):
+    """Tier-1-safe smoke section: a tiny spar mesh through the native BEM
+    solve (2 frequencies) — exercises the section runner, the
+    incremental writer, and the compact-line path in seconds, so a
+    broken bench driver is caught by `pytest -m 'not slow'` instead of
+    by a lost driver round."""
+    import jax
+
+    from raft_tpu.bem_solver import solve_bem
+    from raft_tpu.mesh import clip_waterplane, mesh_member
+
+    t0 = time.perf_counter()
+    panels = clip_waterplane(mesh_member(
+        [0, 22], [6.5, 6.5], np.array([0.0, 0.0, -20.0]),
+        np.array([0.0, 0.0, 2.0]), 7.0, 9.0))
+    w = np.linspace(0.4, 0.9, nw)
+    res = solve_bem(panels, w)
+    assert np.isfinite(res["A"]).all() and np.isfinite(res["X"]).all()
+    return {
+        "metric": f"smoke: {len(panels)}-panel BEM solve ({nw} freq)",
+        "value": round(time.perf_counter() - t0, 3),
+        "unit": "s",
+        "smoke_panels": int(res["npanels"]),
+        "smoke_nw": nw,
+        "smoke_sharded": res.get("sharded", ""),
+        "backend": jax.default_backend(),
+    }
+
+
+def bench_rao():
     import jax
 
     from __graft_entry__ import _flagship_design
@@ -201,66 +332,52 @@ def main():
         "rao_linf_err": rao_err,
         "backend": jax.default_backend(),
     }
+    return out
 
-    # ---- north-star sweep benchmark: 256-design draft x ballast sweep
-    # with the full aero-servo physics in BOTH paths (BASELINE.json
-    # configs[3]; the reference sweep runs the whole model per point).
-    # The serial baseline is timed on 48 of the 256 designs and scaled
-    # linearly (per-design cost is constant; ~5 s/design x 256 would be
-    # ~21 min of driver bench time).  Guarded so the headline metric
-    # always prints. ----
-    try:
-        import bench_sweep
 
-        out.update(bench_sweep.run(baseline_limit=48, verbose=False))
-    except Exception as exc:  # pragma: no cover - defensive for the driver
-        out["sweep_error"] = f"{type(exc).__name__}: {exc}"
+def bench_bem_sharded(nw=16):
+    """Multi-device BEM frequency sharding (the tentpole figure): the
+    same OC3-style mesh solved with the [nw] frequency batch laid across
+    all local devices (NamedSharding over a 1-D 'freq' mesh, the
+    sweep.py pattern) vs forced single-device, warm numbers, with L-inf
+    agreement asserted.  Skipped when only one device exists."""
+    import jax
 
-    # ---- throughput knee: 1024- and 4096-design fused sweeps ----
-    try:
-        out.update(bench_sweep.run_scaling(verbose=False))
-    except Exception as exc:  # pragma: no cover - defensive for the driver
-        out["sweep_scaling_error"] = f"{type(exc).__name__}: {exc}"
+    from raft_tpu.bem_solver import solve_bem
+    from raft_tpu.designs import deep_spar
+    from raft_tpu.mesh import mesh_platform
+    from raft_tpu.model import Model
 
-    # ---- the reference's 5-parameter geometry study: 3^5 = 243 points
-    # with dependent geometry, fairlead repositioning, and ballast trim
-    # (reference raft/parametersweep.py:40-100) ----
-    try:
-        out.update(bench_sweep.run_geometry(baseline_limit=12,
-                                            verbose=False))
-    except Exception as exc:  # pragma: no cover - defensive for the driver
-        out["sweep243_error"] = f"{type(exc).__name__}: {exc}"
+    backend = jax.default_backend()
+    n_dev = len(jax.local_devices())
+    if n_dev < 2:
+        return {"bem_shard_devices": 1}
+    design = deep_spar(n_cases=1)
+    design["platform"]["members"][0]["potMod"] = True
+    m = Model(design)
+    panels = mesh_platform(m.members, dz_max=2.5, da_max=2.5)
+    w = np.linspace(0.2, 1.2, nw)
 
-    # ---- native BEM radiation/diffraction assembly+solve timing: the OC3
-    # spar mesh on the default backend (TPU here) vs CPU, warm numbers ----
-    try:
-        out.update(bench_bem())
-    except Exception as exc:  # pragma: no cover - defensive for the driver
-        out["bem_error"] = f"{type(exc).__name__}: {exc}"
+    def timed(n_devices):
+        solve_bem(panels, w, backend=backend, n_devices=n_devices)  # warm
+        t0 = time.perf_counter()
+        res = solve_bem(panels, w, backend=backend, n_devices=n_devices)
+        return time.perf_counter() - t0, res
 
-    # ---- out-of-core BEM: one >12k-panel streamed solve (VERDICT r4 #8:
-    # the last capability delta vs HAMS's arbitrary mesh sizes) ----
-    try:
-        out.update(bench_bem_stream())
-    except Exception as exc:  # pragma: no cover - defensive for the driver
-        out["bem_stream_error"] = f"{type(exc).__name__}: {exc}"
-
-    # ---- end-to-end design-gradient validation (the differentiable-
-    # design capability; full validation lives in tests/test_parametric,
-    # this records a 2-column AD-vs-FD spot check in the artifact) ----
-    try:
-        out.update(bench_gradients())
-    except Exception as exc:  # pragma: no cover - defensive for the driver
-        out["grad_error"] = f"{type(exc).__name__}: {exc}"
-
-    # full results to disk + regenerated docs, compact line to the driver
-    try:
-        update_perf_docs(out)
-    except Exception as exc:  # pragma: no cover - defensive for the driver
-        out["perf_docs_error"] = f"{type(exc).__name__}: {exc}"
-    with open(BENCH_FULL, "w") as fh:
-        json.dump(out, fh, indent=1)
-    print(json.dumps(compact_results(out)))
+    t_1, res_1 = timed(1)
+    t_n, res_n = timed(None)
+    rel = float(np.abs(res_n["A"] - res_1["A"]).max()
+                / np.abs(res_1["A"]).max())
+    return {
+        "bem_shard_panels": len(panels),
+        "bem_shard_nw": nw,
+        "bem_shard_devices": int(res_n.get("n_devices", 1)),
+        "bem_shard_mode": res_n.get("sharded", ""),
+        "bem_shard_single_s": round(t_1, 3),
+        "bem_shard_s": round(t_n, 3),
+        "bem_shard_speedup": round(t_1 / t_n, 2),
+        "bem_shard_A_linf_rel": rel,
+    }
 
 
 def bench_bem_stream(nw=2):
@@ -440,6 +557,15 @@ def perf_md_text(d):
         row(f"full-hull mesh-convergence anchor "
             f"({'/'.join(str(p) for p in d.get('bem_conv_panels', []))} "
             "panels)", cell)
+    if d.get("bem_shard_devices", 0) > 1:
+        row(f"**multi-device BEM frequency sharding, "
+            f"{d.get('bem_shard_panels')} panels × "
+            f"{d.get('bem_shard_nw')} freq × "
+            f"{d['bem_shard_devices']} devices**",
+            f"**{_fmt(d.get('bem_shard_s'))} s vs "
+            f"{_fmt(d.get('bem_shard_single_s'))} s single-device "
+            f"({_fmt(d.get('bem_shard_speedup'), 1)}×)**; A L∞ "
+            f"{d.get('bem_shard_A_linf_rel', 0.0):.1e} rel")
     if "bem_stream_panels" in d:
         row(f"out-of-core streamed BEM, {d['bem_stream_panels']} panels "
             f"× {d.get('bem_stream_nw')} freq",
@@ -538,10 +664,14 @@ def bench_bem(nw=8, nw_large=4):
 
     def timed(panels, w, bk):
         # warm-up carries the cost query so the timed call stays clean
-        # (the flops count is shape-determined, identical across calls)
-        warm = solve_bem(panels, w, backend=bk, report_cost=True)
+        # (the flops count is shape-determined, identical across calls);
+        # n_devices=1 keeps this figure's single-chip semantics
+        # comparable across rounds — the multi-device scaling figure is
+        # bench_bem_sharded's bem_shard_* block
+        warm = solve_bem(panels, w, backend=bk, report_cost=True,
+                         n_devices=1)
         t0 = time.perf_counter()
-        out = solve_bem(panels, w, backend=bk)
+        out = solve_bem(panels, w, backend=bk, n_devices=1)
         dt = time.perf_counter() - t0
         out["flops"] = warm.get("flops", 0.0)
         return dt, out
@@ -610,7 +740,10 @@ def _bench_bem_converge(backend):
     if not os.path.exists(path):
         return {}
     t0 = time.perf_counter()
-    sols, rel, rel_X = full_hull_convergence(path, backend=backend)
+    # single-device: round-over-round comparability (the sharded figure
+    # lives in bem_shard_*)
+    sols, rel, rel_X = full_hull_convergence(path, backend=backend,
+                                             n_devices=1)
     return {
         "bem_conv_panels": [sols["fine"]["npanels"],
                             sols["xfine"]["npanels"]],
@@ -625,8 +758,4 @@ def _bench_bem_converge(backend):
 
 
 if __name__ == "__main__":
-    if "--write-perf" in sys.argv:
-        with open(BENCH_FULL) as _fh:
-            update_perf_docs(json.load(_fh))
-    else:
-        main()
+    main(sys.argv[1:])

@@ -43,6 +43,10 @@ class HydroCoeffs:
     X: np.ndarray = None
     A0: np.ndarray = None
     Ainf: np.ndarray = None
+    # native-solver provenance (None for imported WAMIT/Capytaine data):
+    # panel counts plus the execution route the coefficients took —
+    # {"npanels", "npanels_solved", "sharded", "n_devices", "streamed"}
+    solver_info: dict = None
 
 
 def read_wamit_1(path, rho=1025.0):

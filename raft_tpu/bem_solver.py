@@ -22,7 +22,13 @@ one-shot meshes (the graph specializes on the mesh shape; see solve_bem):
     cross the host-device boundary (re/im split),
   * added mass A(w), radiation damping B(w) about the PRP from the radiation
     potentials, and wave excitation X(w, beta) from the diffraction solve
-    (Haskind available as a cross-check in tests).
+    (Haskind available as a cross-check in tests),
+  * multi-device: per-frequency problems are independent (the WAMIT/HAMS
+    per-omega formulation), so with >1 local device the [nw] frequency
+    batch (or the flattened frequency x heading batch when nw alone
+    would underfill) lays across a 1-D device mesh with an explicit
+    NamedSharding — the same pattern sweep.py uses for the design axis —
+    with automatic single-device fallback (see solve_bem / _run_sharded).
 
 Time convention matches the reference (e^{+i w t}; impedance
 Z = -w^2 M + i w B + C, reference raft/raft_model.py:585-590), so the wave
@@ -240,26 +246,12 @@ def _radiation_normals(pa):
     return np.concatenate([pa.nrm.T, rxn.T], axis=0)  # [6, N]
 
 
-def _blocked_gj(A, b, block=512):
-    """Solve ``A x = b`` for a well-conditioned dense real system by
-    blocked Gauss-Jordan elimination: per-step pivot-block inversion
-    (jnp.linalg.inv on [block, block] tiles) + full-matrix matmul updates.
-
-    Every O(n^3) flop is an MXU matmul and no LU custom call ever exceeds
-    ``block`` rows — this is what lets the TPU backend solve past the
-    LuDecompositionBlock scoped-VMEM ceiling (observed on v5e: clean
-    compile failure at 16k rows, runtime worker crash at 5800 rows; the
-    reference's external solver HAMS runs arbitrary mesh sizes,
-    reference raft/raft_fowt.py:391).
-
-    No inter-block pivoting (rows pivot only inside each tile's LU): valid
-    because the BEM boundary operator -1/2 I + K/4pi is a compact
-    perturbation of -1/2 I, so every leading Schur complement stays
-    uniformly invertible at practical mesh densities (validated against
-    the complex-LU CPU path in tests/test_bem_solver.py).
-
-    A : [n, n] with n a multiple of ``block``; b : [n, m].  Returns x.
-    """
+def _gj_stage(A, b, kb0, nblk, block=512):
+    """Run ``nblk`` consecutive elimination steps (starting at block row
+    ``kb0``) of the blocked Gauss-Jordan on the in-progress system
+    ``(A, b)``.  ``kb0``/``nblk`` may be traced scalars, so ONE compiled
+    executable serves every stage of a staged (multi-dispatch)
+    elimination — the streamed path's solve-stage banding."""
     import jax
     import jax.numpy as jnp
 
@@ -287,7 +279,32 @@ def _blocked_gj(A, b, block=512):
         b = jax.lax.dynamic_update_slice(b, brow, (k0, 0))
         return A, b
 
-    _, x = jax.lax.fori_loop(0, n // block, step, (A, b))
+    return jax.lax.fori_loop(kb0, kb0 + nblk, step, (A, b))
+
+
+def _blocked_gj(A, b, block=512):
+    """Solve ``A x = b`` for a well-conditioned dense real system by
+    blocked Gauss-Jordan elimination: per-step pivot-block inversion
+    (jnp.linalg.inv on [block, block] tiles) + full-matrix matmul updates
+    (the step body lives in :func:`_gj_stage` so the streamed path can
+    split the same elimination across watchdog-sized dispatches).
+
+    Every O(n^3) flop is an MXU matmul and no LU custom call ever exceeds
+    ``block`` rows — this is what lets the TPU backend solve past the
+    LuDecompositionBlock scoped-VMEM ceiling (observed on v5e: clean
+    compile failure at 16k rows, runtime worker crash at 5800 rows; the
+    reference's external solver HAMS runs arbitrary mesh sizes,
+    reference raft/raft_fowt.py:391).
+
+    No inter-block pivoting (rows pivot only inside each tile's LU): valid
+    because the BEM boundary operator -1/2 I + K/4pi is a compact
+    perturbation of -1/2 I, so every leading Schur complement stays
+    uniformly invertible at practical mesh densities (validated against
+    the complex-LU CPU path in tests/test_bem_solver.py).
+
+    A : [n, n] with n a multiple of ``block``; b : [n, m].  Returns x.
+    """
+    _, x = _gj_stage(A, b, 0, A.shape[0] // block, block=block)
     return x
 
 
@@ -353,6 +370,11 @@ def _solve_all(omegas, betas, x, nrm, area, y, w_q, S0, K0, vmodes, jump,
     Chebyshev basis matmuls stay in modest [E, deg] blocks; a (F, F1)
     tuple (greens.load_tables) runs the bilinear-lookup kernel in one
     whole-matrix sweep — the CPU path, where gathers are cheap.
+
+    ``betas`` [nbeta] is shared by every frequency; a 2-D ``betas``
+    [nw, nbeta] maps a heading row alongside each frequency — the
+    flattened frequency x heading layout the multi-device sharding uses
+    when nw alone would underfill the mesh (see solve_bem).
     """
     import jax
     import jax.numpy as jnp
@@ -368,7 +390,7 @@ def _solve_all(omegas, betas, x, nrm, area, y, w_q, S0, K0, vmodes, jump,
     # `finite` is the only static piece of the depth handling — depth and
     # kmax_geom stay traced operands so a draft/depth sweep at a fixed
     # mesh shape reuses one compiled executable
-    def one_omega(omega):
+    def one_omega(omega, bet):
         nu = omega * omega / g
         k0 = greens.dispersion_k0(nu, depth) if finite else nu
 
@@ -388,39 +410,27 @@ def _solve_all(omegas, betas, x, nrm, area, y, w_q, S0, K0, vmodes, jump,
 
         S = S0.astype(c) + Sw
         K = K0.astype(c) + Kw
-        return _post_assembly(omega, nu, k0, S, K, betas, x, nrm, area,
+        return _post_assembly(omega, nu, k0, S, K, bet, x, nrm, area,
                               vmodes, jump, g, rho, real_block, depth,
                               finite)
 
     # TPU f32 matmuls default to bf16 passes; the influence sums and the
     # block solve need the full f32 path
     with jax.default_matmul_precision("highest"):
-        return jax.lax.map(one_omega, omegas)
+        if betas.ndim == 2:
+            return jax.lax.map(lambda ob: one_omega(*ob), (omegas, betas))
+        return jax.lax.map(lambda om: one_omega(om, betas), omegas)
 
 
-def _post_assembly(omega, nu, k0, S, K, betas, x, nrm, area, vmodes, jump,
-                   g, rho, real_block, depth, finite):
-    """From assembled influence matrices to (A, B, Xr, Xi) for one
-    frequency (the solve + pressure-integral tail of _solve_all's
-    one_omega; shared with the streamed large-mesh path)."""
+def _incident_wave(omega, nu, k0, betas, x, nrm, g, depth, finite):
+    """Incident-wave potential phiI [nb, N] and its normal derivative
+    dphiIdn [nb, N] at the collocation points; finite depth uses the
+    cosh-profile incident wave at wavenumber k0 (written in decaying
+    exponentials; reduces to e^{nu z} as k0 h -> inf)."""
     import jax.numpy as jnp
 
-    f = jnp.float32
-    c = jnp.complex64
-    N = x.shape[0]
     cosb = jnp.cos(betas)[:, None]
     sinb = jnp.sin(betas)[:, None]
-    # exterior (fluid-side) limit of the single-layer normal derivative:
-    # dphi/dn = jump*sigma + K' sigma with jump = -1/2 on body rows
-    # (pulsating-sphere eigenvalue check K'[1] = -1/2 fixes the sign;
-    # see tests/test_bem_solver.py) and LID_JUMP on interior
-    # free-surface rows (their coincident image doubles the layer)
-    lhs = K / (4 * jnp.pi) + jnp.diag(jump).astype(c)
-
-    # radiation RHS (unit velocity) + diffraction RHS per heading;
-    # finite depth uses the cosh-profile incident wave at wavenumber k0
-    # (written in decaying exponentials; reduces to e^{nu z} as
-    # k0 h -> inf)
     kx = x[None, :, 0] * cosb + x[None, :, 1] * sinb          # [nb,N]
     if finite:
         Eh = jnp.exp(-2.0 * k0 * depth)
@@ -437,25 +447,30 @@ def _post_assembly(omega, nu, k0, S, K, betas, x, nrm, area, vmodes, jump,
     dphiIdn = (-1j * k0 * cosb * phiI * nrm[None, :, 0]
                - 1j * k0 * sinb * phiI * nrm[None, :, 1]
                + phiIz * nrm[None, :, 2])
+    return phiI, dphiIdn
 
-    rhs = jnp.concatenate([vmodes.astype(c), -dphiIdn], axis=0)  # [6+nb,N]
-    if real_block:
-        Ar, Ai = jnp.real(lhs), jnp.imag(lhs)
-        A2 = jnp.concatenate(
-            [jnp.concatenate([Ar, -Ai], axis=1),
-             jnp.concatenate([Ai, Ar], axis=1)], axis=0,
-        )                                                      # [2N,2N]
-        b2 = jnp.concatenate([jnp.real(rhs), jnp.imag(rhs)], axis=1).T
-        if N > 1024 and (2 * N) % 512 == 0:
-            # past the TPU LU custom call's comfort zone: blocked
-            # Gauss-Jordan, all matmuls (padding in solve_bem
-            # guarantees the 512-row block multiple)
-            sol = _blocked_gj(A2, b2, block=512)               # [2N,6+nb]
-        else:
-            sol = jnp.linalg.solve(A2, b2)                     # [2N,6+nb]
-        sigma = (sol[:N] + 1j * sol[N:]).T                     # [6+nb,N]
-    else:
-        sigma = jnp.linalg.solve(lhs, rhs.T).T                 # [6+nb,N]
+
+def _real_block_system(lhs, rhs):
+    """The equivalent real 2N x 2N block system of the dense complex
+    system lhs sigma = rhs: [[Kr, -Ki], [Ki, Kr]] [sr; si] = [br; bi]
+    (the dense complex LU has no TPU lowering; real f32 LU does)."""
+    import jax.numpy as jnp
+
+    Ar, Ai = jnp.real(lhs), jnp.imag(lhs)
+    A2 = jnp.concatenate(
+        [jnp.concatenate([Ar, -Ai], axis=1),
+         jnp.concatenate([Ai, Ar], axis=1)], axis=0,
+    )                                                          # [2N,2N]
+    b2 = jnp.concatenate([jnp.real(rhs), jnp.imag(rhs)], axis=1).T
+    return A2, b2
+
+
+def _integrate_outputs(omega, sigma, S, phiI, area, vmodes, rho):
+    """Pressure-integral tail shared by every solve path: source strengths
+    sigma [6+nb, N] -> (A, B, Xr, Xi) f32 for one frequency."""
+    import jax.numpy as jnp
+
+    f = jnp.float32
     phi = sigma @ (S.T / (4 * jnp.pi))                         # [6+nb,N]
 
     # radiation coefficients: rho int phi_k n_i dS = -A_ik + i B_ik / w
@@ -470,61 +485,133 @@ def _post_assembly(omega, nu, k0, S, K, betas, x, nrm, area, vmodes, jump,
         jnp.imag(X).astype(f)
 
 
-def _streamed_band_fn(tables, g, finite, rb=32):
-    """Jitted band assembly for the streamed large-mesh path: one call
-    assembles the wave-term influence rows of a band of collocation
-    points against the whole mesh and LEAVES the result on device (f32
-    re/im parts; complex never crosses the host-device boundary).
-    Returns fn(omega, xb, nb_, y, w_q, depth, kmax_geom) ->
-    (Sr, Si, Kr, Ki) [nbd, N]."""
+def _post_assembly(omega, nu, k0, S, K, betas, x, nrm, area, vmodes, jump,
+                   g, rho, real_block, depth, finite):
+    """From assembled influence matrices to (A, B, Xr, Xi) for one
+    frequency (the solve + pressure-integral tail of _solve_all's
+    one_omega; shared with the streamed large-mesh path)."""
+    import jax.numpy as jnp
+
+    c = jnp.complex64
+    N = x.shape[0]
+    # exterior (fluid-side) limit of the single-layer normal derivative:
+    # dphi/dn = jump*sigma + K' sigma with jump = -1/2 on body rows
+    # (pulsating-sphere eigenvalue check K'[1] = -1/2 fixes the sign;
+    # see tests/test_bem_solver.py) and LID_JUMP on interior
+    # free-surface rows (their coincident image doubles the layer)
+    lhs = K / (4 * jnp.pi) + jnp.diag(jump).astype(c)
+
+    # radiation RHS (unit velocity) + diffraction RHS per heading
+    phiI, dphiIdn = _incident_wave(omega, nu, k0, betas, x, nrm, g,
+                                   depth, finite)
+    rhs = jnp.concatenate([vmodes.astype(c), -dphiIdn], axis=0)  # [6+nb,N]
+    if real_block:
+        A2, b2 = _real_block_system(lhs, rhs)
+        if N > 1024 and (2 * N) % 512 == 0:
+            # past the TPU LU custom call's comfort zone: blocked
+            # Gauss-Jordan, all matmuls (padding in solve_bem
+            # guarantees the 512-row block multiple)
+            sol = _blocked_gj(A2, b2, block=512)               # [2N,6+nb]
+        else:
+            sol = jnp.linalg.solve(A2, b2)                     # [2N,6+nb]
+        sigma = (sol[:N] + 1j * sol[N:]).T                     # [6+nb,N]
+    else:
+        sigma = jnp.linalg.solve(lhs, rhs.T).T                 # [6+nb,N]
+    return _integrate_outputs(omega, sigma, S, phiI, area, vmodes, rho)
+
+
+# jitted streamed-path executables cached at module level, keyed on
+# (D, rows, N, finite) plus the physics scalars baked into the closures —
+# mirroring _solve_all_jit, so repeat streamed solves of the same mesh
+# shape reuse warm programs instead of rebuilding fresh jax.jit wrappers
+# (and recompiling) every call (ADVICE r5)
+_stream_fn_cache = {}
+
+
+def _streamed_fns(D, rows, N, finite, g, rho, rb=32):
+    """The four jitted stages of the streamed out-of-core path for one
+    (band count, band rows, mesh size, depth regime) configuration:
+
+      band(omega, xb, nb_, y, w_q, tables, depth, kmax) -> 4 x [rows, N]
+          wave-term influence rows of one collocation band (f32 re/im;
+          complex never crosses the host-device boundary),
+      system(omega, betas, x, nrm, S0, K0, vmodes, jump, depth, *bands)
+          -> (A2, b2, Sf_r, Sf_i, phiI_r, phiI_i): concatenates the
+          bands (donated — XLA may alias their memory straight into the
+          full matrices) and assembles the real 2N x 2N block system,
+      stage(A2, b2, kb0, nblk): ``nblk`` blocked Gauss-Jordan steps
+          (traced bounds — one executable serves every stage; A2/b2
+          donated so the elimination ping-pongs two HBM buffers),
+      finish(omega, sol, Sf_r, Sf_i, phiI_r, phiI_i, area, vmodes)
+          -> (A, B, Xr, Xi): source strengths to coefficients.
+    """
     import jax
     import jax.numpy as jnp
 
-    def band(omega, xb, nb_, y, w_q, depth, kmax_geom):
+    key = (D, rows, N, finite, float(g), float(rho), rb)
+    hit = _stream_fn_cache.get(key)
+    if hit is not None:
+        return hit
+
+    def band(omega, xb, nb_, y, w_q, tables, depth, kmax_geom):
         nu = omega * omega / g
         k0 = greens.dispersion_k0(nu, depth) if finite else nu
         nbd = xb.shape[0]
         nblk = nbd // rb
 
-        def rows(args):
+        def rows_fn(args):
             return _wave_rows(nu, k0, args[0], args[1], y, w_q, tables,
                               depth, kmax_geom, finite)
 
         with jax.default_matmul_precision("highest"):
             Sw, Kw = jax.lax.map(
-                rows, (xb.reshape(nblk, rb, 3), nb_.reshape(nblk, rb, 3)))
-        N = y.shape[0]
-        Sw = Sw.reshape(nbd, N)
-        Kw = Kw.reshape(nbd, N)
+                rows_fn,
+                (xb.reshape(nblk, rb, 3), nb_.reshape(nblk, rb, 3)))
+        Nf = y.shape[0]
+        Sw = Sw.reshape(nbd, Nf)
+        Kw = Kw.reshape(nbd, Nf)
         return (jnp.real(Sw), jnp.imag(Sw), jnp.real(Kw), jnp.imag(Kw))
 
-    return jax.jit(band)
-
-
-def _streamed_solve_fn(n_bands, g, rho, finite):
-    """Jitted per-frequency solve for the streamed path: concatenates the
-    assembled bands (donated — XLA may alias their memory straight into
-    the full matrices) and runs the shared post-assembly solve."""
-    import jax
-    import jax.numpy as jnp
-
-    def solve(omega, betas, x, nrm, area, S0, K0, vmodes, jump, depth,
-              *bands):
-        Sr = jnp.concatenate(bands[:n_bands])
-        Si = jnp.concatenate(bands[n_bands:2 * n_bands])
-        Kr = jnp.concatenate(bands[2 * n_bands:3 * n_bands])
-        Ki = jnp.concatenate(bands[3 * n_bands:])
+    def system(omega, betas, x, nrm, S0, K0, vmodes, jump, depth, *bands):
+        Sr = jnp.concatenate(bands[:D])
+        Si = jnp.concatenate(bands[D:2 * D])
+        Kr = jnp.concatenate(bands[2 * D:3 * D])
+        Ki = jnp.concatenate(bands[3 * D:])
         c = jnp.complex64
         S = S0.astype(c) + (Sr + 1j * Si)
         K = K0.astype(c) + (Kr + 1j * Ki)
         nu = omega * omega / g
         k0 = greens.dispersion_k0(nu, depth) if finite else nu
+        lhs = K / (4 * jnp.pi) + jnp.diag(jump).astype(c)
+        phiI, dphiIdn = _incident_wave(omega, nu, k0, betas, x, nrm, g,
+                                       depth, finite)
+        rhs = jnp.concatenate([vmodes.astype(c), -dphiIdn], axis=0)
         with jax.default_matmul_precision("highest"):
-            return _post_assembly(
-                omega, nu, k0, S, K, betas, x, nrm, area, vmodes, jump,
-                g, rho, True, depth, finite)
+            A2, b2 = _real_block_system(lhs, rhs)
+        return (A2, b2, jnp.real(S), jnp.imag(S),
+                jnp.real(phiI), jnp.imag(phiI))
 
-    return jax.jit(solve, donate_argnums=tuple(range(10, 10 + 4 * n_bands)))
+    def stage(A2, b2, kb0, nblk):
+        with jax.default_matmul_precision("highest"):
+            return _gj_stage(A2, b2, kb0, nblk, block=512)
+
+    def finish(omega, sol, Sf_r, Sf_i, phiI_r, phiI_i, area, vmodes):
+        Nn = Sf_r.shape[0]
+        sigma = (sol[:Nn] + 1j * sol[Nn:]).T               # [6+nb,N]
+        S = Sf_r + 1j * Sf_i
+        phiI = phiI_r + 1j * phiI_i
+        with jax.default_matmul_precision("highest"):
+            return _integrate_outputs(omega, sigma, S, phiI, area, vmodes,
+                                      rho)
+
+    hit = (
+        jax.jit(band),
+        jax.jit(system, donate_argnums=tuple(range(9, 9 + 4 * D))),
+        jax.jit(stage, donate_argnums=(0, 1)),
+        jax.jit(finish),
+    )
+    _stream_fn_cache[key] = hit
+    return hit
 
 
 _solve_all_jit = None
@@ -551,10 +638,138 @@ _RANKINE_CACHE_BYTES = 256 * 1024 * 1024
 # Above the limit solve_bem switches to the STREAMED out-of-core path
 # (_run_streamed): the per-frequency assembly is split into row bands,
 # each its own dispatch (device arrays persist in HBM between
-# dispatches), followed by one solve dispatch — removing the dispatch-
-# time ceiling so mesh size is bounded by HBM (~16k panels on 16 GB),
-# like HAMS is bounded by host memory.
+# dispatches), followed by a system-assembly dispatch, >= 2 staged
+# blocked-Gauss-Jordan solve dispatches (the O((2N)^3) elimination is
+# ~6 s/frequency at the 16k-panel ceiling and grows cubically — it gets
+# banded like the assembly), and a pressure-integral dispatch — removing
+# the dispatch-time ceiling so mesh size is bounded by HBM (~16k panels
+# on 16 GB), like HAMS is bounded by host memory.
 TPU_PANEL_LIMIT = 10240
+
+
+# jitted multi-device (shard_map) solve executables keyed on the device
+# set + physics statics; jit's own cache handles array shapes
+_sharded_fn_cache = {}
+
+
+def _sharded_solve_fn(mesh, g, rho, real_block, finite, betas_mapped):
+    """Jitted shard_map wrapper of _solve_all laying the frequency batch
+    across ``mesh``'s 'freq' axis — the same NamedSharding pattern that
+    shards the design axis in sweep.py.  Per-frequency solves are
+    independent (WAMIT/HAMS-style per-omega problems), so each device
+    runs its frequency shard's lax.map with zero communication.
+
+    ``betas_mapped`` selects the flattened frequency x heading layout:
+    betas then carries a per-frequency heading row [n, 1] sharded
+    alongside omegas instead of a replicated [nbeta] vector."""
+    import jax
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    key = (tuple(mesh.devices.flat), float(g), float(rho),
+           bool(real_block), bool(finite), bool(betas_mapped))
+    hit = _sharded_fn_cache.get(key)
+    if hit is not None:
+        return hit
+
+    def body(om, bet, x, nrm, area, y, wq, S0, K0, vmodes, jump, tables,
+             depth, kmax):
+        return _solve_all(om, bet, x, nrm, area, y, wq, S0, K0, vmodes,
+                          jump, tables, g, rho, real_block, depth, kmax,
+                          finite)
+
+    spec_b = P("freq") if betas_mapped else P()
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(P("freq"), spec_b) + (P(),) * 12,
+        out_specs=P("freq"),
+    )
+    hit = jax.jit(fn)
+    _sharded_fn_cache[key] = hit
+    return hit
+
+
+def _run_sharded(omegas, betas, static_pre, mesh, mode, n, report_cost):
+    """Multi-device execution of the batched solve: frequencies (or, in
+    'freqbeta' mode, flattened frequency x heading pairs) are laid across
+    the device mesh, repeat-padded to fill every shard, and dispatched in
+    watchdog-sized chunks exactly like the single-device path — each
+    dispatch now solves n_devices shards concurrently.
+
+    Returns (A, B, Xr, Xi, flops) host arrays in the caller's layout
+    (A/B [nw,6,6]; Xr/Xi [nw, nbeta, 6]); flops is None unless
+    ``report_cost``."""
+    import jax
+
+    from raft_tpu.utils.placement import batch_sharding
+
+    (betas_d, x_d, nrm_d, area_d, y_d, wq_d, S0_d, K0_d, vmodes_d,
+     jump_d, tables_d, g, rho, real_block, depth_d, kmax_d,
+     finite) = static_pre
+
+    n_dev = int(mesh.devices.size)
+    sh = batch_sharding(mesh, "freq")
+    omegas = np.atleast_1d(np.asarray(omegas, float))
+    nw = len(omegas)
+    betas_mapped = mode == "freqbeta"
+    if betas_mapped:
+        # underfilled frequency axis: solve (omega, heading) pairs, one
+        # heading per lane (the radiation part is recomputed per lane —
+        # the utilization trade this mode exists for)
+        nb = len(betas)
+        items_om = np.repeat(omegas, nb)
+        items_bet = np.tile(np.asarray(betas, float), nw)[:, None]
+    else:
+        items_om = omegas
+        items_bet = None
+    n_items = len(items_om)
+
+    fn = _sharded_solve_fn(mesh, g, rho, real_block, finite, betas_mapped)
+
+    # per-DEVICE dispatch budget: each dispatch runs chunk_dev
+    # frequencies per device concurrently, so the wall-clock per dispatch
+    # is chunk_dev * per_freq_s regardless of n_dev
+    chunk_dev = int(np.ceil(n_items / n_dev))
+    if real_block:
+        per_freq_s = max((n / 4864.0) ** 2 * 11.0, 1e-3)
+        if chunk_dev * per_freq_s > 45.0:
+            chunk_dev = max(1, int(45.0 / per_freq_s))
+    chunk_total = chunk_dev * n_dev
+
+    parts = []
+    last_args = None
+    for i in range(0, n_items, chunk_total):
+        om = items_om[i:i + chunk_total]
+        bet = items_bet[i:i + chunk_total] if betas_mapped else None
+        if len(om) < chunk_total:      # repeat-pad: same compiled shape
+            padn = chunk_total - len(om)
+            om = np.concatenate([om, np.repeat(om[-1:], padn)])
+            if betas_mapped:
+                bet = np.concatenate(
+                    [bet, np.repeat(bet[-1:], padn, axis=0)])
+        om_d = jax.device_put(np.asarray(om, np.float32), sh)
+        bet_d = (jax.device_put(np.asarray(bet, np.float32), sh)
+                 if betas_mapped else betas_d)
+        last_args = (om_d, bet_d, x_d, nrm_d, area_d, y_d, wq_d, S0_d,
+                     K0_d, vmodes_d, jump_d, tables_d, depth_d, kmax_d)
+        parts.append(fn(*last_args))
+    A, B, Xr, Xi = (
+        np.concatenate([np.asarray(p[j]) for p in parts])[:n_items]
+        for j in range(4)
+    )
+    if betas_mapped:
+        nb = len(betas)
+        A = A[::nb]                     # radiation: one copy per omega
+        B = B[::nb]
+        Xr = Xr[:, 0, :].reshape(nw, nb, 6)
+        Xi = Xi[:, 0, :].reshape(nw, nb, 6)
+
+    flops = None
+    if report_cost:
+        from raft_tpu.utils.profiling import compiled_flops
+
+        flops = compiled_flops(fn, last_args) * (n_items / chunk_total)
+    return A, B, Xr, Xi, flops
 
 
 # lid-row jump coefficient of the extended integral equation: the
@@ -571,7 +786,7 @@ LID_JUMP = 1.0
 
 def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81,
               quad="gauss", backend=None, depth=np.inf, lid_panels=None,
-              report_cost=False):
+              report_cost=False, n_devices=None):
     """Radiation + diffraction solve over frequencies.
 
     panels : [npan,4,3] wetted-hull panels (outward normals)
@@ -595,6 +810,16 @@ def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81,
         re-solved (persistent compilation cache makes later processes
         warm; a warm TPU solve measures ~1.3-4.6x faster than CPU).
         Meshes above TPU_PANEL_LIMIT panels fall back to CPU.
+    n_devices : int | None — cap on the local devices the frequency batch
+        is sharded over (None = all local devices of the backend; 1
+        forces the single-device path).  With >1 devices and enough
+        frequencies to fill them, the [nw] batch is laid across a 1-D
+        'freq' mesh with an explicit NamedSharding (the sweep.py
+        pattern); when nw alone would underfill the mesh but nw * nbeta
+        fills it, the flattened frequency x heading batch is sharded
+        instead.  Falls back to the single-device path automatically
+        when neither fills the mesh, when only one device exists, or on
+        the streamed out-of-core path.
     Returns dict with A [nw,6,6], B [nw,6,6] and X [nw, nbeta, 6] complex
     (excitation per unit wave amplitude, e^{+iwt} convention, PRP-referenced).
     """
@@ -630,7 +855,7 @@ def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81,
         logger.info(
             "solve_bem: %d panels exceeds the single-dispatch ceiling "
             "(%d); using the streamed out-of-core path (multi-dispatch "
-            "band assembly, one solve dispatch per frequency)",
+            "band assembly + staged solve dispatches per frequency)",
             pa.n, TPU_PANEL_LIMIT,
         )
     backend = backend or "cpu"
@@ -694,10 +919,41 @@ def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81,
             _solve_all, static_argnums=(12, 13, 14, 17)
         )
 
-    from raft_tpu.utils.placement import backend_sharding
+    from raft_tpu.utils.placement import (
+        backend_devices,
+        backend_sharding,
+        batch_mesh,
+        replicated_sharding,
+    )
 
-    put = lambda a: jax.device_put(        # noqa: E731
-        np.asarray(a, np.float32), backend_sharding(backend))
+    # device-mesh policy: shard the frequency batch when >1 local device
+    # of the backend exists and the batch fills the mesh; otherwise the
+    # single-device path, unchanged.  The defensive try keeps the
+    # "TPU-form solve on a CPU-only host" route (tests monkeypatch
+    # backend_sharding) working: no devices found -> no sharding.
+    try:
+        devs = backend_devices(backend)
+    except RuntimeError:
+        devs = []
+    n_dev = len(devs) if n_devices is None else max(
+        1, min(int(n_devices), len(devs)))
+    nw_req = len(np.atleast_1d(np.asarray(omegas, float)))
+    nb_req = len(np.atleast_1d(np.asarray(betas, float)))
+    shard_mode = None
+    if not streamed and n_dev > 1:
+        if nw_req >= n_dev:
+            shard_mode = "freq"
+        elif nb_req > 1 and nw_req * nb_req >= n_dev:
+            shard_mode = "freqbeta"
+
+    if shard_mode:
+        dev_mesh = batch_mesh(axis="freq", devices=devs[:n_dev])
+        rep = replicated_sharding(dev_mesh)
+        put = lambda a: jax.device_put(    # noqa: E731
+            np.asarray(a, np.float32), rep)
+    else:
+        put = lambda a: jax.device_put(    # noqa: E731
+            np.asarray(a, np.float32), backend_sharding(backend))
     tables = jax.tree.map(put, tables)
 
     # frequency-independent arrays transfer ONCE (S0/K0 alone are ~94 MB
@@ -715,7 +971,7 @@ def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81,
         return (put(om),) + static_pre
 
     if streamed:
-        A, B, Xr, Xi = _run_streamed(
+        A, B, Xr, Xi, ndisp = _run_streamed(
             omegas, static_pre, put, pa.n)
         out = {
             "w": np.asarray(omegas, float),
@@ -727,7 +983,29 @@ def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81,
             "npanels": n_real,
             "npanels_solved": pa.n,
             "streamed": True,
+            "stream_bands": ndisp["bands"],
+            "stream_solve_dispatches": ndisp["solve_stages"],
         }
+        return out
+
+    if shard_mode:
+        A, B, Xr, Xi, flops = _run_sharded(
+            omegas, np.atleast_1d(np.asarray(betas, float)), static_pre,
+            dev_mesh, shard_mode, pa.n, report_cost)
+        out = {
+            "w": np.asarray(omegas, float),
+            "A": np.asarray(A, np.float64),
+            "B": np.asarray(B, np.float64),
+            "X": np.asarray(Xr, np.float64) + 1j * np.asarray(
+                Xi, np.float64),
+            "betas": np.asarray(betas, float),
+            "npanels": n_real,
+            "npanels_solved": pa.n,
+            "sharded": shard_mode,
+            "n_devices": n_dev,
+        }
+        if flops is not None:
+            out["flops"] = flops
         return out
 
     # Large TPU meshes: keep each dispatch under the tunnel worker's
@@ -788,15 +1066,27 @@ def solve_bem(panels, omegas, betas=(0.0,), rho=1025.0, g=9.81,
 STREAM_BAND_BUDGET_S = 28.0
 
 
+# measured blocked-Gauss-Jordan throughput used to budget the staged
+# solve dispatches (v5e: >= 12 TFLOP/s of f32 matmul at 2N = 6656)
+_GJ_FLOPS_PER_S = 12e12
+
+
 def _run_streamed(omegas, static_pre, put, n, band_budget_s=None):
     """Out-of-core execution for meshes past the single-dispatch ceiling
     (VERDICT r4 #8): per frequency, the wave-term influence assembly is
     split into D row bands, each assembled in its OWN dispatch (device
     arrays persist in HBM between dispatches, so nothing crosses the
-    tunnel), then one solve dispatch concatenates the bands and runs the
-    blocked Gauss-Jordan.  Each dispatch stays under the tunnel
-    watchdog; HAMS-style arbitrary mesh sizes are then bounded by HBM
-    (~16k panels on 16 GB), not dispatch time."""
+    tunnel), then the solve runs as one system-assembly dispatch plus
+    the blocked Gauss-Jordan elimination split into >= 2 row-band stage
+    dispatches (the 2(2N)^3-flop elimination grows past the watchdog
+    well before the ~16k-panel HBM ceiling; each stage runs a bounded
+    slice of block steps through ONE compiled executable with traced
+    bounds), and a final pressure-integral dispatch.  Each dispatch
+    stays under the tunnel watchdog; HAMS-style arbitrary mesh sizes are
+    then bounded by HBM (~16k panels on 16 GB), not dispatch time.
+
+    Returns (A, B, Xr, Xi, ndisp) with ndisp the per-frequency dispatch
+    counts {"bands": D, "solve_stages": S}."""
     import jax
 
     (betas_d, x_d, nrm_d, area_d, y_d, wq_d, S0_d, K0_d, vmodes_d,
@@ -812,8 +1102,18 @@ def _run_streamed(omegas, static_pre, put, n, band_budget_s=None):
         D += 1
     rows = n // D
 
-    band_fn = _streamed_band_fn(tables_d, g, finite)
-    solve_fn = _streamed_solve_fn(D, g, rho, finite)
+    # solve-stage banding: the elimination has (2N)/512 block steps;
+    # group them into >= 2 dispatches sized by the same per-dispatch
+    # budget as the assembly bands
+    nblk_total = (2 * n) // 512
+    t_gj = 2.0 * (2.0 * n) ** 3 / _GJ_FLOPS_PER_S
+    n_stages = min(nblk_total,
+                   max(2, int(np.ceil(t_gj / band_budget_s))))
+    steps = [nblk_total // n_stages + (1 if s < nblk_total % n_stages
+                                       else 0) for s in range(n_stages)]
+
+    band_fn, system_fn, stage_fn, finish_fn = _streamed_fns(
+        D, rows, n, finite, g, rho)
 
     A, B, Xr, Xi = [], [], [], []
     for om in np.atleast_1d(np.asarray(omegas, float)):
@@ -822,19 +1122,31 @@ def _run_streamed(omegas, static_pre, put, n, band_budget_s=None):
         for b in range(D):
             sl = slice(b * rows, (b + 1) * rows)
             parts = band_fn(om_d, x_d[sl], nrm_d[sl], y_d, wq_d,
-                            depth_d, kmax_d)
+                            tables_d, depth_d, kmax_d)
             # block per band: one watchdog window per dispatch
             jax.block_until_ready(parts)
             bands.append(parts)
         flat = [p[j] for j in range(4) for p in bands]
-        res = solve_fn(om_d, betas_d, x_d, nrm_d, area_d, S0_d, K0_d,
-                       vmodes_d, jump_d, depth_d, *flat)
+        A2, b2, Sf_r, Sf_i, phiI_r, phiI_i = system_fn(
+            om_d, betas_d, x_d, nrm_d, S0_d, K0_d, vmodes_d, jump_d,
+            depth_d, *flat)
+        kb0 = 0
+        for ns in steps:
+            # python-int bounds trace as scalars of one consistent dtype
+            # (jit caches on dtype/shape, so every stage length reuses
+            # the first compiled executable per distinct length)
+            A2, b2 = stage_fn(A2, b2, np.int64(kb0), np.int64(ns))
+            jax.block_until_ready(b2)
+            kb0 += ns
+        res = finish_fn(om_d, b2, Sf_r, Sf_i, phiI_r, phiI_i, area_d,
+                        vmodes_d)
         jax.block_until_ready(res)
         A.append(np.asarray(res[0]))
         B.append(np.asarray(res[1]))
         Xr.append(np.asarray(res[2]))
         Xi.append(np.asarray(res[3]))
-    return (np.stack(A), np.stack(B), np.stack(Xr), np.stack(Xi))
+    ndisp = {"bands": D, "solve_stages": n_stages}
+    return (np.stack(A), np.stack(B), np.stack(Xr), np.stack(Xi), ndisp)
 
 
 def max_resolved_omega(panel_size, g=9.81, panels_per_wavelength=7.0):
@@ -847,7 +1159,7 @@ def max_resolved_omega(panel_size, g=9.81, panels_per_wavelength=7.0):
 def coeffs_from_members(members, omegas, headings_deg=(0.0,), rho=1025.0,
                         g=9.81, dz_max=0.0, da_max=0.0, panels=None,
                         quad="gauss", backend=None, depth=np.inf,
-                        irr_removal=True):
+                        irr_removal=True, n_devices=None):
     """Mesh all potMod members, run the native solver, return a HydroCoeffs
     set (same container the WAMIT-file import path produces, so the Model
     pipeline is agnostic to where coefficients came from).
@@ -881,8 +1193,15 @@ def coeffs_from_members(members, omegas, headings_deg=(0.0,), rho=1025.0,
     w_solve = np.unique(np.minimum(omegas, w_cap))
     betas = np.deg2rad(np.asarray(headings_deg, float))
     out = solve_bem(panels, w_solve, betas=betas, rho=rho, g=g, quad=quad,
-                    backend=backend, depth=depth, lid_panels=lids)
+                    backend=backend, depth=depth, lid_panels=lids,
+                    n_devices=n_devices)
     return HydroCoeffs(
         w=out["w"], A=out["A"], B=out["B"],
         headings=np.asarray(headings_deg, float), X=out["X"],
+        solver_info={
+            k: out[k] for k in (
+                "npanels", "npanels_solved", "sharded", "n_devices",
+                "streamed", "stream_bands", "stream_solve_dispatches",
+            ) if k in out
+        },
     )

@@ -282,7 +282,8 @@ class Model:
         return self.bem_coeffs
 
     def run_bem(self, headings=(0.0,), nw_bem=24, dz_max=None, da_max=None,
-                panels=None, quad="gauss", w_grid=None, irr_removal=True):
+                panels=None, quad="gauss", w_grid=None, irr_removal=True,
+                n_devices=None):
         """Run the NATIVE radiation/diffraction panel solver on all potMod
         members (the reference's calcBEM path, raft/raft_fowt.py:318-423,
         with the external Fortran HAMS subprocess replaced by the TPU-native
@@ -293,6 +294,12 @@ class Model:
         and interpolated onto the model grid inside the case pipeline exactly
         like imported WAMIT data.  Panel sizes default to the design's
         dz_BEM/da_BEM.
+
+        The device policy follows the Model: the solve runs on
+        ``Model(device=...)``'s backend and, when that backend has
+        multiple local devices, the frequency batch is sharded across
+        all of them (``n_devices`` caps the count; 1 forces the
+        single-device path — see solve_bem).
         """
         from raft_tpu.bem_solver import coeffs_from_members
 
@@ -312,7 +319,7 @@ class Model:
             headings_deg=headings, rho=self.rho_water, g=self.g,
             dz_max=dz, da_max=da, panels=panels, quad=quad,
             backend=self.device, depth=self.depth,
-            irr_removal=irr_removal,
+            irr_removal=irr_removal, n_devices=n_devices,
         )
         return self.bem_coeffs
 

@@ -136,6 +136,33 @@ _PARTIAL_OUTPUTS = {
     "max_tower_base": "Mbase_max",
 }
 
+
+def _check_derivative_options(modeling_opt):
+    """The traced parametric twin behind the exact partials models
+    Morison-only hydro with no ballast trim (raft_tpu/parametric.py —
+    see the restriction list next to its bridled-mooring
+    NotImplementedError).  compute() honors run_native_BEM and
+    trim_ballast, so combining either with ``derivatives`` would hand an
+    optimizer a Jacobian of a DIFFERENT physics path than the outputs it
+    constrains — refuse loudly instead of silently diverging
+    (ADVICE r5 medium)."""
+    if modeling_opt.get("run_native_BEM"):
+        raise NotImplementedError(
+            "modeling option 'derivatives' cannot be combined with "
+            "'run_native_BEM': the traced parametric pipeline models "
+            "Morison-only hydrodynamics, so the declared exact partials "
+            "would be derivatives of a different physics path than "
+            "compute()'s BEM-based outputs"
+        )
+    if modeling_opt.get("trim_ballast", 0):
+        raise NotImplementedError(
+            "modeling option 'derivatives' cannot be combined with "
+            "trim_ballast != 0: the traced parametric pipeline has no "
+            "ballast-trim step, so the declared exact partials would be "
+            "derivatives of an untrimmed design while compute() reports "
+            "the trimmed one"
+        )
+
 _PROPERTY_OUTPUTS = [
     # (name, shape factory, units)  — shapes use closures over option counts
     ("tower mass", lambda o: 0.0, "kg"),
@@ -414,6 +441,7 @@ class RAFT_OMDAO(_ComponentBase):
         # outputs get EXACT partials from the traced parametric pipeline
         # (raft_tpu/parametric.py, jax.jacfwd end to end).
         if modeling_opt.get("derivatives"):
+            _check_derivative_options(modeling_opt)
             for p in _SCALE_INPUTS:
                 self.add_input(p, val=1.0)
             self.declare_partials(
@@ -809,6 +837,19 @@ class RAFT_OMDAO(_ComponentBase):
         Requires modeling option ``derivatives``; only the
         (_PARTIAL_OUTPUTS x _SCALE_INPUTS) block is exact — every other
         partial remains undeclared, exactly like the reference.
+        Incompatible with ``run_native_BEM`` and ``trim_ballast`` (the
+        traced twin models neither; _check_derivative_options refuses
+        the combination in setup() and here).
+
+        Draft-axis caveat: the twin scales its frozen strip-node set
+        proportionally, while compute() re-discretizes nodes from the
+        scaled design dict (dls_max spacing, waterline re-snap), so the
+        design_scale_draft column is the exact derivative of a slightly
+        different smooth geometry path — measured same-sign and within
+        ~4x of compute()'s one-sided FD (pinned by
+        tests/test_parametric.py::test_omdao_scale_partials).  The
+        ballast, col_diam, and line_length columns match compute() FD
+        to <= 5e-3 / 5e-2.
         """
         import pickle as _pickle
 
@@ -819,6 +860,8 @@ class RAFT_OMDAO(_ComponentBase):
         if not self.options["modeling_options"].get("derivatives"):
             raise RuntimeError(
                 "compute_partials needs modeling option 'derivatives'")
+        # guard again here: options dicts are mutable after setup()
+        _check_derivative_options(self.options["modeling_options"])
         if discrete_inputs is None:
             discrete_inputs = self._discrete_inputs \
                 if hasattr(self, "_discrete_inputs") else {}

@@ -855,6 +855,12 @@ def build_design_response(base_design, metrics=METRIC_NAMES,
     wind_all = np.concatenate([wind, [0.0]])
     nc = len(zeta_all)
 
+    # Scope of the traced twin (what the declared-exact OM partials are
+    # derivatives OF): Morison-only hydrodynamics (no native-BEM
+    # coefficients), no ballast trim, and — enforced right below —
+    # simple non-bridled moorings.  omdao._check_derivative_options
+    # refuses the run_native_BEM / trim_ballast modeling options when
+    # 'derivatives' is on for exactly this reason.
     ms = parse_mooring(base_design["mooring"], rho_water=rho, g=g)
     if ms.bridles is not None:
         raise NotImplementedError(

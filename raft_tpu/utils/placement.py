@@ -18,6 +18,44 @@ def cpu_sharding():
     return jax.sharding.SingleDeviceSharding(jax.devices("cpu")[0])
 
 
+def backend_devices(platform=None):
+    """Local devices of ``platform`` ('tpu' | 'cpu' | 'gpu'; None = default
+    backend), with the same clear error :func:`backend_sharding` raises
+    when the requested platform is absent."""
+    try:
+        if platform is None:
+            return jax.local_devices()
+        return jax.local_devices(backend=platform)
+    except RuntimeError as e:
+        avail = sorted({d.platform for d in jax.devices()})
+        raise RuntimeError(
+            f"device='{platform}' requested but no such backend is "
+            f"available (have: {avail})"
+        ) from e
+
+
+def batch_mesh(platform=None, axis="batch", devices=None):
+    """1-D device mesh over the local devices of ``platform`` (or the
+    explicit ``devices`` list) for embarrassingly-parallel batch axes —
+    the same shape :func:`raft_tpu.sweep.make_sweep_mesh` uses for the
+    design axis, reused by the BEM frequency sharding."""
+    import numpy as np
+
+    devs = list(devices) if devices is not None else backend_devices(platform)
+    return jax.sharding.Mesh(np.array(devs), (axis,))
+
+
+def batch_sharding(mesh, axis="batch"):
+    """NamedSharding laying an array's leading axis across ``mesh``."""
+    return jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec(axis))
+
+
+def replicated_sharding(mesh):
+    """NamedSharding replicating an array on every device of ``mesh``."""
+    return jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+
+
 def put_cpu(x):
     """Commit array/pytree ``x`` to the host CPU backend (fast path)."""
     return jax.device_put(x, cpu_sharding())

@@ -163,7 +163,7 @@ def checked_pipeline(model):
 
 
 def full_hull_convergence(design_path, backend="tpu", sizes=(2.0, 1.5),
-                          nw=8, w_lo=0.25, w_hi=0.9):
+                          nw=8, w_lo=0.25, w_hi=0.9, n_devices=None):
     """Two-mesh potential-flow convergence study of a full hull — the
     flagship VolturnUS-S verification anchor (no published IEA-15MW
     potential-flow tables ship with the reference mirror, so the solve is
@@ -194,7 +194,8 @@ def full_hull_convergence(design_path, backend="tpu", sizes=(2.0, 1.5),
     for tag, sz in zip(("fine", "xfine"), sizes):
         panels = mesh_platform(mem, dz_max=sz, da_max=sz)
         sols[tag] = solve_bem(panels, w, rho=m.rho_water, g=m.g,
-                              backend=backend, depth=m.depth)
+                              backend=backend, depth=m.depth,
+                              n_devices=n_devices)
     Af, Ax = sols["fine"]["A"], sols["xfine"]["A"]
     rel_A = [
         float(np.max(np.abs(Af[:, i, i] - Ax[:, i, i])

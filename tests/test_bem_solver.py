@@ -130,8 +130,13 @@ def test_backend_param_and_streamed_large_mesh(monkeypatch):
     panels = spar_panels(12.0, 12.0)
     out_default = bem_solver.solve_bem(panels, [0.5])
     out_cpu = bem_solver.solve_bem(panels, [0.5, 0.9], backend="cpu")
+    # scale-aware atol: the two calls compile different nw shapes, and
+    # XLA's fusion choices move the f32 near-zero couplings by O(1e-9)
+    # of the matrix scale (host-dependent; exact-zero atol made this
+    # test flake across CPUs)
     np.testing.assert_allclose(
-        out_cpu["A"][:1], out_default["A"], rtol=1e-6)
+        out_cpu["A"][:1], out_default["A"], rtol=1e-6,
+        atol=1e-7 * float(np.abs(out_default["A"]).max()))
 
     orig = placement.backend_sharding
     monkeypatch.setattr(placement, "backend_sharding",

@@ -173,7 +173,11 @@ def test_cheb_eval_matches_quadrature():
                         -10**rng.uniform(-5, 0.5, 500),
                         -10**rng.uniform(-1, 1.2, 100)])
     F_ref, F1_ref = greens.compute_F_F1(a, b)
-    with jax.enable_x64(True):
+    # jax.enable_x64 was removed from the top-level namespace; the
+    # supported context manager lives in jax.experimental
+    from jax.experimental import enable_x64
+
+    with enable_x64(True):
         Fc, F1c = greens.eval_F_F1_cheb(
             np.asarray(a), np.asarray(b), C)
     in_dom = (a <= 100) & (b >= -40)

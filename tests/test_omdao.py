@@ -266,3 +266,25 @@ def test_dlc_filter_drops_steady_cases():
     rebuilt, mask = comp._rebuild_design(comp._inputs, comp._discrete_inputs)
     assert mask.tolist() == [True, True, False]
     assert len(rebuilt["cases"]["data"]) == 2
+
+
+def test_derivatives_guard_rejects_mismatched_physics():
+    """'derivatives' + run_native_BEM or trim_ballast would declare exact
+    partials of a different physics path than compute() (the traced twin
+    models Morison-only hydro, no ballast trim) — the component must
+    refuse the combination at setup AND at compute_partials
+    (ADVICE r5 medium)."""
+    from raft_tpu.omdao import _check_derivative_options
+
+    _check_derivative_options({})                        # plain: fine
+    _check_derivative_options({"trim_ballast": 0})       # explicit 0: fine
+    with pytest.raises(NotImplementedError, match="run_native_BEM"):
+        _check_derivative_options({"run_native_BEM": True})
+    with pytest.raises(NotImplementedError, match="trim_ballast"):
+        _check_derivative_options({"trim_ballast": 1})
+
+    # compute_partials re-checks (options dicts are mutable after setup)
+    comp = _build_component(_design(), derivatives=True)
+    comp.options["modeling_options"]["run_native_BEM"] = True
+    with pytest.raises(NotImplementedError, match="run_native_BEM"):
+        comp.compute_partials({}, {})

@@ -151,8 +151,14 @@ def test_omdao_scale_partials(tmp_path):
     comp.compute_partials(comp._inputs, partials)
 
     eps = 2e-3
-    for in_name, col in (("design_scale_ballast", 1),
-                         ("design_scale_line_length", 3)):
+    # col_diam joins the tight FD check (ADVICE r5 low: without the
+    # geometric columns, a twin-vs-model divergence on the riskiest axes
+    # would pass the suite undetected); diameter scaling leaves the
+    # strip-node topology alone, so central differences of compute()
+    # converge cleanly (measured <= 3e-3 relative)
+    for in_name, col, tol in (("design_scale_ballast", 1, 5e-3),
+                              ("design_scale_line_length", 3, 5e-3),
+                              ("design_scale_col_diam", 2, 5e-2)):
         fd = {}
         for sgn in (+1, -1):
             comp.set_val(in_name, 1.0 + sgn * eps)
@@ -164,5 +170,31 @@ def test_omdao_scale_partials(tmp_path):
             fd_val = (fd[k][+1] - fd[k][-1]) / (2 * eps)
             ad_val = float(np.asarray(partials[k, in_name]))
             scale = max(abs(fd_val), 1e-6 * max(abs(base[k]), 1.0))
-            assert abs(ad_val - fd_val) / scale < 5e-3, (
+            assert abs(ad_val - fd_val) / scale < tol, (
                 k, in_name, ad_val, fd_val)
+
+    # draft: adding this column CAUGHT a real twin-vs-model divergence
+    # (exactly what the advisor predicted).  compute() re-discretizes
+    # strip nodes from the scaled design dict (node counts jump at
+    # member-length multiples of dls_max — +eps crosses one on this
+    # design — and the waterline node is re-snapped), while the traced
+    # twin scales its FROZEN node set proportionally; in-cell the two
+    # parameterizations differ at O(eps), so the draft partial is the
+    # exact derivative of a slightly different (smooth) geometry path.
+    # Measured on this design: same sign, |ad/fd| within ~4x (backward
+    # one-sided FD to stay inside one topology cell).  Pinned here so
+    # the divergence is VISIBLE and bounded instead of silent; the
+    # restriction is documented in omdao.compute_partials.
+    fdd = {}
+    for s in (1.0 - eps, 1.0 - 2 * eps):
+        comp.set_val("design_scale_draft", s)
+        comp.run()
+        fdd[s] = {k: float(comp.get_val(k)) for k in base}
+    comp.set_val("design_scale_draft", 1.0)
+    for k in base:
+        f0, f1, f2 = base[k], fdd[1.0 - eps][k], fdd[1.0 - 2 * eps][k]
+        fd_val = (3 * f0 - 4 * f1 + f2) / (2 * eps)   # 2nd-order backward
+        ad_val = float(np.asarray(partials[k, "design_scale_draft"]))
+        assert np.sign(ad_val) == np.sign(fd_val), (k, ad_val, fd_val)
+        ratio = ad_val / fd_val
+        assert 0.2 < ratio < 5.0, (k, ad_val, fd_val, ratio)
