@@ -16,12 +16,53 @@ HOT LOOP #3) expressed as one XLA graph:
    non-convergence) via a while_loop whose state freezes once converged —
    matching the reference's mid-loop `break` without data-dependent Python
    control flow.
+
+Solver health (raft_tpu/health.py) is tracked in-graph:
+
+ - NaN quarantine: a non-finite iterate freezes its lane at the last
+   finite state and sets a flag instead of propagating through the
+   batched [design, case] solve (the reference would print a warning and
+   ship NaN statistics);
+ - the final refined re-solve runs an escalating conditioned-solve
+   recovery ladder (baseline Gauss-Jordan -> extra iterative refinement ->
+   flagged Tikhonov regularization when the condition estimate of Z(w)
+   blows up, e.g. at a zero-damping resonance);
+ - every solve returns a :class:`raft_tpu.health.SolveReport` pytree
+   (convergence flag, iteration count, residual, condition estimate,
+   non-finite flag, recovery tier) that vmaps with the solve itself.
 """
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.health import (
+    SolveReport,
+    TIER_BASELINE,
+    TIER_REFINE,
+    TIER_TIKHONOV,
+)
 from raft_tpu.hydro import linearized_drag
+
+
+def _gj_step(i, M, idx):
+    """One Gauss-Jordan elimination step on the augmented batch [..., n, m];
+    returns the updated matrix and the |pivot| per batch element."""
+    col = jnp.abs(jnp.take(M, i, axis=-1))          # column i magnitudes
+    col = jnp.where(idx < i, -jnp.inf, col)         # rows above i are done
+    p = jnp.argmax(col, axis=-1)                    # pivot row per batch
+    rp = jnp.take_along_axis(M, p[..., None, None], axis=-2)[..., 0, :]
+    ri = jnp.take(M, i, axis=-2)
+    is_i = (idx == i)[:, None]
+    is_p = (idx == p[..., None])[..., :, None]
+    M = jnp.where(is_i, rp[..., None, :],
+                  jnp.where(is_p, ri[..., None, :], M))
+    piv = jnp.take(rp, i, axis=-1)[..., None]
+    row = rp / piv                                  # normalized pivot row
+    fac = jnp.take(M, i, axis=-1)[..., None]        # column i after swap
+    M = jnp.where(is_i, row[..., None, :], M - fac * row[..., None, :])
+    return M, jnp.abs(piv[..., 0])
 
 
 def gauss_solve(A, b):
@@ -41,25 +82,53 @@ def gauss_solve(A, b):
     n = A.shape[-1]
     M = jnp.concatenate([A, b], axis=-1)                # [..., n, n+1]
     idx = jnp.arange(n)
-
-    def step(i, M):
-        col = jnp.abs(jnp.take(M, i, axis=-1))          # column i magnitudes
-        col = jnp.where(idx < i, -jnp.inf, col)         # rows above i are done
-        p = jnp.argmax(col, axis=-1)                    # pivot row per batch
-        rp = jnp.take_along_axis(M, p[..., None, None], axis=-2)[..., 0, :]
-        ri = jnp.take(M, i, axis=-2)
-        is_i = (idx == i)[:, None]
-        is_p = (idx == p[..., None])[..., :, None]
-        M = jnp.where(is_i, rp[..., None, :],
-                      jnp.where(is_p, ri[..., None, :], M))
-        piv = jnp.take(rp, i, axis=-1)[..., None]
-        row = rp / piv                                  # normalized pivot row
-        fac = jnp.take(M, i, axis=-1)[..., None]        # column i after swap
-        M = jnp.where(is_i, row[..., None, :], M - fac * row[..., None, :])
-        return M
-
-    M = jax.lax.fori_loop(0, n, step, M)
+    M = jax.lax.fori_loop(0, n, lambda i, M: _gj_step(i, M, idx)[0], M)
     return M[..., -1:]
+
+
+def gj_cond_estimate(A):
+    """Cheap per-batch condition estimate of A: the max/min |pivot| ratio
+    of a Gauss-Jordan elimination of the ROW-EQUILIBRATED matrix.
+
+    Row equilibration (divide each row by its max magnitude) makes the
+    estimate scale-invariant: the mixed translational/rotational DOFs of
+    the impedance carry wildly different physical scales, and the raw
+    pivot ratio would report that scaling disparity as ill-conditioning.
+    A genuinely (near-)singular Z(w) — e.g. a zero-damping resonance where
+    -w^2 M + C loses rank and Zi = 0 — drives the smallest equilibrated
+    pivot toward 0 and the estimate toward +inf.  Non-finite inputs
+    report +inf.  Estimate-only: the actual solves run on the
+    un-equilibrated matrix so the baseline arithmetic is unchanged.
+    """
+    n = A.shape[-1]
+    d = jnp.max(jnp.abs(A), axis=-1, keepdims=True)
+    d = jnp.where(d > 0, d, jnp.ones_like(d))
+    M = jnp.concatenate([A / d, jnp.zeros_like(A[..., :1])], axis=-1)
+    idx = jnp.arange(n)
+    shape = A.shape[:-2]
+    init = (M,
+            jnp.full(shape, jnp.inf, A.dtype),
+            jnp.zeros(shape, A.dtype))
+
+    def step(i, carry):
+        M, pmin, pmax = carry
+        M, pa = _gj_step(i, M, idx)
+        return M, jnp.minimum(pmin, pa), jnp.maximum(pmax, pa)
+
+    _, pmin, pmax = jax.lax.fori_loop(0, n, step, init)
+    tiny = jnp.asarray(jnp.finfo(A.dtype).tiny, A.dtype)
+    cond = pmax / jnp.maximum(pmin, tiny)
+    return jnp.where(jnp.isfinite(cond), cond,
+                     jnp.asarray(jnp.inf, A.dtype))
+
+
+def _block_system(Zr, Zi, Fr, Fi):
+    """(Zr + i Zi) x = Fr + i Fi as the equivalent real block system."""
+    top = jnp.concatenate([Zr, -Zi], axis=-1)
+    bot = jnp.concatenate([Zi, Zr], axis=-1)
+    A = jnp.concatenate([top, bot], axis=-2)            # [..., 12, 12]
+    b = jnp.concatenate([Fr, Fi], axis=-1)[..., None]   # [..., 12, 1]
+    return A, b
 
 
 def solve_complex_6x6(Zr, Zi, Fr, Fi, refine=1):
@@ -70,16 +139,99 @@ def solve_complex_6x6(Zr, Zi, Fr, Fi, refine=1):
     Returns (xr, xi) : [..., 6] each.
     refine : iterative-refinement steps (cheap; recovers ~2 digits in f32).
     """
-    top = jnp.concatenate([Zr, -Zi], axis=-1)
-    bot = jnp.concatenate([Zi, Zr], axis=-1)
-    A = jnp.concatenate([top, bot], axis=-2)            # [..., 12, 12]
-    b = jnp.concatenate([Fr, Fi], axis=-1)[..., None]   # [..., 12, 1]
+    A, b = _block_system(Zr, Zi, Fr, Fi)
     x = gauss_solve(A, b)
     for _ in range(refine):
         r = b - A @ x
         x = x + gauss_solve(A, r)
     x = x[..., 0]
     return x[..., :6], x[..., 6:]
+
+
+def solve_complex_6x6_ladder(Zr, Zi, Fr, Fi, refine=1, resid_tol=None,
+                             cond_max=None, tik_rel=1e-3, extra_refine=2):
+    """The batched complex 6x6 solve with the escalating conditioned-solve
+    recovery ladder, per batch element (per frequency bin in the RAO
+    solve):
+
+     tier 0 (baseline)  : Gauss-Jordan block solve + ``refine`` standard
+                          iterative-refinement steps — bit-identical to
+                          :func:`solve_complex_6x6` (the extra tiers are
+                          computed in-graph but only *selected* where
+                          needed, so healthy bins keep the exact baseline
+                          arithmetic);
+     tier 1 (refine)    : ``extra_refine`` additional refinement steps
+                          where the relative residual exceeds
+                          ``resid_tol`` or the baseline went non-finite;
+     tier 2 (tikhonov)  : flagged Tikhonov-regularized solve
+                          (A^T A + lam^2 I) x = A^T b with
+                          lam = tik_rel * max|A|, where the
+                          row-equilibrated condition estimate exceeds
+                          ``cond_max`` or the refined solve is still bad —
+                          a numerically singular Z(w) (zero-damping
+                          resonance) then yields a finite regularized
+                          response instead of Inf/NaN poisoning the batch.
+
+    Defaults scale with the working dtype: resid_tol = 1e3*eps (f32
+    ~1.2e-4, f64 ~2.2e-13 — two orders above a healthy refined solve),
+    cond_max = 0.02/eps (f32 ~1.7e5, f64 ~9e13).
+
+    Returns (xr, xi, residual, cond, tier):
+      xr, xi   : [..., 6] solution parts (finite whenever any tier is)
+      residual : [...] final relative residual max|b - A x| / max|b|
+      cond     : [...] condition estimate (see :func:`gj_cond_estimate`)
+      tier     : [...] int recovery tier taken (TIER_*)
+    """
+    A, b = _block_system(Zr, Zi, Fr, Fi)
+    dtype = A.dtype
+    eps = float(np.finfo(dtype).eps)
+    if resid_tol is None:
+        resid_tol = 1e3 * eps
+    if cond_max is None:
+        cond_max = 0.02 / eps
+    tiny = jnp.asarray(np.finfo(dtype).tiny, dtype)
+    bnorm = jnp.max(jnp.abs(b), axis=(-2, -1))
+
+    def rel_resid(x):
+        r = jnp.max(jnp.abs(b - A @ x), axis=(-2, -1)) / (bnorm + tiny)
+        return jnp.where(jnp.isfinite(r), r, jnp.asarray(jnp.inf, dtype))
+
+    def finite(x):
+        return jnp.all(jnp.isfinite(x), axis=(-2, -1))
+
+    # tier 0: the exact baseline path of solve_complex_6x6
+    x0 = gauss_solve(A, b)
+    for _ in range(refine):
+        x0 = x0 + gauss_solve(A, b - A @ x0)
+    r0 = rel_resid(x0)
+    need1 = (r0 > resid_tol) | ~finite(x0)
+
+    # tier 1: extra refinement (always computed, selected where needed —
+    # the 12x12 systems are tiny, so unconditional compute + select keeps
+    # the graph free of data-dependent control flow under vmap)
+    x1 = x0
+    for _ in range(extra_refine):
+        x1 = x1 + gauss_solve(A, b - A @ x1)
+    xa = jnp.where(need1[..., None, None], x1, x0)
+    ra = rel_resid(xa)
+
+    # tier 2: flagged Tikhonov regularization on the normal equations
+    cond = gj_cond_estimate(A)
+    need2 = (ra > resid_tol) | ~finite(xa) | (cond > cond_max)
+    anorm = jnp.max(jnp.abs(A), axis=(-2, -1))
+    lam2 = (tik_rel * anorm) ** 2 + tiny
+    At = jnp.swapaxes(A, -1, -2)
+    n = A.shape[-1]
+    G = At @ A + lam2[..., None, None] * jnp.eye(n, dtype=dtype)
+    x2 = gauss_solve(G, At @ b)
+    x = jnp.where(need2[..., None, None], x2, xa)
+
+    tier = jnp.where(
+        need2, TIER_TIKHONOV, jnp.where(need1, TIER_REFINE, TIER_BASELINE)
+    )
+    residual = rel_resid(x)
+    x = x[..., 0]
+    return x[..., :6], x[..., 6:], residual, cond, tier
 
 
 def assemble_impedance(w, M, B, C):
@@ -109,6 +261,7 @@ def solve_dynamics(
     tol=0.01,
     refine=1,
     checkable=False,
+    relax=0.8,
 ):
     """Fixed-point dynamics solve for one case (vmap over cases in the Model).
 
@@ -121,43 +274,67 @@ def solve_dynamics(
     C_lin : [6, 6] total stiffness
     F_lin_r/i : [nw, 6] linear excitation force (real/imag parts)
     XiStart : initial amplitude guess (reference raft_model.py:50, :535)
+    relax : weight of the NEW iterate in the under-relaxed update
+        (reference: 0.8, i.e. Xi <- 0.2*old + 0.8*new); the sweep drivers'
+        bounded non-convergence retry re-solves with a smaller value
+        (stronger under-relaxation).
 
-    Returns (Xi_r, Xi_i) : [nw, 6] response amplitudes, plus iteration count
-    and final convergence flag.
+    Returns (Xi_r, Xi_i, report) : [nw, 6] response amplitude parts plus a
+    :class:`raft_tpu.health.SolveReport`.  A non-finite iterate freezes the
+    lane at its last finite state (NaN quarantine) instead of propagating
+    through a batched solve; the returned amplitudes are always finite
+    unless every recovery tier failed AND no finite iterate ever existed
+    (then they are zero with ``nonfinite`` set).
     """
     nw = w.shape[0]
     cdtype = u.dtype
+    relax = float(relax)
+    # round so the default relax=0.8 reproduces the reference's literal
+    # 0.2 weight exactly (1.0 - 0.8 = 0.19999999999999996 in binary)
+    w_old = round(1.0 - relax, 12)
     XiLast = jnp.full((6, nw), XiStart, dtype=cdtype)
     Xi0 = jnp.zeros((6, nw), dtype=cdtype)
 
-    def step(XiLast, n_refine):
-        B_drag, F_drag = linearized_drag(nodes, XiLast, u, w, dw, rho)
+    def assemble(XiL):
+        B_drag, F_drag = linearized_drag(nodes, XiL, u, w, dw, rho)
         B_tot = B_lin + B_drag[None, :, :]
         Zr, Zi = assemble_impedance(w, M_lin, B_tot, C_lin)
         F = F_drag + (F_lin_r + 1j * F_lin_i).astype(cdtype)  # [nw, 6]
+        return Zr, Zi, F
+
+    def step(XiL, n_refine):
+        Zr, Zi, F = assemble(XiL)
         xr, xi = solve_complex_6x6(
             Zr, Zi, jnp.real(F), jnp.imag(F), refine=n_refine
         )
         return (xr + 1j * xi).T                                # [6, nw]
 
     def cond(state):
-        i, XiLast, XiPoint, Xi, done = state
+        i, XiLast, XiPoint, Xi, done, froze = state
         return (i < nIter + 1) & (~done)
 
     def body(state):
-        i, XiLast, XiPoint, Xi_prev, done = state
+        i, XiLast, XiPoint, Xi_prev, done, froze = state
         # no refinement inside the loop: the fixed point only needs the
         # solution to well within the 1% convergence tolerance, and the
         # unrefined f32 block solve already sits at ~1e-4 relative
         Xi = step(XiLast, 0)
+        # NaN quarantine: a non-finite iterate freezes this lane at its
+        # last finite state (XiLast stays finite by construction) and
+        # raises the flag, instead of propagating through the batch
+        finite = jnp.all(jnp.isfinite(Xi))
         tolCheck = jnp.abs(Xi - XiLast) / (jnp.abs(Xi) + tol)
-        conv = jnp.all(tolCheck < tol)
-        XiNext = jnp.where(conv, XiLast, 0.2 * XiLast + 0.8 * Xi)
+        conv = jnp.all(tolCheck < tol)                 # NaN compares False
+        XiNext = jnp.where(conv | ~finite, XiLast,
+                           w_old * XiLast + relax * Xi)
         # XiPoint records the linearization point of the last solve, so the
         # refined re-solve below reproduces exactly that solve
-        return (i + 1, XiNext, XiLast, Xi, conv)
+        return (i + 1, XiNext, XiLast,
+                jnp.where(finite, Xi, Xi_prev),        # last finite iterate
+                conv | ~finite, froze | ~finite)
 
-    init = (jnp.array(0), XiLast, XiLast, Xi0, jnp.array(False))
+    init = (jnp.array(0), XiLast, XiLast, Xi0,
+            jnp.array(False), jnp.array(False))
     if checkable:
         # scan-based fixed-trip-count variant with the same freeze
         # semantics: jax.experimental.checkify supports scan but not this
@@ -167,12 +344,30 @@ def solve_dynamics(
             state = jax.lax.cond(cond(state), body, lambda s: s, state)
             return state, None
         state, _ = jax.lax.scan(scan_body, init, None, length=nIter + 1)
-        i, _, XiPoint, Xi, converged = state
+        i, _, XiPoint, Xi, done, froze = state
     else:
-        i, _, XiPoint, Xi, converged = jax.lax.while_loop(cond, body, init)
-    # one refined re-solve at the final drag-linearization point recovers
-    # the full f32+refinement accuracy for the returned amplitudes without
-    # paying the refinement inside every fixed-point iteration
-    if refine > 0:
-        Xi = step(XiPoint, refine)
-    return jnp.real(Xi), jnp.imag(Xi), i, converged
+        i, _, XiPoint, Xi, done, froze = jax.lax.while_loop(cond, body, init)
+    converged = done & ~froze
+    # one re-solve at the final drag-linearization point recovers the full
+    # f32+refinement accuracy for the returned amplitudes without paying
+    # the refinement inside every fixed-point iteration — now through the
+    # conditioned-solve recovery ladder, which also yields the per-case
+    # residual / condition-estimate / recovery-tier health record
+    Zr, Zi, F = assemble(XiPoint)
+    xr_c, xi_c, resid, cond_est, tier = solve_complex_6x6_ladder(
+        Zr, Zi, jnp.real(F), jnp.imag(F), refine=refine
+    )
+    Xi_cand = (xr_c + 1j * xi_c).T                             # [6, nw]
+    cand_ok = jnp.all(jnp.isfinite(Xi_cand))
+    # if even the ladder's last tier is non-finite (e.g. NaN node inputs),
+    # fall back to the loop's last finite iterate (zeros if none existed)
+    Xi_out = jnp.where(cand_ok, Xi_cand, Xi)
+    report = SolveReport(
+        converged=converged,
+        iters=i,
+        nonfinite=froze | ~cand_ok,
+        recovery_tier=jnp.max(tier),
+        residual=jnp.max(resid),
+        cond=jnp.max(cond_est),
+    )
+    return jnp.real(Xi_out), jnp.imag(Xi_out), report

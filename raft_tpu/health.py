@@ -1,0 +1,189 @@
+"""Solver-health subsystem: structured per-case solve reports, the
+recovery-tier vocabulary, and host-side quarantine/reporting helpers.
+
+The reference's solver health accounting is a single print statement
+("WARNING - Iteration of dynamics solve unsuccessful...", reference
+raft/raft_model.py:603-611) and nothing else: a NaN'd case propagates
+silently into the response statistics, and a design point that throws
+during setup kills a parameter sweep outright.  At production-sweep scale
+(ROADMAP north star: design sweeps sharded over a device mesh) one bad
+lane must not poison a batched solve, so health is tracked *in-graph*:
+
+ - :class:`SolveReport` is a pytree produced inside the traced
+   fixed-point loop (raft_tpu/dynamics.py), batched by the same vmaps
+   that batch the solve itself — per (design, case) lane it records the
+   convergence flag, iteration count, final relative residual, a
+   condition estimate of Z(w), a non-finite flag (the NaN quarantine:
+   a non-finite iterate freezes the lane at its last finite state), and
+   the recovery tier the conditioned-solve ladder escalated to;
+ - the host-side helpers below convert the report to NumPy, fan it into
+   result dictionaries, and route warnings through the package logger
+   (``logging.getLogger("raft_tpu")``) so callers can silence or capture
+   solver-health output instead of scraping stdout;
+ - :class:`FailedPoint` is the sweep drivers' quarantine record for a
+   design point whose *host-side* preparation raised (the CPU mooring
+   equilibrium is the usual thrower): the point is reported in the
+   result's ``failed`` list with its batch slot masked, and the sweep
+   completes.
+"""
+
+import dataclasses
+import os
+from typing import NamedTuple
+
+import numpy as np
+
+from raft_tpu.utils.profiling import logger
+
+# recovery tiers of the conditioned-solve ladder (dynamics.solve_complex_
+# 6x6_ladder), escalating per frequency bin:
+TIER_BASELINE = 0    # Gauss-Jordan block solve + standard refinement
+TIER_REFINE = 1      # extra iterative-refinement steps (residual too large)
+TIER_TIKHONOV = 2    # flagged Tikhonov-regularized solve (condition estimate
+#                      blew up / solve non-finite, e.g. a zero-damping
+#                      resonance making Z(w) numerically singular)
+TIER_NAMES = {
+    TIER_BASELINE: "baseline",
+    TIER_REFINE: "extra-refinement",
+    TIER_TIKHONOV: "tikhonov",
+}
+
+
+class SolveReport(NamedTuple):
+    """Per-case solver-health record (a JAX pytree: every field is an
+    array with the lane batch shape — scalar for one case, [ncase] after
+    the case vmap, [ndesign, ncase] in the sweep drivers).
+
+    converged     : bool  — fixed point met the reference's tolerance
+    iters         : int   — fixed-point iterations taken (freeze included)
+    nonfinite     : bool  — a non-finite iterate was quarantined: the lane
+                            froze at its last finite state instead of
+                            propagating NaN/Inf through the batch
+    recovery_tier : int   — max ladder tier over frequency (TIER_*)
+    residual      : float — max over frequency of the final solve's
+                            relative residual |b - A x| / |b|
+    cond          : float — max over frequency of the row-equilibrated
+                            pivot-ratio condition estimate of Z(w)
+    """
+
+    converged: object
+    iters: object
+    nonfinite: object
+    recovery_tier: object
+    residual: object
+    cond: object
+
+
+@dataclasses.dataclass
+class FailedPoint:
+    """A sweep design point quarantined on the host side: its
+    ``_prepare_design`` (geometry packing / statics / mooring equilibrium)
+    raised, so its batch slot was masked and its result rows are NaN."""
+
+    index: int          # position in the sweep's ``points`` list
+    point: dict         # the parameter dict of the failed design point
+    error: str          # "ExceptionType: message" of what prep raised
+
+    def as_dict(self):
+        return {"index": self.index, "point": self.point,
+                "error": self.error}
+
+
+def report_to_numpy(rep):
+    """Device SolveReport -> SolveReport of host NumPy arrays."""
+    return SolveReport(*(np.asarray(f) for f in rep))
+
+
+def report_dict(rep, prefix=""):
+    """SolveReport -> plain dict of NumPy arrays (for results dicts and
+    .npz checkpoints, which cannot hold pytrees)."""
+    rep = report_to_numpy(rep)
+    return {prefix + name: getattr(rep, name) for name in rep._fields}
+
+
+def report_from_dict(d, prefix=""):
+    """Inverse of :func:`report_dict` (checkpoint reload)."""
+    return SolveReport(
+        **{name: np.asarray(d[prefix + name]) for name in SolveReport._fields}
+    )
+
+
+def log_report(rep, label="case", log=None, limit=10):
+    """Route per-lane solver-health warnings through the package logger.
+
+    Replaces the reference's print-only non-convergence WARNING
+    (reference raft/raft_model.py:603-611): callers silence or capture
+    these with standard ``logging`` configuration on the ``raft_tpu``
+    logger.  Returns the number of unhealthy (non-converged or
+    NaN-quarantined) lanes.
+    """
+    log = log or logger
+    rep = report_to_numpy(rep)
+    conv = np.atleast_1d(rep.converged)
+    nonfin = np.atleast_1d(rep.nonfinite)
+    tier = np.atleast_1d(rep.recovery_tier)
+    resid = np.atleast_1d(rep.residual)
+    bad = np.argwhere(~conv | nonfin)
+    for n, idx in enumerate(bad):
+        if n >= limit:
+            log.warning(
+                "%s solver health: ... and %d more unhealthy lanes",
+                label, len(bad) - limit,
+            )
+            break
+        i = tuple(int(v) for v in idx)
+        tag = f"{label} {i[0] + 1}" if len(i) == 1 else f"{label} {i}"
+        if nonfin[tuple(idx)]:
+            log.warning(
+                "%s produced non-finite iterates; lane quarantined at its "
+                "last finite state (NaN frozen, response reported as zero "
+                "where no finite iterate exists)", tag,
+            )
+        else:
+            log.warning(
+                "%s dynamics iteration did not converge to the tolerance "
+                "(residual %.3g, recovery tier %s)",
+                tag, float(resid[tuple(idx)]),
+                TIER_NAMES.get(int(tier[tuple(idx)]), "?"),
+            )
+    n_tik = int(np.sum(tier >= TIER_TIKHONOV))
+    if n_tik:
+        log.warning(
+            "%s solver health: %d lane(s) fell back to the flagged "
+            "Tikhonov-regularized solve (ill-conditioned Z(w)); their "
+            "responses are regularized approximations", label, n_tik,
+        )
+    return int(len(bad))
+
+
+# ---------------------------------------------------------------------------
+# RAFT_TPU_DEBUG_NANS: opt-in debugging switch.  When set, jax_debug_nans is
+# enabled (XLA re-runs the offending primitive un-jitted and raises at the
+# first NaN) and Model builds the scan-based "checkable" fixed point that
+# jax.experimental.checkify supports (raft_tpu.validate.checked_pipeline).
+# ---------------------------------------------------------------------------
+
+DEBUG_NANS_ENV = "RAFT_TPU_DEBUG_NANS"
+_TRUTHY = ("1", "true", "yes", "on")
+
+
+def debug_nans_requested(environ=None):
+    """Whether the RAFT_TPU_DEBUG_NANS environment switch is on."""
+    env = os.environ if environ is None else environ
+    return str(env.get(DEBUG_NANS_ENV, "")).strip().lower() in _TRUTHY
+
+
+def apply_debug_nans(environ=None):
+    """Apply the RAFT_TPU_DEBUG_NANS switch and return its state.
+
+    When the switch is on, enables ``jax_debug_nans``; when off, jax
+    config is left untouched (so a user's manual
+    ``jax.config.update("jax_debug_nans", True)`` is never clobbered).
+    The returned bool doubles as the ``checkable`` pipeline selector.
+    """
+    on = debug_nans_requested(environ)
+    if on:
+        import jax
+
+        jax.config.update("jax_debug_nans", True)
+    return on

@@ -34,6 +34,12 @@ from raft_tpu.hydro import (
     make_wave_spectrum,
 )
 from raft_tpu.dynamics import solve_dynamics
+from raft_tpu.health import (
+    apply_debug_nans,
+    log_report,
+    report_dict,
+    report_to_numpy,
+)
 from raft_tpu.io.schema import cases_as_dicts, get_from_dict, load_design
 from raft_tpu.mooring import (
     case_mooring_batch_fn,
@@ -44,7 +50,7 @@ from raft_tpu.mooring import (
 )
 from raft_tpu.statics import compute_statics, member_inertia
 from raft_tpu.utils.placement import backend_sharding, put_cpu
-from raft_tpu.utils.profiling import timer
+from raft_tpu.utils.profiling import logger, timer
 from raft_tpu.utils.frames import (
     transform_force,
     translate_matrix_3to6,
@@ -94,16 +100,22 @@ def _uniform_heading_grid(headings, resolution=1e-3, max_grid=73):
 
 
 def make_case_dynamics(w, k, depth, rho, g, XiStart, nIter, dtype, cdtype,
-                       checkable=False):
+                       checkable=False, relax=0.8):
     """Build the single-case device function
     ``fn(nodes, zeta[nw], beta, C_lin[6,6], M_lin[nw,6,6], B_lin[nw,6,6],
-    F_add_r[nw,6], F_add_i[nw,6]) -> (Xi_r[6,nw], Xi_i[6,nw], iters, conv)``.
+    F_add_r[nw,6], F_add_i[nw,6]) -> (Xi_r[6,nw], Xi_i[6,nw], report)``
+    where ``report`` is a :class:`raft_tpu.health.SolveReport` pytree
+    (convergence flag, iteration count, NaN-quarantine flag, recovery
+    tier, residual, condition estimate — all batched by the callers'
+    vmaps alongside the amplitudes).
 
     ``nodes`` is an explicit argument (a HydroNodes pytree in the working
     dtype) so callers can vmap over *designs* as well as cases — the sweep
     driver (raft_tpu/sweep.py) batches padded node bundles over a device
     mesh, while :meth:`Model.case_pipeline_fn` closes over one design's
-    nodes and vmaps over cases only.
+    nodes and vmaps over cases only.  ``relax`` is the new-iterate weight
+    of the under-relaxed fixed point (reference: 0.8); the sweep drivers'
+    non-convergence retry passes a smaller value.
     """
     w = np.asarray(w).astype(dtype)
     k = np.asarray(k).astype(dtype)
@@ -127,11 +139,11 @@ def make_case_dynamics(w, k, depth, rho, g, XiStart, nIter, dtype, cdtype,
             F_iner = excitation_froude_krylov(nodes, u, ud, pD, rho)  # [nw,6]
             Fr = jnp.real(F_iner) + F_add_r
             Fi = jnp.imag(F_iner) + F_add_i
-            xr, xi, iters, conv = solve_dynamics(
+            xr, xi, report = solve_dynamics(
                 nodes, u, w, dw, rho, M_lin, B_lin, C_lin, Fr, Fi,
-                XiStart, nIter=nIter, checkable=checkable,
+                XiStart, nIter=nIter, checkable=checkable, relax=relax,
             )
-        return xr, xi, iters, conv
+        return xr, xi, report
 
     return one_case
 
@@ -456,7 +468,7 @@ class Model:
         """The (un-jitted) batched device function for the case dynamics:
         (zeta[nc,nw], beta[nc], C_lin[nc,6,6], M_lin[nc,nw,6,6],
         B_lin[nc,nw,6,6], F_add_r[nc,nw,6], F_add_i[nc,nw,6])
-        -> (Xi_r[nc,6,nw], Xi_i[nc,6,nw], iters[nc], conv[nc]).
+        -> (Xi_r[nc,6,nw], Xi_i[nc,6,nw], SolveReport with [nc] fields).
 
         Exposed separately so the driver entry point and the multichip dryrun
         can jit it with explicit shardings.  ``wrap`` is applied to the
@@ -475,8 +487,13 @@ class Model:
         return jax.vmap(fn)
 
     def _build_pipeline(self):
-        """The single jitted device graph: [case] -> Xi, F_iner."""
-        return jax.jit(self.case_pipeline_fn())
+        """The single jitted device graph: [case] -> Xi, SolveReport.
+
+        The RAFT_TPU_DEBUG_NANS=1 environment switch enables
+        ``jax_debug_nans`` and selects the scan-based checkable fixed
+        point (the variant jax.experimental.checkify supports — see
+        raft_tpu.validate.checked_pipeline)."""
+        return jax.jit(self.case_pipeline_fn(checkable=apply_debug_nans()))
 
     def prepare_case_inputs(self, cases=None, verbose=True):
         """Host-side setup for the batched case solve: per-case aero means,
@@ -628,8 +645,6 @@ class Model:
                 else:
                     self.run_bem(headings=headings)
             elif meshDir:
-                from raft_tpu.utils.profiling import logger
-
                 logger.warning(
                     "analyze_cases: BEM coefficients already loaded; "
                     "meshDir ignored — call preprocess_hams() directly to "
@@ -661,17 +676,19 @@ class Model:
                 )
             else:
                 dev_args = tuple(jnp.asarray(a) for a in args)
-            xr, xi, iters, conv = self._pipeline(*dev_args)
+            xr, xi, report = self._pipeline(*dev_args)
             jax.block_until_ready(xr)
         Xi = np.asarray(xr, np.float64) + 1j * np.asarray(xi, np.float64)  # [case,6,nw]
         self.Xi = Xi
         self.zeta = zeta
-        for i in range(ncase):
-            if not bool(conv[i]):
-                print(
-                    f"WARNING - case {i+1} dynamics iteration did not converge "
-                    f"to the tolerance."
-                )
+        # solver health: per-case report surfaced in the results dict and
+        # routed through the package logger (callers can silence/capture
+        # it; the reference's equivalent is a bare print,
+        # raft/raft_model.py:603-611)
+        report = report_to_numpy(report)
+        self.solve_report = report
+        self.results["solve_report"] = report_dict(report)
+        log_report(report, label="case", log=logger)
 
         # ---- response metrics (reference raft_fowt.py:706-833 and
         # raft_model.py:158-309) ----

@@ -420,6 +420,29 @@ class RAFT_OMDAO(_ComponentBase):
         self.add_output("stats_wind_PSD", val=np.zeros((n_cases, nfreq)))
         self.add_output("stats_wave_PSD", val=np.zeros((n_cases, nfreq)))
 
+        # ---- per-case solver health (raft_tpu/health.py SolveReport):
+        # replaces the reference's print-only non-convergence WARNING with
+        # real outputs an optimizer driver can gate on
+        self.add_output("solver_converged", val=np.zeros(n_cases),
+                        desc="1.0 where the case's dynamics fixed point "
+                             "converged to the tolerance")
+        self.add_output("solver_iters", val=np.zeros(n_cases),
+                        desc="fixed-point iterations per case")
+        self.add_output("solver_nonfinite", val=np.zeros(n_cases),
+                        desc="1.0 where a non-finite iterate was "
+                             "NaN-quarantined (response frozen at the "
+                             "last finite state)")
+        self.add_output("solver_recovery_tier", val=np.zeros(n_cases),
+                        desc="conditioned-solve recovery tier taken "
+                             "(0 baseline, 1 extra refinement, 2 flagged "
+                             "Tikhonov)")
+        self.add_output("solver_residual", val=np.zeros(n_cases),
+                        desc="final relative residual of the 6x6 solves "
+                             "(max over frequency)")
+        self.add_output("solver_all_healthy", val=0.0,
+                        desc="1.0 iff every case converged with no "
+                             "NaN-quarantined lane")
+
         self.add_output("Max_Offset", val=0, units="m")
         self.add_output("heave_avg", val=0, units="m")
         self.add_output("Max_PtfmPitch", val=0, units="deg")
@@ -788,6 +811,28 @@ class RAFT_OMDAO(_ComponentBase):
                 if np.iscomplexobj(val):
                     val = np.abs(val)
                 outputs[name] = val[0] if val.ndim > 1 else val
+
+        # solver-health outputs + warning (the reference only prints;
+        # here a driver can constrain on solver_all_healthy and callers
+        # capture the warning through the 'raft_tpu' logger)
+        rep = model.solve_report
+        outputs["solver_converged"][case_mask] = rep.converged.astype(float)
+        outputs["solver_iters"][case_mask] = rep.iters.astype(float)
+        outputs["solver_nonfinite"][case_mask] = rep.nonfinite.astype(float)
+        outputs["solver_recovery_tier"][case_mask] = \
+            rep.recovery_tier.astype(float)
+        outputs["solver_residual"][case_mask] = rep.residual.astype(float)
+        healthy = bool(rep.converged.all()) and not bool(rep.nonfinite.any())
+        outputs["solver_all_healthy"] = float(healthy)
+        if not healthy:
+            from raft_tpu.utils.profiling import logger
+
+            logger.warning(
+                "RAFT_OMDAO: %d of %d case(s) unhealthy (non-converged or "
+                "NaN-quarantined); see the solver_* outputs",
+                int(np.sum(~rep.converged | rep.nonfinite)),
+                len(rep.converged),
+            )
 
         cm = results["case_metrics"]
         for n in _STAT_CHANNELS:

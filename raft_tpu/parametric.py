@@ -1013,7 +1013,7 @@ def build_design_response(base_design, metrics=METRIC_NAMES,
             B_lin = b1[:, None, None] * P_hub
             return one_case(nodes, z, b, C, M_lin, B_lin, Fz, Fz)
 
-        xr, xi, _iters, _conv = jax.vmap(dyn_one)(
+        xr, xi, _rep = jax.vmap(dyn_one)(
             zeta_j, beta_j, C_lin, a_hub, b_hub)   # [nc, 6, nw]
         Xi2 = xr**2 + xi**2
         std = jnp.sqrt(jnp.sum(Xi2, axis=-1) * dw)              # [nc, 6]

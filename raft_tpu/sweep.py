@@ -18,7 +18,14 @@ one full Model run each, no checkpointing) with a TPU-first batch pipeline:
  - chunks of `mesh size` designs are processed at a time, and every chunk's
    results are checkpointed to an .npz so a crashed 243-point sweep resumes
    instead of restarting (the reference has no checkpoint/restart —
-   SURVEY.md §5).
+   SURVEY.md §5);
+ - the sweep is fault-isolated: a design point whose host-side prep
+   raises (the CPU mooring equilibrium is the usual thrower) is
+   quarantined into the result's ``failed`` list with its batch slot
+   masked, device-side NaN lanes freeze in-graph and surface through the
+   per-point SolveReport fields, and non-converged lanes get one bounded
+   retry re-solve with doubled nIter and stronger under-relaxation — the
+   sweep always completes (raft_tpu/health.py).
 
 Typical use::
 
@@ -30,15 +37,17 @@ import copy
 import dataclasses
 import itertools
 import os
+import zipfile
 
 import numpy as np
 
 import jax
-import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from raft_tpu.geometry import HydroNodes
+from raft_tpu.health import FailedPoint
 from raft_tpu.model import Model, make_case_dynamics
+from raft_tpu.utils.profiling import logger
 
 
 def grid_points(axes):
@@ -140,6 +149,11 @@ def initialize_distributed(coordinator=None, num_processes=None,
 def _load_checkpoint(ck_path):
     """Load a chunk checkpoint if it exists; returns None to recompute.
 
+    A corrupt, truncated, or incomplete checkpoint (a crash mid-write in
+    a pre-atomic-write run, disk trouble, a stray file) is *deleted* with
+    a logged reason and the chunk recomputed — never silently trusted and
+    never allowed to poison the restart.
+
     Multi-process coherent: the exists/recompute decision is taken on
     process 0 and broadcast, so every host makes the same choice
     (recomputing a chunk runs global collectives that need all processes).
@@ -150,16 +164,35 @@ def _load_checkpoint(ck_path):
     if ck_path is None:
         return None
 
+    def _discard(reason):
+        logger.warning(
+            "sweep checkpoint %s %s; deleting it and recomputing the chunk",
+            ck_path, reason,
+        )
+        if jax.process_index() == 0:
+            try:
+                os.remove(ck_path)
+            except OSError:
+                pass
+        return None
+
     def _try_load():
-        # a checkpoint from an older (pre-atomic-write) run can be
-        # truncated; treat an unreadable file as absent
         if not os.path.exists(ck_path):
             return None
         try:
             with np.load(ck_path, allow_pickle=False) as zf:
-                return {key: zf[key] for key in zf.files}
-        except Exception:
-            return None
+                data = {key: zf[key] for key in zf.files}
+        except (OSError, ValueError, EOFError, KeyError,
+                zipfile.BadZipFile) as e:
+            return _discard(
+                f"is corrupt or truncated ({type(e).__name__}: {e})"
+            )
+        if "_all_failed" not in data and "Xi_r" not in data:
+            return _discard(
+                "is missing the required result arrays (incomplete write "
+                "or foreign file)"
+            )
+        return data
 
     if jax.process_count() == 1:
         return _try_load()
@@ -194,6 +227,65 @@ def _fetch(x):
     return np.asarray(x)
 
 
+# jitted sweep executables cached at module level (keyed on the physics
+# scalars, grid, dtype, fixed-point parameters, and sharding) so repeated
+# sweeps — and the bounded non-convergence retry, which needs a second
+# executable with doubled nIter — never recompile per run_sweep call
+_PIPELINE_CACHE = {}
+
+# SolveReport fields as flat result/checkpoint keys, with the fill value
+# used for masked rows (quarantined prep failures and ragged padding)
+_REPORT_FILLS = {
+    "converged": False, "iters": 0, "nonfinite": False,
+    "recovery_tier": 0, "residual": np.nan, "cond": np.nan,
+}
+
+
+def _sweep_pipeline(model0, sharding, nIter, relax):
+    """The jitted [design, case] dynamics executable for ``model0``'s
+    configuration, design axis laid out by ``sharding``."""
+    key = (
+        model0.w.tobytes(), np.asarray(model0.k).tobytes(), model0.nw,
+        float(model0.depth), float(model0.rho_water), float(model0.g),
+        float(model0.XiStart), int(nIter), float(relax),
+        np.dtype(model0.dtype).name, np.dtype(model0.cdtype).name,
+        sharding,
+    )
+    fn = _PIPELINE_CACHE.get(key)
+    if fn is None:
+        one_case = make_case_dynamics(
+            model0.w, model0.k, model0.depth, model0.rho_water, model0.g,
+            model0.XiStart, nIter, model0.dtype, model0.cdtype, relax=relax,
+        )
+        per_design = jax.vmap(one_case, in_axes=(None, 0, 0, 0, 0, 0, 0, 0))
+        fn = jax.jit(
+            jax.vmap(per_design),
+            in_shardings=(sharding,) * 8,
+            out_shardings=sharding,
+        )
+        _PIPELINE_CACHE[key] = fn
+    return fn
+
+
+def _fetch_solve(xr, xi, rep):
+    """Pipeline output -> dict of host NumPy arrays (allgathered)."""
+    out = {"Xi_r": _fetch(xr).astype(np.float64),
+           "Xi_i": _fetch(xi).astype(np.float64)}
+    for name in rep._fields:
+        out[name] = _fetch(getattr(rep, name))
+    return out
+
+
+def _masked_row_fill(template, fill):
+    """NaN/zero row shaped like one entry of ``template``."""
+    t = np.asarray(template)
+    if isinstance(fill, float) and np.isnan(fill) \
+            and not np.issubdtype(t.dtype, np.floating) \
+            and not np.issubdtype(t.dtype, np.complexfloating):
+        fill = 0
+    return np.full(t.shape, fill, t.dtype)
+
+
 def run_sweep(
     base_design,
     points,
@@ -203,6 +295,7 @@ def run_sweep(
     out_dir=None,
     collect=default_collect,
     verbose=True,
+    retry_nonconverged=True,
 ):
     """Run the analysis over all design ``points`` with the design axis
     sharded across ``mesh`` and per-chunk checkpointing under ``out_dir``.
@@ -223,11 +316,23 @@ def run_sweep(
     out_dir : str | None
         Checkpoint directory. Chunk k's results live in ``chunk_{k:04d}.npz``
         and are loaded instead of recomputed on restart.
+    retry_nonconverged : bool
+        Give non-converged (but finite) lanes one bounded retry re-solve
+        with doubled nIter and stronger under-relaxation (relax 0.4
+        instead of the reference's 0.8); the retry result is adopted only
+        where it converges, so first-pass-healthy lanes stay bit-identical.
 
     Returns
     -------
-    dict of stacked result arrays, leading axis = len(points), plus
-    ``Xi`` [npoints, ncase, 6, nw] complex response amplitudes.
+    dict of stacked result arrays, leading axis = len(points): ``Xi``
+    [npoints, ncase, 6, nw] complex response amplitudes, the per-point
+    SolveReport fields (``converged``, ``iters``, ``nonfinite``,
+    ``recovery_tier``, ``residual``, ``cond`` — see raft_tpu/health.py)
+    plus ``retried``, the ``collect`` metrics and ``param_*`` columns,
+    and the fault-isolation record: ``failed`` (list of
+    {index, point, error} dicts for points whose host-side prep raised)
+    with the matching ``failed_mask``.  Failed points' result rows are
+    NaN (flag fields False/0) — they can never be mistaken for physics.
     """
     if mesh is None:
         mesh = make_sweep_mesh()
@@ -236,10 +341,9 @@ def run_sweep(
         os.makedirs(out_dir, exist_ok=True)
 
     sharding = NamedSharding(mesh, P("design"))
-    pipeline = None  # built after the first chunk is prepped (needs w grid)
 
     npoints = len(points)
-    chunk_results = []
+    chunk_records = []  # per chunk: dict(res | None, failed, n_real, k0)
     for k0 in range(0, npoints, n_dev):
         k = k0 // n_dev
         ck_path = os.path.join(out_dir, f"chunk_{k:04d}.npz") if out_dir else None
@@ -248,75 +352,206 @@ def run_sweep(
 
         loaded = _load_checkpoint(ck_path)
         if loaded is not None:
-            chunk_results.append(loaded)
+            fidx = loaded.pop("_failed_idx", None)
+            fmsg = loaded.pop("_failed_msg", None)
+            failed = [
+                (int(i), chunk_pts[int(i) - k0], str(m))
+                for i, m in zip(
+                    np.atleast_1d(fidx) if fidx is not None else [],
+                    np.atleast_1d(fmsg) if fmsg is not None else [],
+                )
+            ]
+            res = None if loaded.pop("_all_failed", None) is not None \
+                else loaded
+            chunk_records.append(
+                {"res": res, "failed": failed, "n_real": n_real, "k0": k0}
+            )
             if verbose:
-                print(f"sweep chunk {k}: loaded checkpoint ({n_real} designs)")
+                logger.info(
+                    "sweep chunk %d: loaded checkpoint (%d designs)",
+                    k, n_real,
+                )
             continue
 
         # host prep (independent per design; the expensive part is the
-        # vmapped CPU mooring equilibrium inside prepare_case_inputs)
-        models, nodes_list, args_list = [], [], []
-        for pt in chunk_pts:
-            m, nd, ar = _prepare_design(base_design, pt, apply_point, precision)
-            models.append(m)
-            nodes_list.append(nd)
-            args_list.append(ar)
-        # pad the ragged trailing chunk by repeating the last design so the
-        # batch still fills the mesh; the copies are dropped on collect
-        while len(nodes_list) < n_dev:
-            nodes_list.append(nodes_list[-1])
-            args_list.append(args_list[-1])
+        # vmapped CPU mooring equilibrium inside prepare_case_inputs).
+        # Fault isolation: a raising design point is quarantined — its
+        # batch slot is masked with a healthy design and its result rows
+        # reported as NaN + failed, so one bad design dict cannot kill
+        # the whole sweep.
+        preps = [None] * n_real
+        failed = []
+        for j, pt in enumerate(chunk_pts):
+            try:
+                preps[j] = _prepare_design(
+                    base_design, pt, apply_point, precision
+                )
+            except Exception as e:  # noqa: BLE001 — quarantine any prep fault
+                msg = f"{type(e).__name__}: {e}"
+                failed.append((k0 + j, pt, msg))
+                logger.warning(
+                    "sweep point %d quarantined: design prep raised (%s)",
+                    k0 + j, msg,
+                )
 
-        nodes_b = pad_and_stack_nodes(nodes_list)
-        args_b = tuple(
-            np.stack([a[i] for a in args_list]) for i in range(len(args_list[0]))
-        )
-
-        if pipeline is None:
-            m0 = models[0]
-            one_case = make_case_dynamics(
-                m0.w, m0.k, m0.depth, m0.rho_water, m0.g,
-                m0.XiStart, m0.nIter, m0.dtype, m0.cdtype,
+        ok = [j for j in range(n_real) if preps[j] is not None]
+        if not ok:
+            res = None  # whole chunk failed host-side; no device solve
+        else:
+            # explicit slot map: every device slot names the prep it
+            # carries and ``valid`` marks the slots whose results are
+            # real.  Failed-prep slots and the ragged-tail padding slots
+            # are filled with the chunk's first healthy design purely to
+            # keep the batch shape — the mask guarantees those copies can
+            # never leak into collected metrics.
+            fill = ok[0]
+            slot = [j if (j < n_real and preps[j] is not None) else fill
+                    for j in range(n_dev)]
+            valid = np.array(
+                [j < n_real and preps[j] is not None for j in range(n_dev)]
             )
-            per_design = jax.vmap(one_case, in_axes=(None, 0, 0, 0, 0, 0, 0, 0))
-            pipeline = jax.jit(
-                jax.vmap(per_design),
-                in_shardings=(sharding,) * 8,
-                out_shardings=sharding,
+            nodes_list = [preps[s][1] for s in slot]
+            args_list = [preps[s][2] for s in slot]
+
+            nodes_b = pad_and_stack_nodes(nodes_list)
+            args_b = tuple(
+                np.stack([a[i] for a in args_list])
+                for i in range(len(args_list[0]))
             )
 
-        dev_in = jax.device_put((nodes_b,) + args_b, sharding)
-        xr, xi, iters, conv = pipeline(*dev_in)
-        xr = _fetch(xr).astype(np.float64)
-        xi = _fetch(xi).astype(np.float64)
-        Xi = xr + 1j * xi  # [n_dev, ncase, 6, nw]
+            m0 = preps[fill][0]
+            pipeline = _sweep_pipeline(m0, sharding, m0.nIter, 0.8)
+            dev_in = jax.device_put((nodes_b,) + args_b, sharding)
+            sol = _fetch_solve(*pipeline(*dev_in))
 
-        res = {"Xi_r": xr[:n_real], "Xi_i": xi[:n_real],
-               "converged": _fetch(conv)[:n_real]}
-        per_design_metrics = [
-            collect(models[i], chunk_pts[i], Xi[i]) for i in range(n_real)
-        ]
-        for key in per_design_metrics[0]:
-            res[key] = np.stack([d[key] for d in per_design_metrics])
-        for name in chunk_pts[0]:
-            res[f"param_{name}"] = np.array([pt[name] for pt in chunk_pts])
+            # bounded retry: one re-solve of the chunk with doubled nIter
+            # and stronger under-relaxation; adopted per lane only where
+            # the retry actually converges (NaN-quarantined lanes are
+            # excluded — more iterations cannot fix non-finite inputs)
+            retry_mask = valid[:, None] & ~sol["converged"] \
+                & ~sol["nonfinite"]
+            sol["retried"] = np.zeros_like(retry_mask)
+            if retry_nonconverged and retry_mask.any():
+                pipe2 = _sweep_pipeline(m0, sharding, 2 * m0.nIter, 0.4)
+                sol2 = _fetch_solve(*pipe2(*dev_in))
+                use = retry_mask & sol2["converged"]
+                for key in ("Xi_r", "Xi_i"):
+                    sol[key] = np.where(
+                        use[:, :, None, None], sol2[key], sol[key]
+                    )
+                for key in _REPORT_FILLS:
+                    sol[key] = np.where(use, sol2[key], sol[key])
+                sol["retried"] = retry_mask
+                logger.warning(
+                    "sweep chunk %d: %d non-converged lane(s) retried with "
+                    "doubled nIter / relax=0.4; %d recovered",
+                    k, int(retry_mask.sum()), int(use.sum()),
+                )
+
+            # mask quarantined rows before anything downstream sees them
+            inv = ~valid[:n_real]
+            res = {}
+            for key in ("Xi_r", "Xi_i"):
+                a = sol[key][:n_real].copy()
+                a[inv] = np.nan
+                res[key] = a
+            for key, fillval in _REPORT_FILLS.items():
+                # fill values are dtype-matched (bool->False, int->0,
+                # float->NaN), so masked rows assign directly
+                a = sol[key][:n_real].copy()
+                a[inv] = fillval
+                res[key] = a
+            res["retried"] = sol["retried"][:n_real].copy()
+            res["retried"][inv] = False
+
+            Xi = res["Xi_r"] + 1j * res["Xi_i"]  # [n_real, ncase, 6, nw]
+            per_metrics = [
+                collect(preps[j][0], chunk_pts[j], Xi[j]) if valid[j]
+                else None
+                for j in range(n_real)
+            ]
+            template = per_metrics[ok[0]]
+            for key in template:
+                res[key] = np.stack([
+                    np.asarray(per_metrics[j][key])
+                    if per_metrics[j] is not None
+                    else _masked_row_fill(template[key], np.nan)
+                    for j in range(n_real)
+                ])
+            for name in chunk_pts[0]:
+                res[f"param_{name}"] = np.array(
+                    [pt[name] for pt in chunk_pts]
+                )
 
         if ck_path and jax.process_index() == 0:
             # one writer in multi-process runs (every host holds the full
             # allgathered results, so checkpoints stay restartable anywhere);
             # write-then-rename so a crash mid-write never leaves a
             # truncated chunk that would poison the restart
+            save = {} if res is None else dict(res)
+            if res is None:
+                save["_all_failed"] = np.array(True)
+            if failed:
+                save["_failed_idx"] = np.array([f[0] for f in failed], int)
+                save["_failed_msg"] = np.array([f[2] for f in failed])
             tmp_path = ck_path + ".tmp.npz"
-            np.savez(tmp_path, **res)
+            np.savez(tmp_path, **save)
             os.replace(tmp_path, ck_path)
         if verbose:
-            print(f"sweep chunk {k}: solved {n_real} designs on {n_dev} devices")
-        chunk_results.append(res)
+            logger.info(
+                "sweep chunk %d: solved %d designs on %d devices"
+                "%s", k, n_real - len(failed), n_dev,
+                f" ({len(failed)} quarantined)" if failed else "",
+            )
+        chunk_records.append(
+            {"res": res, "failed": failed, "n_real": n_real, "k0": k0}
+        )
 
+    proto = next(
+        (r["res"] for r in chunk_records if r["res"] is not None), None
+    )
+    if proto is None:
+        first = chunk_records[0]["failed"][0]
+        raise RuntimeError(
+            f"run_sweep: every design point failed host-side preparation; "
+            f"first error at point {first[0]}: {first[2]}"
+        )
     out = {}
-    for key in chunk_results[0]:
-        out[key] = np.concatenate([c[key] for c in chunk_results], axis=0)
+    for key in proto:
+        parts = []
+        for rec in chunk_records:
+            if rec["res"] is not None and key in rec["res"]:
+                parts.append(rec["res"][key])
+            elif rec["res"] is not None:
+                # checkpoint written by an older schema (missing a newer
+                # result column): fill masked rows rather than crash
+                parts.append(np.stack(
+                    [_masked_row_fill(proto[key][0],
+                                      _REPORT_FILLS.get(key, np.nan))]
+                    * rec["n_real"]
+                ))
+            elif key.startswith("param_"):
+                name = key[len("param_"):]
+                parts.append(np.array([
+                    pt[name]
+                    for pt in points[rec["k0"]: rec["k0"] + rec["n_real"]]
+                ]))
+            else:
+                parts.append(np.stack(
+                    [_masked_row_fill(proto[key][0],
+                                      _REPORT_FILLS.get(key, np.nan))]
+                    * rec["n_real"]
+                ))
+        out[key] = np.concatenate(parts, axis=0)
     out["Xi"] = out.pop("Xi_r") + 1j * out.pop("Xi_i")
+    failed_all = [f for rec in chunk_records for f in rec["failed"]]
+    out["failed"] = [
+        FailedPoint(i, pt, msg).as_dict() for i, pt, msg in failed_all
+    ]
+    mask = np.zeros(npoints, bool)
+    for i, _, _ in failed_all:
+        mask[i] = True
+    out["failed_mask"] = mask
     return out
 
 

@@ -59,6 +59,7 @@ from raft_tpu.mooring import (
 from raft_tpu.statics import compute_statics
 from raft_tpu.sweep import pad_and_stack_nodes
 from raft_tpu.utils.placement import put_cpu
+from raft_tpu.utils.profiling import logger
 
 _am_f64 = jax.jit(added_mass_morison)
 
@@ -358,22 +359,24 @@ def _shard_pipeline_args(dev_args, mesh):
             jax.device_put(a, s_d), jax.device_put(b, s_d))
 
 
-def _dynamics_pipeline(model0, return_xi):
+def _dynamics_pipeline(model0, return_xi, nIter=None, relax=0.8):
     """Jitted sweep dynamics for ``model0``'s configuration, cached so
-    repeated sweeps (and the benchmark's hot re-run) reuse one executable."""
+    repeated sweeps (and the benchmark's hot re-run) reuse one executable.
+    ``nIter``/``relax`` overrides serve the bounded non-convergence retry
+    (doubled iteration budget, stronger under-relaxation)."""
     return _dynamics_pipeline_cached(
         model0.w.tobytes(), np.asarray(model0.k).tobytes(), model0.nw,
         float(model0.depth), float(model0.rho_water), float(model0.g),
-        float(model0.XiStart), int(model0.nIter),
+        float(model0.XiStart), int(nIter or model0.nIter),
         np.dtype(model0.dtype).name, np.dtype(model0.cdtype).name,
-        float(model0.hHub), bool(return_xi),
+        float(model0.hHub), bool(return_xi), float(relax),
     )
 
 
 @lru_cache(maxsize=16)
 def _dynamics_pipeline_cached(w_bytes, k_bytes, nw, depth, rho, g,
                               XiStart, nIter, dtype_name, cdtype_name,
-                              hHub, return_xi):
+                              hHub, return_xi, relax=0.8):
     """Build the jitted sweep pipeline: lax.map over draft groups, vmap
     over (draft-in-group, ballast, case).
 
@@ -392,7 +395,7 @@ def _dynamics_pipeline_cached(w_bytes, k_bytes, nw, depth, rho, g,
     k = np.frombuffer(k_bytes, np.float64, count=nw)
     dw = float(w[1] - w[0])
     one_case = make_case_dynamics(
-        w, k, depth, rho, g, XiStart, nIter, dtype, cdtype,
+        w, k, depth, rho, g, XiStart, nIter, dtype, cdtype, relax=relax,
     )
     E00 = np.zeros((1, 3, 3))
     E00[0, 0, 0] = 1.0
@@ -409,13 +412,13 @@ def _dynamics_pipeline_cached(w_bytes, k_bytes, nw, depth, rho, g,
             B_lin = b1[:, None, None] * P_hub
             return one_case(nodes, z, b, C, M_lin, B_lin, Fz, Fz)
 
-        xr, xi, iters, conv = jax.vmap(fn)(
+        xr, xi, rep = jax.vmap(fn)(
             zeta, beta, C_case, a_c, b_c
-        )  # [nc, ...]
+        )  # [nc, ...]; rep: SolveReport with [nc] fields
         std = jnp.sqrt(jnp.sum(xr * xr + xi * xi, axis=-1) * dw)  # [nc, 6]
         if return_xi:
-            return std, iters, conv, xr, xi
-        return std, iters, conv
+            return std, rep, xr, xi
+        return std, rep
 
     # [gd, nB] design axes inside a group; nodes shared along ballast
     per_draft = jax.vmap(per_design, in_axes=(None, None, None, 0, 0, 0, 0))
@@ -431,6 +434,91 @@ def _dynamics_pipeline_cached(w_bytes, k_bytes, nw, depth, rho, g,
     return jax.jit(pipeline)
 
 
+def _solve_fused_dynamics(model0, dev_args, return_xi, nd_flat, nc,
+                          retry_nonconverged=True, label="fused sweep"):
+    """Dispatch the fused dynamics pipeline, fetch + flatten the results
+    to a leading [nd_flat] design axis, and give non-converged *finite*
+    lanes one bounded retry re-solve with doubled nIter and stronger
+    under-relaxation (relax 0.4); the retry is adopted per lane only
+    where it converges, so first-pass-healthy lanes stay bit-identical.
+
+    Returns (sol dict, first-dispatch seconds, compiled flops)."""
+    from raft_tpu.utils.profiling import compiled_flops
+
+    pipeline = _dynamics_pipeline(model0, return_xi)
+    t0 = time.perf_counter()
+    dyn = pipeline(*dev_args)
+    jax.block_until_ready(dyn)
+    t_dyn = time.perf_counter() - t0
+    dyn_flops = compiled_flops(pipeline, dev_args)
+
+    def unpack(dyn):
+        rep = dyn[1]
+        out = {
+            "std": np.asarray(dyn[0], np.float64).reshape(nd_flat, nc, 6),
+            "iters": np.asarray(rep.iters).reshape(nd_flat, nc),
+            "converged": np.asarray(rep.converged).reshape(nd_flat, nc),
+            "nonfinite": np.asarray(rep.nonfinite).reshape(nd_flat, nc),
+            "recovery_tier": np.asarray(
+                rep.recovery_tier).reshape(nd_flat, nc),
+            "residual": np.asarray(
+                rep.residual, np.float64).reshape(nd_flat, nc),
+            "cond": np.asarray(rep.cond, np.float64).reshape(nd_flat, nc),
+        }
+        if return_xi:
+            out["xr"] = np.asarray(dyn[2], np.float64).reshape(
+                nd_flat, nc, 6, model0.nw)
+            out["xi"] = np.asarray(dyn[3], np.float64).reshape(
+                nd_flat, nc, 6, model0.nw)
+        return out
+
+    sol = unpack(dyn)
+    retry_mask = ~sol["converged"] & ~sol["nonfinite"]
+    sol["retried"] = np.zeros_like(retry_mask)
+    if retry_nonconverged and retry_mask.any():
+        pipe2 = _dynamics_pipeline(
+            model0, return_xi, nIter=2 * model0.nIter, relax=0.4)
+        dyn2 = pipe2(*dev_args)
+        jax.block_until_ready(dyn2)
+        sol2 = unpack(dyn2)
+        use = retry_mask & sol2["converged"]
+        sol["std"] = np.where(use[:, :, None], sol2["std"], sol["std"])
+        for key in ("iters", "converged", "nonfinite", "recovery_tier",
+                    "residual", "cond"):
+            sol[key] = np.where(use, sol2[key], sol[key])
+        if return_xi:
+            for key in ("xr", "xi"):
+                sol[key] = np.where(
+                    use[:, :, None, None], sol2[key], sol[key])
+        sol["retried"] = retry_mask
+        logger.warning(
+            "%s: %d non-converged lane(s) retried with doubled nIter / "
+            "relax=0.4; %d recovered",
+            label, int(retry_mask.sum()), int(use.sum()),
+        )
+    return sol, t_dyn, dyn_flops
+
+
+def _quarantine_design_rows(res, fmask, lead_shape):
+    """Mask failed designs' rows across every per-design result array
+    (floats -> NaN, bools -> False, ints -> 0) so a quarantined slot can
+    never be mistaken for physics."""
+    if not fmask.any():
+        return
+    nlead = len(lead_shape)
+    for key, a in list(res.items()):
+        if not isinstance(a, np.ndarray) or a.shape[:nlead] != lead_shape:
+            continue
+        a = np.array(a)  # some result arrays are read-only jax views
+        if a.dtype == bool:
+            a[fmask] = False
+        elif np.issubdtype(a.dtype, np.integer):
+            a[fmask] = 0
+        else:
+            a[fmask] = np.nan
+        res[key] = a
+
+
 def run_draft_ballast_sweep(
     base_design,
     draft_scales,
@@ -440,6 +528,7 @@ def run_draft_ballast_sweep(
     return_xi=False,
     verbose=True,
     mesh=None,
+    retry_nonconverged=True,
 ):
     """Run the fused draft x ballast sweep.
 
@@ -496,16 +585,37 @@ def run_draft_ballast_sweep(
         )
 
     # ---- host prep: one variant per draft, ballast by linearity
-    # (threaded + variant-cached like the general design sweep) ----
+    # (threaded + variant-cached like the general design sweep).  Fault
+    # isolation: a draft whose prep raises is quarantined — its slot is
+    # filled with the first healthy draft to keep the batch shape, and
+    # every (draft, ballast) row it covers is reported NaN + failed. ----
     t0 = time.perf_counter()
     from concurrent.futures import ThreadPoolExecutor
 
+    def _safe_prep(s):
+        try:
+            return _prepare_draft(
+                base_design, s, model0.rho_water, model0.g), None
+        except Exception as e:  # noqa: BLE001 — quarantine any prep fault
+            return None, f"{type(e).__name__}: {e}"
+
     with ThreadPoolExecutor(max_workers=8) as ex:
-        variants = list(ex.map(
-            lambda s: _prepare_draft(
-                base_design, s, model0.rho_water, model0.g),
-            draft_scales,
-        ))
+        prepped = list(ex.map(_safe_prep, draft_scales))
+    failed_drafts = [(i, msg) for i, (v, msg) in enumerate(prepped)
+                     if v is None]
+    for i, msg in failed_drafts:
+        logger.warning(
+            "fused sweep draft %d (scale %g) quarantined: prep raised (%s)",
+            i, float(draft_scales[i]), msg,
+        )
+    ok = [i for i, (v, _) in enumerate(prepped) if v is not None]
+    if not ok:
+        raise RuntimeError(
+            "run_draft_ballast_sweep: every draft variant failed host-side "
+            f"preparation; first error: {failed_drafts[0][1]}"
+        )
+    variants = [prepped[i][0] if prepped[i][0] is not None
+                else prepped[ok[0]][0] for i in range(nD)]
     b = np.asarray(ballast_scales, np.float64)
     comb = [_ballast_combine(v, b) for v in variants]
     t_host = time.perf_counter() - t0
@@ -581,7 +691,6 @@ def run_draft_ballast_sweep(
         + np.stack([v.A_morison for v in variants])[:, None]
     )                                                          # [nD, nB, 6, 6]
 
-    pipeline = _dynamics_pipeline(model0, return_xi)
     dev_args = (
         nodes_g,
         zeta.astype(dtype),
@@ -600,15 +709,14 @@ def run_draft_ballast_sweep(
     else:
         dev_args = (jax.device_put(dev_args[0]),) + tuple(
             jnp.asarray(a) for a in dev_args[1:])
-    t0 = time.perf_counter()
-    dyn = pipeline(*dev_args)
-    jax.block_until_ready(dyn)
-    t_dyn_first = time.perf_counter() - t0  # includes compile on first call
-    from raft_tpu.utils.profiling import compiled_flops
-    dyn_flops = compiled_flops(pipeline, dev_args)
-    std = np.asarray(dyn[0], np.float64).reshape(nd, nc, 6)
-    iters = np.asarray(dyn[1]).reshape(nd, nc)
-    conv = np.asarray(dyn[2]).reshape(nd, nc)
+    sol, t_dyn_first, dyn_flops = _solve_fused_dynamics(
+        model0, dev_args, return_xi, nd, nc,
+        retry_nonconverged=retry_nonconverged,
+        label=f"fused sweep {nD}x{nB}",
+    )  # t_dyn_first includes compile on first call
+    std = sol["std"]
+    iters = sol["iters"]
+    conv = sol["converged"]
 
     # ---- metrics (reference parametersweep getOutputs semantics,
     # reference raft/parametersweep.py:9-21) ----
@@ -634,6 +742,12 @@ def run_draft_ballast_sweep(
         "std": std.reshape(nD, nB, nc, 6),
         "converged": conv.reshape(nD, nB, nc),
         "iters": iters.reshape(nD, nB, nc),
+        # per-point solver health (raft_tpu/health.py SolveReport fields)
+        "nonfinite": sol["nonfinite"].reshape(nD, nB, nc),
+        "recovery_tier": sol["recovery_tier"].reshape(nD, nB, nc),
+        "residual": sol["residual"].reshape(nD, nB, nc),
+        "cond": sol["cond"].reshape(nD, nB, nc),
+        "retried": sol["retried"].reshape(nD, nB, nc),
         "Xi0": r6.reshape(nD, nB, nc, 6),
         "T_moor": T_moor.reshape((nD, nB) + T_moor.shape[1:]),
         "moor_resid": moor_resid.reshape(nD, nB, nc),
@@ -653,16 +767,27 @@ def run_draft_ballast_sweep(
         },
     }
     if return_xi:
-        xr = np.asarray(dyn[3], np.float64).reshape(nd, nc, 6, model0.nw)
-        xi = np.asarray(dyn[4], np.float64).reshape(nd, nc, 6, model0.nw)
-        res["Xi"] = (xr + 1j * xi).reshape(nD, nB, nc, 6, model0.nw)
+        res["Xi"] = (sol["xr"] + 1j * sol["xi"]).reshape(
+            nD, nB, nc, 6, model0.nw)
+    # quarantined drafts: NaN every row they cover + report them
+    fmask = np.zeros((nD, nB), bool)
+    for i, _ in failed_drafts:
+        fmask[i] = True
+    _quarantine_design_rows(res, fmask, (nD, nB))
+    res["failed"] = [
+        {"index": i, "point": {"draft_scale": float(draft_scales[i])},
+         "error": msg}
+        for i, msg in failed_drafts
+    ]
+    res["failed_mask"] = fmask
     if verbose:
         tm = res["timing"]
-        print(
-            f"fused sweep {nD}x{nB}: host {tm['host_prep_s']:.2f}s, "
-            f"aero {tm['aero_first_s'] + tm['aero_second_s']:.2f}s, "
-            f"mooring {tm['mooring_s']:.2f}s, dynamics(first) "
-            f"{tm['dynamics_first_s']:.2f}s, total {tm['total_s']:.2f}s"
+        logger.info(
+            "fused sweep %dx%d: host %.2fs, aero %.2fs, mooring %.2fs, "
+            "dynamics(first) %.2fs, total %.2fs",
+            nD, nB, tm["host_prep_s"],
+            tm["aero_first_s"] + tm["aero_second_s"], tm["mooring_s"],
+            tm["dynamics_first_s"], tm["total_s"],
         )
     return res
 
@@ -875,6 +1000,7 @@ def run_design_sweep(
     trim_ballast_density=False,
     verbose=True,
     mesh=None,
+    retry_nonconverged=True,
 ):
     """Fused sweep over an arbitrary list of design dicts — the general
     form of the reference's 5-parameter geometry study
@@ -921,12 +1047,30 @@ def run_design_sweep(
     t0 = time.perf_counter()
     from concurrent.futures import ThreadPoolExecutor
 
+    def _safe_prep(d):
+        try:
+            return _prepare_design_point(
+                d, model0.rho_water, model0.g, trim_ballast_density), None
+        except Exception as e:  # noqa: BLE001 — quarantine any prep fault
+            return None, f"{type(e).__name__}: {e}"
+
     with ThreadPoolExecutor(max_workers=8) as ex:
-        variants = list(ex.map(
-            lambda d: _prepare_design_point(
-                d, model0.rho_water, model0.g, trim_ballast_density),
-            designs,
-        ))
+        prepped = list(ex.map(_safe_prep, designs))
+    failed_pts = [(i, msg) for i, (v, msg) in enumerate(prepped)
+                  if v is None]
+    for i, msg in failed_pts:
+        logger.warning(
+            "design sweep point %d quarantined: prep raised (%s)", i, msg)
+    ok = [i for i, (v, _) in enumerate(prepped) if v is not None]
+    if not ok:
+        raise RuntimeError(
+            "run_design_sweep: every design failed host-side preparation; "
+            f"first error: {failed_pts[0][1]}"
+        )
+    # failed designs' slots carry the first healthy design (batch shape
+    # only); their result rows are NaN'd + reported below
+    variants = [prepped[i][0] if prepped[i][0] is not None
+                else prepped[ok[0]][0] for i in range(nd)]
     moor_all = tuple(
         np.stack([np.asarray(v.moor[i], np.float64) for v in variants])
         for i in range(7)
@@ -1028,7 +1172,6 @@ def run_design_sweep(
     )[pad_idx]                                          # [nd_pad, nc, 6, 6]
     M0_all = (M_struc + np.stack([v.A_morison for v in variants]))[pad_idx]
 
-    pipeline = _dynamics_pipeline(model0, return_xi)
     dev_args = (
         nodes_g,
         zeta.astype(dtype),
@@ -1047,15 +1190,14 @@ def run_design_sweep(
     else:
         dev_args = (jax.device_put(dev_args[0]),) + tuple(
             jnp.asarray(a) for a in dev_args[1:])
-    t0 = time.perf_counter()
-    dyn = pipeline(*dev_args)
-    jax.block_until_ready(dyn)
-    t_dyn = time.perf_counter() - t0
-    from raft_tpu.utils.profiling import compiled_flops
-    dyn_flops = compiled_flops(pipeline, dev_args)
-    std = np.asarray(dyn[0], np.float64).reshape(nd_pad, nc, 6)[:nd]
-    iters = np.asarray(dyn[1]).reshape(nd_pad, nc)[:nd]
-    conv = np.asarray(dyn[2]).reshape(nd_pad, nc)[:nd]
+    sol, t_dyn, dyn_flops = _solve_fused_dynamics(
+        model0, dev_args, return_xi, nd_pad, nc,
+        retry_nonconverged=retry_nonconverged,
+        label=f"design sweep x{nd}",
+    )
+    std = sol["std"][:nd]
+    iters = sol["iters"][:nd]
+    conv = sol["converged"][:nd]
 
     # ---- metrics (reference parametersweep getOutputs semantics) ----
     offset = np.hypot(r6[:, 0, 0], r6[:, 0, 1])
@@ -1070,6 +1212,12 @@ def run_design_sweep(
         "std": std,
         "converged": conv,
         "iters": iters,
+        # per-point solver health (raft_tpu/health.py SolveReport fields)
+        "nonfinite": sol["nonfinite"][:nd],
+        "recovery_tier": sol["recovery_tier"][:nd],
+        "residual": sol["residual"][:nd],
+        "cond": sol["cond"][:nd],
+        "retried": sol["retried"][:nd],
         "Xi0": r6,
         "F_aero0": F_aero2,
         "T_moor": T_moor,
@@ -1085,17 +1233,21 @@ def run_design_sweep(
         },
     }
     if return_xi:
-        xr = np.asarray(dyn[3], np.float64).reshape(
-            nd_pad, nc, 6, model0.nw)[:nd]
-        xi = np.asarray(dyn[4], np.float64).reshape(
-            nd_pad, nc, 6, model0.nw)[:nd]
-        res["Xi"] = xr + 1j * xi
+        res["Xi"] = sol["xr"][:nd] + 1j * sol["xi"][:nd]
+    # quarantined designs: NaN their rows + report them
+    fmask = np.zeros(nd, bool)
+    for i, _ in failed_pts:
+        fmask[i] = True
+    _quarantine_design_rows(res, fmask, (nd,))
+    res["failed"] = [{"index": i, "error": msg} for i, msg in failed_pts]
+    res["failed_mask"] = fmask
     if verbose:
         tm = res["timing"]
-        print(
-            f"design sweep x{nd}: host {tm['host_prep_s']:.2f}s, "
-            f"aero {tm['aero_first_s'] + tm['aero_second_s']:.2f}s, "
-            f"mooring {tm['mooring_s']:.2f}s, dynamics "
-            f"{tm['dynamics_first_s']:.2f}s, total {tm['total_s']:.2f}s"
+        logger.info(
+            "design sweep x%d: host %.2fs, aero %.2fs, mooring %.2fs, "
+            "dynamics %.2fs, total %.2fs",
+            nd, tm["host_prep_s"],
+            tm["aero_first_s"] + tm["aero_second_s"], tm["mooring_s"],
+            tm["dynamics_first_s"], tm["total_s"],
         )
     return res

@@ -12,10 +12,20 @@ diagonal viability (raft_model.py:419-426), station-count checks
  - ``checked_pipeline(model)``: the case pipeline wrapped in
    ``jax.experimental.checkify`` float checks, so device-side NaN/Inf in
    the solve surfaces as a Python error with a location instead of
-   silently propagating into the response statistics.
+   silently propagating into the response statistics;
+ - the ``RAFT_TPU_DEBUG_NANS=1`` environment switch (re-exported from
+   raft_tpu.health): enables ``jax_debug_nans`` and makes the Model build
+   the scan-based checkable fixed point, so a production run can be
+   re-launched in NaN-hunting mode without a code change.
 """
 
 import numpy as np
+
+from raft_tpu.health import (            # noqa: F401  (re-exported API)
+    DEBUG_NANS_ENV,
+    apply_debug_nans,
+    debug_nans_requested,
+)
 
 
 def _numeric(problems, label, value, cast=float):

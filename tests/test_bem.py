@@ -161,8 +161,8 @@ def test_model_with_bem():
     args, aux = model.prepare_case_inputs()
     # BEM added mass joined the frequency-dependent mass matrix
     assert not np.allclose(args[3][0, 0], args[3][0, -1])
-    xr, xi, iters, conv = jax.jit(model.case_pipeline_fn())(
+    xr, xi, rep = jax.jit(model.case_pipeline_fn())(
         *(np.asarray(a) for a in args)
     )
-    assert np.asarray(conv).all()
+    assert np.asarray(rep.converged).all()
     assert np.isfinite(np.asarray(xr)).all()

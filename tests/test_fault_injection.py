@@ -1,0 +1,310 @@
+"""Fault-injection suite for the solver-health layer (raft_tpu/health.py,
+dynamics NaN quarantine + recovery ladder, sweep quarantine/retry):
+
+ - a design point with NaN node coordinates must freeze in-graph (flagged,
+   finite output) without poisoning the batched sweep;
+ - a design point whose apply_point raises must be quarantined host-side
+   into the result's ``failed`` list;
+ - healthy lanes must be BIT-IDENTICAL to an uninjected run;
+ - a numerically singular Z(w) (zero-damping resonance) must escalate to
+   the flagged Tikhonov tier and stay finite;
+ - a corrupt checkpoint must be deleted with a logged reason and the
+   chunk recomputed;
+ - the RAFT_TPU_DEBUG_NANS env switch must round-trip.
+"""
+
+import dataclasses
+import glob
+import logging
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+import raft_tpu.sweep as sweep_mod
+from raft_tpu.designs import demo_semi
+from raft_tpu.model import Model
+from raft_tpu.sweep import grid_points, run_sweep
+
+NW = (0.05, 0.5)  # small frequency grid keeps the sweep compiles cheap
+
+AXES = {"d_col": [9.0, 10.0, 11.0], "draft_scale": [1.0, 1.1]}  # 6 points
+POISON = 2   # device-side NaN-poisoned point (node coordinates -> NaN)
+RAISER = 4   # host-side prep raiser (quarantined into `failed`)
+
+
+def _base(n_cases=1):
+    return demo_semi(n_cases=n_cases, nw_settings=NW)
+
+
+def _apply_point(design, point):
+    for mem in design["platform"]["members"]:
+        if mem["name"] == "outer":
+            mem["d"] = [point["d_col"]] * len(np.atleast_1d(mem["d"]))
+        mem["rA"][2] *= point["draft_scale"]
+        if mem["rB"][2] < 0:
+            mem["rB"][2] *= point["draft_scale"]
+    return design
+
+
+def _apply_point_faulty(design, point):
+    if point.get("_raise"):
+        raise RuntimeError("injected prep failure")
+    return _apply_point(design, point)
+
+
+@pytest.fixture(scope="module")
+def injected_sweep():
+    """An uninjected reference sweep and the same sweep with one
+    NaN-poisoned and one prep-raising point."""
+    base = _base()
+    clean_pts = grid_points(AXES)
+    res_clean = run_sweep(base, clean_pts, _apply_point, verbose=False)
+
+    inj_pts = grid_points(AXES)
+    inj_pts[RAISER] = dict(inj_pts[RAISER], _raise=True)
+    inj_pts[POISON] = dict(inj_pts[POISON], _poison=True)
+
+    real_prep = sweep_mod._prepare_design
+
+    def poisoned_prep(base_design, pt, apply_point, precision):
+        m, nd, ar = real_prep(base_design, pt, apply_point, precision)
+        if pt.get("_poison"):
+            nd = dataclasses.replace(
+                nd, r=np.full_like(np.asarray(nd.r), np.nan))
+        return m, nd, ar
+
+    sweep_mod._prepare_design = poisoned_prep
+    try:
+        res_inj = run_sweep(
+            base, inj_pts, _apply_point_faulty, verbose=False)
+    finally:
+        sweep_mod._prepare_design = real_prep
+    return res_clean, res_inj
+
+
+def test_sweep_completes_and_flags_exactly_the_injected_points(
+        injected_sweep):
+    res_clean, res_inj = injected_sweep
+    npts = len(grid_points(AXES))
+    assert res_inj["Xi"].shape[0] == npts
+
+    # exactly the raiser is quarantined host-side, with NaN result rows
+    assert [f["index"] for f in res_inj["failed"]] == [RAISER]
+    assert "injected prep failure" in res_inj["failed"][0]["error"]
+    assert res_inj["failed_mask"].tolist() == [
+        i == RAISER for i in range(npts)]
+    assert np.isnan(res_inj["Xi"][RAISER]).all()
+    assert np.isnan(res_inj["mass"][RAISER]).all()
+    assert not res_inj["converged"][RAISER].any()
+
+    # exactly the poisoned point is NaN-quarantined in-graph: flagged,
+    # not converged, and its frozen output is finite (zeros), never NaN
+    nonfin = res_inj["nonfinite"]
+    assert nonfin[POISON].all()
+    assert not res_inj["converged"][POISON].any()
+    assert np.isfinite(res_inj["Xi"][POISON]).all()
+    healthy = [i for i in range(npts) if i not in (POISON, RAISER)]
+    assert not nonfin[healthy].any()
+
+    # the uninjected run is fully healthy
+    assert res_clean["converged"].all()
+    assert not res_clean["nonfinite"].any()
+    assert not res_clean["failed"]
+
+
+def test_healthy_lanes_bit_identical_to_uninjected_run(injected_sweep):
+    res_clean, res_inj = injected_sweep
+    npts = len(grid_points(AXES))
+    healthy = [i for i in range(npts) if i not in (POISON, RAISER)]
+    linf = np.max(np.abs(res_inj["Xi"][healthy] - res_clean["Xi"][healthy]))
+    assert linf <= 1e-12, f"healthy-lane L_inf {linf}"
+    np.testing.assert_array_equal(
+        res_inj["converged"][healthy], res_clean["converged"][healthy])
+    np.testing.assert_array_equal(
+        res_inj["iters"][healthy], res_clean["iters"][healthy])
+    for key in ("mass", "displacement", "GMT"):
+        np.testing.assert_array_equal(
+            res_inj[key][healthy], res_clean[key][healthy])
+
+
+def test_case_pipeline_nan_quarantine_is_per_lane():
+    """One NaN'd case in the Model's batched pipeline freezes its own lane
+    only; the other lane stays bit-identical to a clean run."""
+    m = Model(_base(n_cases=2))
+    m.analyze_unloaded()
+    args, _ = m.prepare_case_inputs(verbose=False)
+    fn = jax.jit(m.case_pipeline_fn())
+    xr0, xi0, rep0 = fn(*(np.asarray(a) for a in args))
+    assert np.asarray(rep0.converged).all()
+    assert not np.asarray(rep0.nonfinite).any()
+
+    bad = [np.array(a, copy=True) for a in args]
+    bad[2][1] = np.nan  # C_lin of case 1 only
+    xr, xi, rep = fn(*bad)
+    assert np.isfinite(np.asarray(xr)).all()
+    assert np.isfinite(np.asarray(xi)).all()
+    assert np.asarray(rep.nonfinite).tolist() == [False, True]
+    assert np.asarray(rep.converged).tolist()[1] is False \
+        or not bool(np.asarray(rep.converged)[1])
+    np.testing.assert_array_equal(np.asarray(xr)[0], np.asarray(xr0)[0])
+    np.testing.assert_array_equal(np.asarray(xi)[0], np.asarray(xi0)[0])
+
+
+def test_recovery_ladder_tikhonov_on_singular_Z():
+    """A zero-damping resonance (Zi = 0, Zr rank-deficient at one
+    frequency) escalates exactly that bin to the flagged Tikhonov tier
+    with a finite solution; healthy bins keep the baseline solve
+    bit-for-bit."""
+    from raft_tpu.dynamics import solve_complex_6x6, solve_complex_6x6_ladder
+
+    rng = np.random.default_rng(0)
+    nw = 8
+    Zr = np.stack([
+        np.diag(rng.uniform(1.0, 2.0, 6)) + 0.05 * rng.standard_normal((6, 6))
+        for _ in range(nw)
+    ])
+    Zi = np.zeros((nw, 6, 6))
+    Fr = rng.standard_normal((nw, 6))
+    Fi = rng.standard_normal((nw, 6))
+    Zr[3, 0, :] = 0.0
+    Zr[3, :, 0] = 0.0  # -w^2 M + C loses rank at bin 3, no damping
+
+    xr, xi, resid, cond, tier = map(np.asarray, solve_complex_6x6_ladder(
+        Zr, Zi, Fr, Fi, refine=1))
+    assert np.isfinite(xr).all() and np.isfinite(xi).all()
+    assert tier[3] == 2
+    others = np.arange(nw) != 3
+    assert (tier[others] == 0).all()
+    assert np.isinf(cond[3]) or cond[3] > 1e12
+    assert cond[others].max() < 1e3
+
+    bxr, bxi = solve_complex_6x6(Zr, Zi, Fr, Fi, refine=1)
+    np.testing.assert_array_equal(np.asarray(bxr)[others], xr[others])
+    np.testing.assert_array_equal(np.asarray(bxi)[others], xi[others])
+    assert resid[others].max() < 1e-12
+
+
+def test_gj_cond_estimate_is_scale_invariant():
+    """Row scaling (mixed translational/rotational DOF magnitudes) must
+    not read as ill-conditioning; genuine near-singularity must."""
+    from raft_tpu.dynamics import gj_cond_estimate
+
+    rng = np.random.default_rng(1)
+    A = rng.standard_normal((4, 12, 12)) + 5 * np.eye(12)
+    scales = 10.0 ** rng.uniform(-6, 9, size=(4, 12, 1))
+    c_scaled = np.asarray(gj_cond_estimate(A * scales))
+    assert c_scaled.max() < 1e4
+
+    B = A.copy()
+    B[2, 5] = B[2, 7] * (1 + 1e-14)  # two nearly dependent rows
+    c = np.asarray(gj_cond_estimate(B))
+    assert c[2] > 1e10
+    assert np.delete(c, 2).max() < 1e4
+
+
+def test_sweep_retry_machinery(tmp_path):
+    """With a starved iteration budget the bounded retry re-solves
+    non-converged lanes (doubled nIter, stronger under-relaxation) and
+    never touches healthy ones."""
+    base = _base()
+    base["settings"]["nIter"] = 1
+    pts = grid_points({"d_col": [9.0, 10.0], "draft_scale": [1.0]})
+    res = run_sweep(base, pts, _apply_point, verbose=False)
+    assert res["Xi"].shape[0] == 2
+    assert np.isfinite(res["Xi"]).all()
+    # 1 fixed-point iteration cannot meet the 1% tolerance -> retried
+    assert not res["converged"].all()
+    assert res["retried"].any()
+    assert not res["nonfinite"].any()
+    res2 = run_sweep(base, pts, _apply_point, verbose=False,
+                     retry_nonconverged=False)
+    assert not res2["retried"].any()
+
+
+def test_corrupt_checkpoint_deleted_with_logged_reason(tmp_path, caplog):
+    base = _base()
+    pts = grid_points({"d_col": [9.0, 10.0], "draft_scale": [1.0]})
+    out = str(tmp_path)
+    res = run_sweep(base, pts, _apply_point, out_dir=out, verbose=False)
+    ck = sorted(glob.glob(os.path.join(out, "chunk_*.npz")))[0]
+
+    # garbage content (not merely truncated): must be deleted with a
+    # logged reason and recomputed, never trusted
+    with open(ck, "wb") as f:
+        f.write(b"this is not a zip archive")
+    with caplog.at_level(logging.WARNING, logger="raft_tpu"):
+        res2 = run_sweep(base, pts, _apply_point, out_dir=out, verbose=False)
+    assert any("deleting" in r.getMessage() and "chunk" in r.getMessage()
+               for r in caplog.records)
+    np.testing.assert_array_equal(res["Xi"], res2["Xi"])
+    # the rewritten checkpoint is valid again
+    with np.load(ck) as zf:
+        assert "Xi_r" in zf.files
+
+    # an npz missing the required arrays is equally discarded
+    caplog.clear()
+    np.savez(ck + ".tmp.npz", foo=np.arange(3))
+    os.replace(ck + ".tmp.npz", ck)
+    with caplog.at_level(logging.WARNING, logger="raft_tpu"):
+        res3 = run_sweep(base, pts, _apply_point, out_dir=out, verbose=False)
+    assert any("missing the required result arrays" in r.getMessage()
+               for r in caplog.records)
+    np.testing.assert_array_equal(res["Xi"], res3["Xi"])
+
+
+def test_checkpoint_restart_preserves_quarantine(tmp_path):
+    base = _base()
+    pts = grid_points({"d_col": [9.0, 10.0], "draft_scale": [1.0]})
+    pts[1] = dict(pts[1], _raise=True)
+    out = str(tmp_path)
+    res = run_sweep(base, pts, _apply_point_faulty, out_dir=out,
+                    verbose=False)
+    assert [f["index"] for f in res["failed"]] == [1]
+    # restart loads the checkpoint (prep never reruns) and still reports
+    # the quarantined point
+    res2 = run_sweep(base, pts, _apply_point_faulty, out_dir=out,
+                     verbose=False)
+    assert [f["index"] for f in res2["failed"]] == [1]
+    assert res2["failed_mask"].tolist() == [False, True]
+    np.testing.assert_array_equal(res["Xi"], res2["Xi"])
+
+
+def test_model_reports_solver_health():
+    m = Model(_base())
+    m.analyze_unloaded()
+    m.analyze_cases()
+    rep = m.results["solve_report"]
+    assert rep["converged"].all()
+    assert not rep["nonfinite"].any()
+    assert (rep["recovery_tier"] == 0).all()
+    assert rep["residual"].max() < 1e-10  # f64 CPU path
+    assert np.isfinite(rep["cond"]).all()
+
+
+def test_debug_nans_env_roundtrip(monkeypatch):
+    """RAFT_TPU_DEBUG_NANS=1 must enable jax_debug_nans + the scan-based
+    checkable pipeline, and fully round-trip off again."""
+    from raft_tpu.validate import apply_debug_nans, debug_nans_requested
+
+    monkeypatch.delenv("RAFT_TPU_DEBUG_NANS", raising=False)
+    assert not debug_nans_requested()
+    assert apply_debug_nans() is False
+
+    monkeypatch.setenv("RAFT_TPU_DEBUG_NANS", "1")
+    assert debug_nans_requested()
+    try:
+        assert apply_debug_nans() is True
+        assert jax.config.jax_debug_nans
+        # a healthy solve runs clean through the checkable pipeline
+        m = Model(_base())
+        m.analyze_unloaded()
+        m.analyze_cases()
+        assert m.results["solve_report"]["converged"].all()
+    finally:
+        jax.config.update("jax_debug_nans", False)
+    monkeypatch.delenv("RAFT_TPU_DEBUG_NANS")
+    assert apply_debug_nans() is False
+    assert not jax.config.jax_debug_nans

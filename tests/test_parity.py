@@ -24,14 +24,14 @@ def solved(request):
     model.analyze_unloaded()
     args, aux = model.prepare_case_inputs()
     fn = jax.jit(model.case_pipeline_fn())
-    xr, xi, iters, conv = fn(*(np.asarray(a) for a in args))
+    xr, xi, rep = fn(*(np.asarray(a) for a in args))
     Xi_jax = np.asarray(xr) + 1j * np.asarray(xi)
     Xi_np = rao_solve_numpy(
         model.nodes.astype(np.float64), model.w, model.k, model.depth,
         model.rho_water, model.g, *[np.asarray(a, np.float64) for a in args],
         XiStart=model.XiStart, nIter=model.nIter,
     )
-    return model, aux, Xi_jax, Xi_np, np.asarray(conv)
+    return model, aux, Xi_jax, Xi_np, np.asarray(rep.converged)
 
 
 def test_converged(solved):
