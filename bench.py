@@ -16,6 +16,7 @@ Prints ONE JSON line:
 import argparse
 import json
 import os
+import signal
 import sys
 import time
 
@@ -24,12 +25,27 @@ import numpy as np
 _ROOT = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, _ROOT)
 
-# wall-clock budget for the whole bench run: once exceeded, remaining
-# sections are SKIPPED (recorded as "<name>_error": "skipped: ...") so
-# the driver-parseable line still prints before any external `timeout`
-# kills the process (round 5's rc=124 lost the entire run to exactly
-# that).  Override with --budget or BENCH_BUDGET_S.
-BENCH_BUDGET_S_DEFAULT = 840.0
+# Host-mesh CPU parallelism for the f64 rotor island: split the XLA:CPU
+# host platform across the physical cores so Rotor.run_bem_batch shards
+# its lane axis (raft_tpu/__init__.py wires the XLA flag at import time,
+# which is why this must happen before any jax import).  An explicit
+# user choice always wins; single/dual-core hosts keep one device (the
+# split buys nothing there and costs executable variety).
+if "RAFT_TPU_HOST_DEVICES" not in os.environ:
+    _cores = os.cpu_count() or 1
+    if _cores >= 4:
+        os.environ["RAFT_TPU_HOST_DEVICES"] = str(min(_cores, 8))
+
+# wall-clock budget for the whole bench run, now ENFORCED per section:
+# a section is started only while budget remains AND runs under a
+# SIGALRM watchdog capped at the remaining budget (rounds 3-5 each lost
+# their driver line to a section that overran the advisory budget until
+# the external `timeout` killed the process at rc=124 — a section that
+# overruns its slice is now recorded as skipped instead of eating the
+# run).  Lowered from 840 s to leave real margin under the driver's
+# `timeout -k`.  Override with --budget or BENCH_BUDGET_S; optionally
+# cap any single section with BENCH_SECTION_CAP_S.
+BENCH_BUDGET_S_DEFAULT = 780.0
 
 NW_MIN, NW_MAX = 0.00625, 0.8   # arange -> exactly 128 bins
 N_CASES = 12
@@ -52,6 +68,7 @@ _COMPACT_KEYS = (
     "backend",
     "sweep_n_designs", "sweep_wall_s", "sweep_per_design_ms",
     "sweep_vs_baseline", "sweep_rao_linf_err", "sweep_converged_frac",
+    "sweep_rotor_stage_s", "sweep_overlap_saved_s", "sweep_host_devices",
     "sweep243_vs_baseline", "sweep243_per_design_ms",
     "sweep1024_per_design_ms", "sweep4096_per_design_ms",
     "bem_panels", "bem_device_vs_cpu", "bem_large_panels",
@@ -78,6 +95,88 @@ def _write_full(out, path=None):
     os.replace(tmp, path)
 
 
+class _SectionTimeout(Exception):
+    """Raised by the per-section watchdog when a slice is exhausted."""
+
+
+class _watchdog:
+    """SIGALRM wall-clock cap for one bench section.  No-op when
+    ``seconds`` is None/<=0, off the main thread, or on platforms
+    without SIGALRM.  A section stuck inside one long C call (a hung
+    device dispatch) is only interrupted when control returns to
+    Python — the realistic overruns (serial NumPy baselines, many-
+    dispatch loops) hit Python bytecode constantly."""
+
+    def __init__(self, seconds):
+        import threading
+
+        self.seconds = seconds
+        self.armed = (
+            seconds is not None and seconds > 0
+            and hasattr(signal, "SIGALRM")
+            and threading.current_thread() is threading.main_thread()
+        )
+
+    def __enter__(self):
+        if self.armed:
+            def _raise(signum, frame):
+                raise _SectionTimeout()
+
+            self._prev = signal.signal(signal.SIGALRM, _raise)
+            signal.setitimer(signal.ITIMER_REAL, self.seconds)
+        return self
+
+    def __exit__(self, *exc):
+        if self.armed:
+            signal.setitimer(signal.ITIMER_REAL, 0.0)
+            signal.signal(signal.SIGALRM, self._prev)
+        return False
+
+
+def run_sections(sections, out, full_path, deadline, section_cap=None):
+    """Run bench sections under the budget/watchdog policy.
+
+    Each entry is ``(name, fn)`` or ``(name, fn, weight)``.  A section's
+    watchdog slice is its weighted fair share of the REMAINING budget
+    (slice = remaining * w_i / sum of remaining weights, optionally
+    bounded by ``section_cap``): a section that finishes early donates
+    its leftover to the ones after it, a section that overruns its slice
+    is cut by SIGALRM and recorded as ``<name>_error: skipped`` — it can
+    never eat the whole budget, so every later section still gets a
+    slice and the driver-parseable compact line always prints before an
+    external `timeout` fires.  Results flush to ``full_path`` after
+    every section."""
+    entries = [(s[0], s[1], (s[2] if len(s) > 2 else 1.0))
+               for s in sections]
+    for i, (name, fn, weight) in enumerate(entries):
+        now = time.monotonic()
+        remaining = None if deadline is None else deadline - now
+        if remaining is not None and remaining <= 0:
+            out[f"{name}_error"] = (
+                "skipped: wall-clock budget exhausted")
+            _write_full(out, full_path)
+            continue
+        cap = None
+        if remaining is not None:
+            w_left = sum(e[2] for e in entries[i:]) or 1.0
+            cap = remaining * weight / w_left
+        if section_cap and section_cap > 0:
+            cap = section_cap if cap is None else min(cap, section_cap)
+        t_sec = time.monotonic()
+        try:
+            with _watchdog(cap):
+                out.update(fn() or {})
+        except _SectionTimeout:
+            out[f"{name}_error"] = (
+                f"skipped: section watchdog ({cap:.0f}s slice exhausted)")
+        except Exception as exc:
+            out[f"{name}_error"] = f"{type(exc).__name__}: {exc}"
+        out.setdefault("section_seconds", {})[name] = round(
+            time.monotonic() - t_sec, 1)
+        _write_full(out, full_path)
+    return out
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--smoke", action="store_true",
@@ -89,7 +188,15 @@ def main(argv=None):
                     default=float(os.environ.get(
                         "BENCH_BUDGET_S", BENCH_BUDGET_S_DEFAULT)),
                     help="wall-clock seconds before remaining sections "
-                         "are skipped (<=0 disables the guard)")
+                         "are skipped (<=0 disables the guard); each "
+                         "section also runs under a SIGALRM watchdog "
+                         "capped at the remaining budget")
+    ap.add_argument("--section-cap", type=float,
+                    default=float(os.environ.get(
+                        "BENCH_SECTION_CAP_S", 0.0)),
+                    help="optional hard per-section watchdog cap in "
+                         "seconds (0 = only the remaining budget caps a "
+                         "section)")
     ap.add_argument("--out", default=None,
                     help="results JSON path (default BENCH_FULL.json; "
                          "--smoke defaults to BENCH_SMOKE.json in the "
@@ -117,36 +224,30 @@ def main(argv=None):
 
         sections = [
             # headline first: whatever the budget kills later, the
-            # driver line has its primary metric
-            ("rao", bench_rao),
-            ("sweep", lambda: bench_sweep.run(baseline_limit=48,
-                                              verbose=False)),
+            # driver line has its primary metric.  Baseline limits are
+            # sized so the serial NumPy comparisons stay a fraction of
+            # the enforced budget (per-design cost is constant, the
+            # extrapolation is linear either way).  The third field is
+            # the section's fair-share WEIGHT of the remaining budget
+            # (run_sections): sized from measured round-4/5 section
+            # costs so a generous budget runs everything while a tight
+            # one degrades section by section instead of losing the run.
+            ("rao", bench_rao, 1.0),
+            ("sweep", lambda: bench_sweep.run(baseline_limit=16,
+                                              verbose=False), 6.0),
             ("sweep_scaling", lambda: bench_sweep.run_scaling(
-                verbose=False)),
+                verbose=False), 1.5),
             ("sweep243", lambda: bench_sweep.run_geometry(
-                baseline_limit=12, verbose=False)),
-            ("bem", bench_bem),
-            ("bem_sharded", bench_bem_sharded),
-            ("bem_stream", bench_bem_stream),
-            ("grad", bench_gradients),
+                baseline_limit=8, verbose=False), 2.0),
+            ("bem", bench_bem, 3.0),
+            ("bem_sharded", bench_bem_sharded, 0.5),
+            ("bem_stream", bench_bem_stream, 1.0),
+            ("grad", bench_gradients, 0.5),
         ]
 
     out = {}
-    for name, fn in sections:
-        if deadline is not None and time.monotonic() > deadline:
-            out[f"{name}_error"] = (
-                f"skipped: wall-clock budget "
-                f"({args.budget:.0f}s) exhausted")
-            _write_full(out, full_path)
-            continue
-        t_sec = time.monotonic()
-        try:
-            out.update(fn() or {})
-        except Exception as exc:
-            out[f"{name}_error"] = f"{type(exc).__name__}: {exc}"
-        out.setdefault("section_seconds", {})[name] = round(
-            time.monotonic() - t_sec, 1)
-        _write_full(out, full_path)
+    run_sections(sections, out, full_path, deadline,
+                 section_cap=args.section_cap)
 
     # regenerated docs (full runs only), compact line to the driver
     if not args.smoke:
@@ -508,6 +609,25 @@ def perf_md_text(d):
         )
         row("sweep RAO L∞ parity vs the serial path",
             _fmt(d.get("sweep_rao_linf_err", float("nan"))))
+    if "sweep_rotor_stage_s" in d:
+        row(
+            "heterogeneous overlap: host-sharded rotor ∥ async device "
+            "dynamics",
+            f"rotor stage {_fmt(d['sweep_rotor_stage_s'])} s on "
+            f"{d.get('sweep_host_devices', '?')} host device(s), "
+            f"{_fmt(d.get('sweep_overlap_saved_s', 0.0))} s hidden by "
+            f"overlap across {d.get('sweep_overlap_chunks', '?')} "
+            "case chunk(s)",
+        )
+    if "sweep_rotor_telemetry" in d:
+        t = d["sweep_rotor_telemetry"]
+        row(
+            "guided-rotor lane accounting (hot sweep)",
+            f"{t.get('guided_lanes', 0)} warm-started / "
+            f"{t.get('direct_fallback_lanes', 0)} direct-fallback lanes "
+            f"({t.get('fallback_cases', 0)} case(s) tripped a guard), "
+            f"probe err {t.get('probe_rel_err_max', 0.0):.1e}",
+        )
     for key, label in (("sweep1024", "1024-design sweep"),
                        ("sweep4096", "4096-design sweep")):
         if f"{key}_per_design_ms" in d:
@@ -644,12 +764,20 @@ def update_perf_docs(d):
             fh.write(txt)
 
 
-def bench_bem(nw=8, nw_large=4):
+def bench_bem(nw=8, nw_large=4, dz=2.5, dz_large=1.25, backend=None,
+              converge=True):
     """BEM assembly+solve timings at two mesh sizes: ~850 panels (the
     TPU-vs-CPU crossover regime, full nw) and a ~3000-panel production
     mesh (past the old TPU LU ceiling — exercises the blocked
     Gauss-Jordan path and mesh-size bucketing; fewer frequencies to bound
-    the CPU comparison time)."""
+    the CPU comparison time).
+
+    ``dz``/``dz_large``/``backend``/``converge`` exist so the tier-1
+    regression test (tests/test_bench_bem_regression.py) can drive the
+    full TPU-only branch — including the real-block/blocked-GJ solve and
+    the convergence-anchor unpack that silently crashed a driver round
+    with ``bem_error: too many values to unpack`` — on a coarse CPU mesh.
+    """
     import jax
 
     from raft_tpu.bem_solver import solve_bem
@@ -660,7 +788,7 @@ def bench_bem(nw=8, nw_large=4):
     design = deep_spar(n_cases=1)
     design["platform"]["members"][0]["potMod"] = True
     m = Model(design)
-    backend = jax.default_backend()
+    backend = backend or jax.default_backend()
 
     def timed(panels, w, bk):
         # warm-up carries the cost query so the timed call stays clean
@@ -678,7 +806,7 @@ def bench_bem(nw=8, nw_large=4):
 
     # ~850 panels: above the TPU-vs-CPU crossover (~500 panels) while
     # keeping the one-time compile ~20 s (cached persistently thereafter)
-    panels = mesh_platform(m.members, dz_max=2.5, da_max=2.5)
+    panels = mesh_platform(m.members, dz_max=dz, da_max=dz)
     w = np.linspace(0.2, 1.2, nw)
     t_cpu, out_cpu = timed(panels, w, "cpu")
     res = {
@@ -703,7 +831,7 @@ def bench_bem(nw=8, nw_large=4):
             res["bem_mfu_vs_bf16_peak"] = round(
                 fl / t_dev / PEAK_FLOPS_BF16, 6)
 
-    panels_l = mesh_platform(m.members, dz_max=1.25, da_max=1.25)
+    panels_l = mesh_platform(m.members, dz_max=dz_large, da_max=dz_large)
     w_l = np.linspace(0.2, 0.8, nw_large)
     t_cpu_l, out_cpu_l = timed(panels_l, w_l, "cpu")
     res.update({
@@ -719,11 +847,14 @@ def bench_bem(nw=8, nw_large=4):
             np.abs(out_dev_l["A"] - out_cpu_l["A"]).max()
             / np.abs(out_cpu_l["A"]).max()
         )
-        res.update(_bench_bem_converge(backend))
+        if converge:
+            res.update(_bench_bem_converge(backend))
     return res
 
 
-def _bench_bem_converge(backend):
+def _bench_bem_converge(backend, path="/root/reference/designs/"
+                                      "VolturnUS-S.yaml",
+                        sizes=(2.0, 1.5), nw=8):
     """Flagship full-hull mesh-convergence anchor on the accelerator
     (the same study as tests/test_reference_designs.py::
     test_volturnus_full_hull_mesh_convergence, via the shared
@@ -736,18 +867,23 @@ def _bench_bem_converge(backend):
 
     from raft_tpu.validate import full_hull_convergence
 
-    path = "/root/reference/designs/VolturnUS-S.yaml"
     if not os.path.exists(path):
         return {}
     t0 = time.perf_counter()
     # single-device: round-over-round comparability (the sharded figure
-    # lives in bem_shard_*)
+    # lives in bem_shard_*).  NOTE the unpack arity below is pinned by
+    # tests/test_bench_bem_regression.py against the REAL helper: a
+    # round once recorded ``bem_error: too many values to unpack
+    # (expected 2)`` because the helper grew a third return value while
+    # the bench still unpacked two — and only the TPU branch calls this,
+    # so CPU test runs never saw it.
     sols, rel, rel_X = full_hull_convergence(path, backend=backend,
+                                             sizes=sizes, nw=nw,
                                              n_devices=1)
     return {
         "bem_conv_panels": [sols["fine"]["npanels"],
                             sols["xfine"]["npanels"]],
-        "bem_conv_nw": 8,
+        "bem_conv_nw": nw,
         "bem_conv_s": round(time.perf_counter() - t0, 1),
         "bem_conv_A_rel_max_by_dof": [round(r, 4) for r in rel],
         "bem_conv_A_within_5pct": bool(max(rel) < 0.05),

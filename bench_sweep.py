@@ -328,6 +328,18 @@ def run(baseline_limit=None, verbose=True):
         "sweep_timing_breakdown": {
             k: round(v, 3) for k, v in res_hot["timing"].items()
         },
+        # the heterogeneous-overlap figures (tentpole PR-3): rotor-stage
+        # span, measured overlap savings, and the host mesh it ran on
+        "sweep_rotor_stage_s": round(
+            res_hot["timing"]["aero_second_s"], 3),
+        "sweep_overlap_saved_s": round(
+            res_hot["timing"]["overlap_saved_s"], 3),
+        "sweep_overlap_chunks": int(res_hot["timing"]["overlap_chunks"]),
+        "sweep_host_devices": int(
+            res_hot["rotor_telemetry"]["rotor_host_devices"]),
+        # guided-rotor telemetry (lane counts, probe error, stage costs)
+        # — settles why aero_second_s reads what it reads on a given host
+        "sweep_rotor_telemetry": dict(res_hot["rotor_telemetry"]),
     }
     out.update(_utilization("sweep_dynamics", res_hot))
     if verbose:

@@ -23,6 +23,23 @@ coded adjoints / finite differences.
 
 import os as _os
 
+# Host-mesh CPU parallelism: RAFT_TPU_HOST_DEVICES=N splits the XLA:CPU
+# host platform into N virtual devices so embarrassingly-parallel f64 CPU
+# islands (the rotor second pass, Rotor.run_bem_batch) shard across host
+# cores with shard_map/NamedSharding instead of running one vmapped
+# executable on a single XLA:CPU device.  The flag must reach XLA before
+# the backend initializes, which means before the first `import jax` in
+# the process — importing raft_tpu first is sufficient; a process that
+# already initialized JAX keeps its existing device count (documented in
+# docs/performance.md "heterogeneous overlap").
+_hd = _os.environ.get("RAFT_TPU_HOST_DEVICES", "")
+if _hd.strip().isdigit() and int(_hd) > 1:
+    _flags = _os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        _os.environ["XLA_FLAGS"] = (
+            _flags + f" --xla_force_host_platform_device_count={int(_hd)}"
+        ).strip()
+
 from jax import config as _jax_config
 
 # Float64 is the framework default: the reference physics is float64 NumPy and

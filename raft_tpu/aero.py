@@ -474,8 +474,64 @@ def servo_transfer_terms(w, dT_dU, dT_dOm, dT_dPi, dQ_dU, dQ_dOm, dQ_dPi,
 
 # compiled loads+derivatives executables shared across Rotor instances with
 # identical configuration (keyed by the raw geometry/polar bytes); each
-# entry holds (single-point executable, vmapped batch executable)
+# entry is a dict holding the single-point executable, the vmapped batch
+# executables, the raw (unjitted) per-lane functions, and a lazily-filled
+# map of host-mesh sharded variants keyed on the device tuple
 _rotor_eval_cache = {}
+
+
+# lanes per host device per dispatch.  The compiled per-device program is
+# [_LANE_BLOCK]-shaped for EVERY mesh size (the lane batch is cut into
+# super-blocks of _LANE_BLOCK * n_devices lanes, one async dispatch each),
+# which is what makes the host-sharded and single-device paths
+# bit-identical: XLA fuses differently at different batch shapes (measured
+# ~5e-14 relative FMA-contraction drift between a [128]-lane and a
+# 8x[16]-lane compile of the same per-lane chain), so equal bits require
+# the SAME per-device partitioned module — enforced by fixing its shape.
+_LANE_BLOCK = 64
+
+
+def _host_mesh_devices(n_devices=None):
+    """CPU devices the lane axis shards over (>1 only when the host
+    platform was split, e.g. via RAFT_TPU_HOST_DEVICES in
+    raft_tpu/__init__.py).  ``n_devices`` caps the count; 1 forces the
+    single-device mesh (same per-device program, so results stay
+    bit-identical — see _LANE_BLOCK)."""
+    devs = list(jax.devices("cpu"))
+    if n_devices is None:
+        return devs
+    return devs[: max(1, min(int(n_devices), len(devs)))]
+
+
+def _sharded_batch_fns(cached, devices):
+    """Jitted shard_map wrappers of the cached per-lane evaluations laying
+    the lane axis across a 1-D ``('lane',)`` host mesh — the NamedSharding
+    pattern bem_solver._sharded_solve_fn uses for the frequency batch.
+    Lanes are independent scalar chains, so each device runs its
+    [_LANE_BLOCK]-lane shard's vmap with zero communication; the
+    single-device fallback is the same program on a 1-device mesh.
+    Returns (plain_fn, guided_fn, lane_sharding)."""
+    key = tuple(devices)
+    hit = cached["sharded"].get(key)
+    if hit is None:
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        mesh = Mesh(np.array(devices), ("lane",))
+        f, fg = cached["raw"]
+        spec = P("lane")
+        plain = shard_map(
+            jax.vmap(f), mesh=mesh,
+            in_specs=(spec,) * 5, out_specs=(spec,) * 3,
+        )
+        guided = shard_map(
+            jax.vmap(fg), mesh=mesh,
+            in_specs=(spec,) * 6, out_specs=(spec,) * 4,
+        )
+        hit = (jax.jit(plain), jax.jit(guided),
+               NamedSharding(mesh, spec))
+        cached["sharded"][key] = hit
+    return hit
 
 
 class Rotor:
@@ -543,11 +599,16 @@ class Rotor:
         )
         cached = _rotor_eval_cache.get(key)
         if cached is None:
+            # geometry/polars enter the executables as NUMPY closure
+            # constants (tiny arrays baked into the graph as literals):
+            # device-COMMITTED constants would pin the compiled graph to
+            # cpu:0 and conflict with the host-mesh sharded dispatch
+            # (_sharded_batch_fns), which replicates constants per device
             geom = {
-                k: (put_cpu(v) if isinstance(v, jnp.ndarray) else v)
+                k: (np.asarray(v) if isinstance(v, jnp.ndarray) else v)
                 for k, v in self.geom.items()
             }
-            polars = tuple(put_cpu(p) for p in self.polars)
+            polars = tuple(np.asarray(p) for p in self.polars)
             env = self.env
 
             def loads_TQ(U, Om, pitch, tilt, yaw, phi0=None, n_newton=2):
@@ -582,13 +643,17 @@ class Rotor:
                 )(jnp.stack([U, Om, pitch]))
                 return vals, JT, phi, resid
 
-            cached = (
-                jax.jit(loads_and_derivs),
-                jax.jit(jax.vmap(loads_and_derivs)),
-                jax.jit(jax.vmap(loads_and_derivs_guided)),
-            )
+            cached = {
+                "eval": jax.jit(loads_and_derivs),
+                "raw": (loads_and_derivs, loads_and_derivs_guided),
+                "sharded": {},   # device tuple -> shard_map executables
+            }
             _rotor_eval_cache[key] = cached
-        self._eval, self._eval_batch, self._eval_batch_guided = cached
+        self._cached = cached
+        self._eval = cached["eval"]
+        # telemetry of the last batched evaluation (lanes, padding, host
+        # devices used) — read by the sweep's rotor-stage instrumentation
+        self.last_batch_info = None
 
     # -------------------------------------------------------------- control
 
@@ -661,7 +726,8 @@ class Rotor:
         return loads, derivs
 
     def run_bem_batch(self, Uhub, ptfm_pitch, yaw_misalign=None,
-                      phi0=None, return_phi=False, return_resid=False):
+                      phi0=None, return_phi=False, return_resid=False,
+                      n_devices=None):
         """Batched steady loads + SI derivatives over a leading lane axis —
         the design sweep's second-pass rotor evaluation (one vmapped
         compiled CPU call instead of one serial :meth:`run_bem` per design
@@ -676,14 +742,25 @@ class Rotor:
         return_resid : also return the worst per-section |Ning residual|
             at the returned roots per lane [nt] (guided path only; None
             for the bracketed path)
+        n_devices : int | None — cap on the CPU host devices the lane
+            axis shards over (None = all CPU devices; 1 forces the
+            single-device mesh).  More than one host device exists only
+            when the host platform was split (RAFT_TPU_HOST_DEVICES=N,
+            wired in raft_tpu/__init__.py).  The lane batch is cut into
+            super-blocks of ``_LANE_BLOCK * n_devices`` lanes, each laid
+            across the 1-D host mesh with shard_map/NamedSharding and
+            dispatched ASYNCHRONOUSLY (devices run concurrently, blocks
+            queue); because the per-device partitioned program is
+            [_LANE_BLOCK]-shaped at every mesh size, vals/J are
+            bit-identical to the single-device path (asserted in
+            tests/test_host_shard.py).
         Returns (vals [nt, 10], J [nt, 10, 3][, phi][, resid]) with the
         same layout as :meth:`run_bem`'s stacked outputs, derivatives
         already SI.
 
-        The lane axis is padded to a multiple of 64 so sweeps of varying
-        size share compiled executables (each distinct lane count would
-        otherwise trigger a fresh XLA compile of the vmapped jacfwd
-        graph).
+        The lane axis is padded (repeating the final lane) to fill the
+        last super-block, so sweeps of every size and mesh share ONE
+        compiled executable per mesh signature.
         """
         Uhub = np.atleast_1d(np.asarray(Uhub, np.float64))
         ptfm_pitch = np.broadcast_to(
@@ -693,7 +770,16 @@ class Rotor:
             np.asarray(yaw_misalign, np.float64), Uhub.shape
         )
         n = Uhub.size
-        nb = -(-n // 64) * 64
+        devices = _host_mesh_devices(n_devices)
+        # never put more devices under the batch than it has 64-lane
+        # blocks: a 6-lane call on an 8-device mesh would otherwise pad
+        # to 512 lanes of work (the trimmed results stay bit-identical
+        # across mesh sizes either way — fixed per-device block shape)
+        devices = devices[: max(1, min(len(devices),
+                                       -(-n // _LANE_BLOCK)))]
+        n_dev = len(devices)
+        G = _LANE_BLOCK * n_dev            # lanes per dispatch
+        nb = -(-n // G) * G
         pad = lambda a: np.concatenate(  # noqa: E731
             [a, np.repeat(a[-1:], nb - n, axis=0)]
         ) if nb > n else a
@@ -702,22 +788,33 @@ class Rotor:
         pitch_deg = np.interp(Uhub_p, self.Uhub, self.pitch_deg)
         tilt = np.deg2rad(self.shaft_tilt) + pitch_p
 
-        args = (
-            put_cpu(Uhub_p), put_cpu(Omega_rpm * np.pi / 30.0),
-            put_cpu(np.deg2rad(pitch_deg)), put_cpu(tilt),
-            put_cpu(np.deg2rad(yaw_p)),
-        )
-        resid = None
-        if phi0 is None:
-            vals, J, phi = self._eval_batch(*args)
-        else:
-            vals, J, phi, resid = self._eval_batch_guided(
-                *args, put_cpu(pad(np.asarray(phi0, np.float64))))
-        out = [np.asarray(vals)[:n], np.asarray(J)[:n]]
+        batch_fn, guided_fn, sharding = _sharded_batch_fns(
+            self._cached, tuple(devices))
+        put = lambda a: jax.device_put(  # noqa: E731
+            np.asarray(a, np.float64), sharding)
+        self.last_batch_info = {
+            "lanes": int(n), "lanes_padded": int(nb),
+            "n_devices": int(n_dev), "dispatches": int(nb // G),
+            "guided": phi0 is not None,
+        }
+
+        args_np = [Uhub_p, Omega_rpm * np.pi / 30.0,
+                   np.deg2rad(pitch_deg), tilt, np.deg2rad(yaw_p)]
+        if phi0 is not None:
+            args_np.append(pad(np.asarray(phi0, np.float64)))
+        fn = batch_fn if phi0 is None else guided_fn
+        outs = []
+        for i in range(0, nb, G):          # async: blocks queue per device
+            outs.append(fn(*(put(a[i:i + G]) for a in args_np)))
+        jax.block_until_ready(outs)
+
+        cat = lambda j: np.concatenate(  # noqa: E731
+            [np.asarray(o[j]) for o in outs])[:n]
+        out = [cat(0), cat(1)]
         if return_phi:
-            out.append(np.asarray(phi)[:n])
+            out.append(cat(2))
         if return_resid:
-            out.append(None if resid is None else np.asarray(resid)[:n])
+            out.append(cat(3) if phi0 is not None else None)
         return tuple(out)
 
     # ---------------------------------------------------- aero-servo terms

@@ -618,7 +618,8 @@ class Model:
         )
         return args, aux
 
-    def analyze_cases(self, display=0, runPyHAMS=False, meshDir=None):
+    def analyze_cases(self, display=0, runPyHAMS=False, meshDir=None,
+                      tracer=None):
         """Run all load cases: per-case statics (aero means + mooring
         equilibrium), batched dynamics solve, and response metrics
         (reference raft/raft_model.py:149-309).
@@ -627,7 +628,16 @@ class Model:
         before the case batch, like the reference's calcBEM call
         (raft_model.py:235-236) — here via the native panel solver; an
         external HAMS/WAMIT output can be loaded with import_bem instead.
+
+        ``tracer`` (raft_tpu.trace.Tracer, created per call when None)
+        records the stage timeline — host prep vs the device dispatch —
+        surfaced as ``results["stage_spans"]`` and dumped as a
+        chrome://tracing JSON when RAFT_TPU_TRACE is set (the same
+        instrumentation the sweep drivers use for the CPU/TPU overlap).
         """
+        from raft_tpu.trace import Tracer
+
+        tracer = tracer or Tracer("analyze_cases")
         if runPyHAMS and any(m.potMod for m in self.members):
             if self.bem_coeffs is None:
                 # solve at every distinct case wave heading so off-axis
@@ -650,7 +660,8 @@ class Model:
                     "meshDir ignored — call preprocess_hams() directly to "
                     "write the HAMS/WAMIT tree"
                 )
-        args, aux = self.prepare_case_inputs()
+        with tracer.span("case_prep", backend="cpu"):
+            args, aux = self.prepare_case_inputs()
         cases = aux["cases"]
         ncase = aux["ncase"]
         zeta = aux["zeta"]
@@ -666,7 +677,8 @@ class Model:
         if self._pipeline is None:
             with timer("pipeline_compile"):
                 self._pipeline = self._build_pipeline()
-        with timer("rao_solve"):
+        with timer("rao_solve"), tracer.span(
+                "dynamics", backend=jax.default_backend()):
             if self._sharding is not None:
                 # committed inputs pin the jitted graph to the requested
                 # backend (jit follows input placement)

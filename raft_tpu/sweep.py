@@ -296,6 +296,7 @@ def run_sweep(
     collect=default_collect,
     verbose=True,
     retry_nonconverged=True,
+    overlap=True,
 ):
     """Run the analysis over all design ``points`` with the design axis
     sharded across ``mesh`` and per-chunk checkpointing under ``out_dir``.
@@ -321,6 +322,15 @@ def run_sweep(
         with doubled nIter and stronger under-relaxation (relax 0.4
         instead of the reference's 0.8); the retry result is adopted only
         where it converges, so first-pass-healthy lanes stay bit-identical.
+    overlap : bool
+        Software-pipeline the chunk loop: chunk k's device solve is
+        dispatched asynchronously and stays in flight while the host
+        preps chunk k+1 (geometry/statics/mooring — the CPU-bound half
+        of the sweep), its results fetched only when the next chunk has
+        been dispatched.  Results are identical to the serial loop (the
+        fetch/retry/checkpoint tail runs unchanged, just later).
+        Automatically disabled in multi-process runs, where collective
+        ordering must follow the chunk order on every host.
 
     Returns
     -------
@@ -343,7 +353,104 @@ def run_sweep(
     sharding = NamedSharding(mesh, P("design"))
 
     npoints = len(points)
-    chunk_records = []  # per chunk: dict(res | None, failed, n_real, k0)
+    overlap_ok = bool(overlap) and jax.process_count() == 1
+    records = {}  # chunk index -> dict(res | None, failed, n_real, k0)
+
+    def _write_ck(ck_path, res, failed):
+        if ck_path and jax.process_index() == 0:
+            # one writer in multi-process runs (every host holds the full
+            # allgathered results, so checkpoints stay restartable
+            # anywhere); write-then-rename so a crash mid-write never
+            # leaves a truncated chunk that would poison the restart
+            save = {} if res is None else dict(res)
+            if res is None:
+                save["_all_failed"] = np.array(True)
+            if failed:
+                save["_failed_idx"] = np.array([f[0] for f in failed], int)
+                save["_failed_msg"] = np.array([f[2] for f in failed])
+            tmp_path = ck_path + ".tmp.npz"
+            np.savez(tmp_path, **save)
+            os.replace(tmp_path, ck_path)
+
+    def _finalize(ctx):
+        """Blocking tail of one dispatched chunk: fetch, bounded retry,
+        quarantine masking, metric collection, checkpoint, record."""
+        k, k0 = ctx["k"], ctx["k0"]
+        chunk_pts, n_real = ctx["chunk_pts"], len(ctx["chunk_pts"])
+        preps, failed, valid = ctx["preps"], ctx["failed"], ctx["valid"]
+        ok, m0, dev_in = ctx["ok"], ctx["m0"], ctx["dev_in"]
+        sol = _fetch_solve(*ctx["raw"])
+
+        # bounded retry: one re-solve of the chunk with doubled nIter
+        # and stronger under-relaxation; adopted per lane only where
+        # the retry actually converges (NaN-quarantined lanes are
+        # excluded — more iterations cannot fix non-finite inputs)
+        retry_mask = valid[:, None] & ~sol["converged"] \
+            & ~sol["nonfinite"]
+        sol["retried"] = np.zeros_like(retry_mask)
+        if retry_nonconverged and retry_mask.any():
+            pipe2 = _sweep_pipeline(m0, sharding, 2 * m0.nIter, 0.4)
+            sol2 = _fetch_solve(*pipe2(*dev_in))
+            use = retry_mask & sol2["converged"]
+            for key in ("Xi_r", "Xi_i"):
+                sol[key] = np.where(
+                    use[:, :, None, None], sol2[key], sol[key]
+                )
+            for key in _REPORT_FILLS:
+                sol[key] = np.where(use, sol2[key], sol[key])
+            sol["retried"] = retry_mask
+            logger.warning(
+                "sweep chunk %d: %d non-converged lane(s) retried with "
+                "doubled nIter / relax=0.4; %d recovered",
+                k, int(retry_mask.sum()), int(use.sum()),
+            )
+
+        # mask quarantined rows before anything downstream sees them
+        inv = ~valid[:n_real]
+        res = {}
+        for key in ("Xi_r", "Xi_i"):
+            a = sol[key][:n_real].copy()
+            a[inv] = np.nan
+            res[key] = a
+        for key, fillval in _REPORT_FILLS.items():
+            # fill values are dtype-matched (bool->False, int->0,
+            # float->NaN), so masked rows assign directly
+            a = sol[key][:n_real].copy()
+            a[inv] = fillval
+            res[key] = a
+        res["retried"] = sol["retried"][:n_real].copy()
+        res["retried"][inv] = False
+
+        Xi = res["Xi_r"] + 1j * res["Xi_i"]  # [n_real, ncase, 6, nw]
+        per_metrics = [
+            collect(preps[j][0], chunk_pts[j], Xi[j]) if valid[j]
+            else None
+            for j in range(n_real)
+        ]
+        template = per_metrics[ok[0]]
+        for key in template:
+            res[key] = np.stack([
+                np.asarray(per_metrics[j][key])
+                if per_metrics[j] is not None
+                else _masked_row_fill(template[key], np.nan)
+                for j in range(n_real)
+            ])
+        for name in chunk_pts[0]:
+            res[f"param_{name}"] = np.array(
+                [pt[name] for pt in chunk_pts]
+            )
+
+        _write_ck(ctx["ck_path"], res, failed)
+        if verbose:
+            logger.info(
+                "sweep chunk %d: solved %d designs on %d devices"
+                "%s", k, n_real - len(failed), n_dev,
+                f" ({len(failed)} quarantined)" if failed else "",
+            )
+        records[k] = {"res": res, "failed": failed, "n_real": n_real,
+                      "k0": k0}
+
+    inflight = None
     for k0 in range(0, npoints, n_dev):
         k = k0 // n_dev
         ck_path = os.path.join(out_dir, f"chunk_{k:04d}.npz") if out_dir else None
@@ -363,15 +470,17 @@ def run_sweep(
             ]
             res = None if loaded.pop("_all_failed", None) is not None \
                 else loaded
-            chunk_records.append(
-                {"res": res, "failed": failed, "n_real": n_real, "k0": k0}
-            )
+            records[k] = {"res": res, "failed": failed, "n_real": n_real,
+                          "k0": k0}
             if verbose:
                 logger.info(
                     "sweep chunk %d: loaded checkpoint (%d designs)",
                     k, n_real,
                 )
             continue
+
+        # host prep below overlaps the previous chunk's in-flight device
+        # solve (dispatches are async; the fetch happens in _finalize)
 
         # host prep (independent per design; the expensive part is the
         # vmapped CPU mooring equilibrium inside prepare_case_inputs).
@@ -396,116 +505,54 @@ def run_sweep(
 
         ok = [j for j in range(n_real) if preps[j] is not None]
         if not ok:
-            res = None  # whole chunk failed host-side; no device solve
-        else:
-            # explicit slot map: every device slot names the prep it
-            # carries and ``valid`` marks the slots whose results are
-            # real.  Failed-prep slots and the ragged-tail padding slots
-            # are filled with the chunk's first healthy design purely to
-            # keep the batch shape — the mask guarantees those copies can
-            # never leak into collected metrics.
-            fill = ok[0]
-            slot = [j if (j < n_real and preps[j] is not None) else fill
-                    for j in range(n_dev)]
-            valid = np.array(
-                [j < n_real and preps[j] is not None for j in range(n_dev)]
-            )
-            nodes_list = [preps[s][1] for s in slot]
-            args_list = [preps[s][2] for s in slot]
-
-            nodes_b = pad_and_stack_nodes(nodes_list)
-            args_b = tuple(
-                np.stack([a[i] for a in args_list])
-                for i in range(len(args_list[0]))
-            )
-
-            m0 = preps[fill][0]
-            pipeline = _sweep_pipeline(m0, sharding, m0.nIter, 0.8)
-            dev_in = jax.device_put((nodes_b,) + args_b, sharding)
-            sol = _fetch_solve(*pipeline(*dev_in))
-
-            # bounded retry: one re-solve of the chunk with doubled nIter
-            # and stronger under-relaxation; adopted per lane only where
-            # the retry actually converges (NaN-quarantined lanes are
-            # excluded — more iterations cannot fix non-finite inputs)
-            retry_mask = valid[:, None] & ~sol["converged"] \
-                & ~sol["nonfinite"]
-            sol["retried"] = np.zeros_like(retry_mask)
-            if retry_nonconverged and retry_mask.any():
-                pipe2 = _sweep_pipeline(m0, sharding, 2 * m0.nIter, 0.4)
-                sol2 = _fetch_solve(*pipe2(*dev_in))
-                use = retry_mask & sol2["converged"]
-                for key in ("Xi_r", "Xi_i"):
-                    sol[key] = np.where(
-                        use[:, :, None, None], sol2[key], sol[key]
-                    )
-                for key in _REPORT_FILLS:
-                    sol[key] = np.where(use, sol2[key], sol[key])
-                sol["retried"] = retry_mask
-                logger.warning(
-                    "sweep chunk %d: %d non-converged lane(s) retried with "
-                    "doubled nIter / relax=0.4; %d recovered",
-                    k, int(retry_mask.sum()), int(use.sum()),
+            # whole chunk failed host-side; no device solve
+            _write_ck(ck_path, None, failed)
+            if verbose:
+                logger.info(
+                    "sweep chunk %d: solved 0 designs on %d devices "
+                    "(%d quarantined)", k, n_dev, len(failed),
                 )
+            records[k] = {"res": None, "failed": failed,
+                          "n_real": n_real, "k0": k0}
+            continue
 
-            # mask quarantined rows before anything downstream sees them
-            inv = ~valid[:n_real]
-            res = {}
-            for key in ("Xi_r", "Xi_i"):
-                a = sol[key][:n_real].copy()
-                a[inv] = np.nan
-                res[key] = a
-            for key, fillval in _REPORT_FILLS.items():
-                # fill values are dtype-matched (bool->False, int->0,
-                # float->NaN), so masked rows assign directly
-                a = sol[key][:n_real].copy()
-                a[inv] = fillval
-                res[key] = a
-            res["retried"] = sol["retried"][:n_real].copy()
-            res["retried"][inv] = False
-
-            Xi = res["Xi_r"] + 1j * res["Xi_i"]  # [n_real, ncase, 6, nw]
-            per_metrics = [
-                collect(preps[j][0], chunk_pts[j], Xi[j]) if valid[j]
-                else None
-                for j in range(n_real)
-            ]
-            template = per_metrics[ok[0]]
-            for key in template:
-                res[key] = np.stack([
-                    np.asarray(per_metrics[j][key])
-                    if per_metrics[j] is not None
-                    else _masked_row_fill(template[key], np.nan)
-                    for j in range(n_real)
-                ])
-            for name in chunk_pts[0]:
-                res[f"param_{name}"] = np.array(
-                    [pt[name] for pt in chunk_pts]
-                )
-
-        if ck_path and jax.process_index() == 0:
-            # one writer in multi-process runs (every host holds the full
-            # allgathered results, so checkpoints stay restartable anywhere);
-            # write-then-rename so a crash mid-write never leaves a
-            # truncated chunk that would poison the restart
-            save = {} if res is None else dict(res)
-            if res is None:
-                save["_all_failed"] = np.array(True)
-            if failed:
-                save["_failed_idx"] = np.array([f[0] for f in failed], int)
-                save["_failed_msg"] = np.array([f[2] for f in failed])
-            tmp_path = ck_path + ".tmp.npz"
-            np.savez(tmp_path, **save)
-            os.replace(tmp_path, ck_path)
-        if verbose:
-            logger.info(
-                "sweep chunk %d: solved %d designs on %d devices"
-                "%s", k, n_real - len(failed), n_dev,
-                f" ({len(failed)} quarantined)" if failed else "",
-            )
-        chunk_records.append(
-            {"res": res, "failed": failed, "n_real": n_real, "k0": k0}
+        # explicit slot map: every device slot names the prep it
+        # carries and ``valid`` marks the slots whose results are
+        # real.  Failed-prep slots and the ragged-tail padding slots
+        # are filled with the chunk's first healthy design purely to
+        # keep the batch shape — the mask guarantees those copies can
+        # never leak into collected metrics.
+        fill = ok[0]
+        slot = [j if (j < n_real and preps[j] is not None) else fill
+                for j in range(n_dev)]
+        valid = np.array(
+            [j < n_real and preps[j] is not None for j in range(n_dev)]
         )
+        nodes_list = [preps[s][1] for s in slot]
+        args_list = [preps[s][2] for s in slot]
+
+        nodes_b = pad_and_stack_nodes(nodes_list)
+        args_b = tuple(
+            np.stack([a[i] for a in args_list])
+            for i in range(len(args_list[0]))
+        )
+
+        m0 = preps[fill][0]
+        pipeline = _sweep_pipeline(m0, sharding, m0.nIter, 0.8)
+        dev_in = jax.device_put((nodes_b,) + args_b, sharding)
+        raw = pipeline(*dev_in)        # ASYNC dispatch: fetch in _finalize
+        ctx = dict(k=k, k0=k0, ck_path=ck_path, chunk_pts=chunk_pts,
+                   preps=preps, failed=failed, valid=valid, ok=ok,
+                   m0=m0, dev_in=dev_in, raw=raw)
+        if inflight is not None:
+            _finalize(inflight)        # blocks on the PREVIOUS chunk
+        inflight = ctx
+        if not overlap_ok:
+            _finalize(inflight)
+            inflight = None
+    if inflight is not None:
+        _finalize(inflight)
+    chunk_records = [records[k] for k in sorted(records)]
 
     proto = next(
         (r["res"] for r in chunk_records if r["res"] is not None), None

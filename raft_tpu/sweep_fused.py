@@ -145,7 +145,26 @@ _GUIDE_RTOL = 1e-9       # probe tolerance; exceeded -> direct fallback
 _GUIDE_PHI_TOL = 1e-2    # rad; max polish displacement of an in-basin lane
 
 
-def _guided_rotor_eval(rotor, U_case, yaw_case, pitch_dc):
+def _blank_rotor_telemetry():
+    """Guided-rotor telemetry accumulator: lane counts, probe error, and
+    stage costs (feeds sweep_timing_breakdown via res['rotor_telemetry']
+    — how the docs/performance.md §9 warm-start claim is reconciled with
+    what a given host actually measures)."""
+    return {
+        "guided_lanes": 0,           # lanes served by the warm-started path
+        "direct_fallback_lanes": 0,  # lanes re-solved by the full path
+        "bracketed_sample_lanes": 0,  # full-solve pitch samples + probes
+        "small_batch_lanes": 0,      # tiny sweeps solved directly (no guide)
+        "fallback_cases": 0,         # wind cases that tripped a guard
+        "probe_rel_err_max": 0.0,
+        "bracketed_sample_s": 0.0,
+        "guided_batch_s": 0.0,
+        "direct_fallback_s": 0.0,
+        "rotor_host_devices": 0,     # host devices the lane axis sharded over
+    }
+
+
+def _guided_rotor_eval(rotor, U_case, yaw_case, pitch_dc, telemetry=None):
     """Rotor loads + derivatives over (design x wind-case) lanes, with the
     per-section inflow-angle solves warm-started across designs.
 
@@ -169,14 +188,19 @@ def _guided_rotor_eval(rotor, U_case, yaw_case, pitch_dc):
     pitch_dc : [nd, nwind] platform pitch per design x case
     Returns (vals [nd, nwind, 10], J [nd, nwind, 10, 3]).
     """
+    tel = telemetry if telemetry is not None else _blank_rotor_telemetry()
     nd, nwind = pitch_dc.shape
     K, P = _GUIDE_NODES, _GUIDE_PROBES
     if nd <= K + P + 1:
+        t0 = time.perf_counter()
         vals, J = rotor.run_bem_batch(
             np.broadcast_to(U_case[None], (nd, nwind)).ravel(),
             pitch_dc.ravel(),
             np.broadcast_to(yaw_case[None], (nd, nwind)).ravel(),
         )
+        tel["small_batch_lanes"] += nd * nwind
+        tel["direct_fallback_s"] += time.perf_counter() - t0
+        tel["rotor_host_devices"] = rotor.last_batch_info["n_devices"]
         return vals.reshape(nd, nwind, 10), J.reshape(nd, nwind, 10, 3)
 
     # full-solve pitch samples per case (probes off the node grid)
@@ -186,10 +210,13 @@ def _guided_rotor_eval(rotor, U_case, yaw_case, pitch_dc):
     t_probe = np.array([0.317, 0.683])[:P]
     t_all = np.concatenate([t_nodes, t_probe])           # [K+P]
     batch_pitch = lo[:, None] + (hi - lo)[:, None] * t_all[None]
+    t0 = time.perf_counter()
     vals_n, J_n, phi_n = rotor.run_bem_batch(
         np.repeat(U_case, K + P), batch_pitch.ravel(),
         np.repeat(yaw_case, K + P), return_phi=True,
     )
+    tel["bracketed_sample_s"] += time.perf_counter() - t0
+    tel["bracketed_sample_lanes"] += (K + P) * nwind
     ns, nsp = phi_n.shape[-2:]
     vals_n = vals_n.reshape(nwind, K + P, 10)
     J_n = J_n.reshape(nwind, K + P, 10, 3)
@@ -216,9 +243,12 @@ def _guided_rotor_eval(rotor, U_case, yaw_case, pitch_dc):
         np.concatenate([interp_phi(batch_pitch[j, K:], j)
                         for j in range(nwind)]),
     ])
+    t0 = time.perf_counter()
     vals_g, J_g, phi_g, resid_g = rotor.run_bem_batch(
         U_g, pitch_g, yaw_g, phi0=phi0_g, return_phi=True,
         return_resid=True)
+    tel["guided_batch_s"] += time.perf_counter() - t0
+    tel["rotor_host_devices"] = rotor.last_batch_info["n_devices"]
     # .copy(): np.asarray of a jax.Array is a READ-ONLY view, and the
     # fallback below assigns into these per failing case
     vals = vals_g[:nd * nwind].reshape(nwind, nd, 10).copy()
@@ -257,21 +287,28 @@ def _guided_rotor_eval(rotor, U_case, yaw_case, pitch_dc):
         # error (guesses land ~1e-4 rad from the intended root)
         lane_ok = np.all(resid_l[j] <= 1e-8)
         phi_ok = np.all(dphi_l[j] <= _GUIDE_PHI_TOL)
+        tel["probe_rel_err_max"] = max(tel["probe_rel_err_max"],
+                                       float(err))
         if not (err <= _GUIDE_RTOL and lane_ok and phi_ok):
             direct.append(j)
+    tel["fallback_cases"] += len(direct)
+    tel["guided_lanes"] += nd * (nwind - len(direct))
+    tel["direct_fallback_lanes"] += nd * len(direct)
     if direct:
         dd = np.array(direct)
+        t0 = time.perf_counter()
         v_d, J_d = rotor.run_bem_batch(
             np.broadcast_to(U_case[dd][None], (nd, len(dd))).ravel(),
             pitch_dc[:, dd].ravel(),
             np.broadcast_to(yaw_case[dd][None], (nd, len(dd))).ravel(),
         )
+        tel["direct_fallback_s"] += time.perf_counter() - t0
         vals[dd] = v_d.reshape(nd, len(dd), 10).swapaxes(0, 1)
         J[dd] = J_d.reshape(nd, len(dd), 10, 3).swapaxes(0, 1)
     return vals.swapaxes(0, 1), J.swapaxes(0, 1)
 
 
-def _aero_second_pass(model0, cases, wind, pitch_mean):
+def _aero_second_pass(model0, cases, wind, pitch_mean, telemetry=None):
     """Second-pass rotor loads + aero-servo transfer terms at each design's
     mean platform pitch: phi-warm-started batched rotor evaluation (see
     :func:`_guided_rotor_eval`) plus broadcast transfer-function algebra
@@ -297,7 +334,7 @@ def _aero_second_pass(model0, cases, wind, pitch_mean):
         [float(cases[i].get("yaw_misalign", 0.0)) for i in widx]
     )
     vals, J = _guided_rotor_eval(
-        rotor, wind[widx], yaw, pitch_mean[:, widx])
+        rotor, wind[widx], yaw, pitch_mean[:, widx], telemetry=telemetry)
 
     # mean hub loads with the reference's ordering quirk [T, Y, Z, My, Q, Mz]
     # (raft/raft_rotor.py:350-351), shifted to the PRP
@@ -339,24 +376,22 @@ def _ballast_combine(v, b):
     return dict(mass=mass, rCG=rCG, M_struc=M_struc, C_struc=C_struc)
 
 
-def _shard_pipeline_args(dev_args, mesh):
-    """Place the dynamics-pipeline operands over a 1-D ``('design',)``
-    mesh: every per-design operand is sharded along the within-group
-    design axis (axis 1 — the lax.map group axis 0 stays serial on every
-    device), the case/frequency operands are replicated.  The jitted
-    pipeline then runs SPMD: each device solves its slice of the designs
-    with zero communication (the design axis is embarrassingly parallel,
-    SURVEY.md §2.4), exactly like the generic driver's design mesh
-    (sweep.py) but on the fused path that produces the headline number."""
+def _pipeline_placers(mesh):
+    """(put_design, put_replicated) placement callables for the dynamics
+    pipeline operands.  With a 1-D ``('design',)`` mesh, per-design
+    operands shard along the within-group design axis (axis 1 — the
+    lax.map group axis 0 stays serial on every device) and case/frequency
+    operands replicate, so the jitted pipeline runs SPMD with zero
+    communication (the design axis is embarrassingly parallel, SURVEY.md
+    §2.4); without a mesh both are plain default-device placements."""
+    if mesh is None:
+        return jax.device_put, jnp.asarray
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     s_d = NamedSharding(mesh, P(None, "design"))
     s_r = NamedSharding(mesh, P())
-    nodes_g, zeta, beta, C, M0, a, b = dev_args
-    nodes_s = jax.tree.map(lambda x: jax.device_put(x, s_d), nodes_g)
-    return (nodes_s, jax.device_put(zeta, s_r), jax.device_put(beta, s_r),
-            jax.device_put(C, s_d), jax.device_put(M0, s_d),
-            jax.device_put(a, s_d), jax.device_put(b, s_d))
+    return (lambda x: jax.device_put(x, s_d),
+            lambda x: jax.device_put(x, s_r))
 
 
 def _dynamics_pipeline(model0, return_xi, nIter=None, relax=0.8):
@@ -434,69 +469,190 @@ def _dynamics_pipeline_cached(w_bytes, k_bytes, nw, depth, rho, g,
     return jax.jit(pipeline)
 
 
-def _solve_fused_dynamics(model0, dev_args, return_xi, nd_flat, nc,
-                          retry_nonconverged=True, label="fused sweep"):
-    """Dispatch the fused dynamics pipeline, fetch + flatten the results
-    to a leading [nd_flat] design axis, and give non-converged *finite*
-    lanes one bounded retry re-solve with doubled nIter and stronger
-    under-relaxation (relax 0.4); the retry is adopted per lane only
-    where it converges, so first-pass-healthy lanes stay bit-identical.
+def _unpack_dyn(dyn, nd_flat, ncc, return_xi, nw):
+    """Pipeline output for one case chunk -> dict of host arrays with a
+    flattened leading [nd_flat] design axis and a [ncc] case axis."""
+    rep = dyn[1]
+    out = {
+        "std": np.asarray(dyn[0], np.float64).reshape(nd_flat, ncc, 6),
+        "iters": np.asarray(rep.iters).reshape(nd_flat, ncc),
+        "converged": np.asarray(rep.converged).reshape(nd_flat, ncc),
+        "nonfinite": np.asarray(rep.nonfinite).reshape(nd_flat, ncc),
+        "recovery_tier": np.asarray(
+            rep.recovery_tier).reshape(nd_flat, ncc),
+        "residual": np.asarray(
+            rep.residual, np.float64).reshape(nd_flat, ncc),
+        "cond": np.asarray(rep.cond, np.float64).reshape(nd_flat, ncc),
+    }
+    if return_xi:
+        out["xr"] = np.asarray(dyn[2], np.float64).reshape(
+            nd_flat, ncc, 6, nw)
+        out["xi"] = np.asarray(dyn[3], np.float64).reshape(
+            nd_flat, ncc, 6, nw)
+    return out
 
-    Returns (sol dict, first-dispatch seconds, compiled flops)."""
+
+def _overlap_case_chunks(wind, aero_on, overlap, nd_aero):
+    """Case-axis chunks for the aero-second -> dynamics overlap, or None
+    for the barrier-preserving single-dispatch path.
+
+    The split is along the WIND-CASE axis: wind-free cases need no rotor
+    second pass, so their dynamics dispatch goes out first (the device
+    starts while the host begins rotor work), and the wind cases are cut
+    into two double-buffered chunks — the dispatch for chunk k runs while
+    the host computes rotor loads for chunk k+1.
+
+    Barrier fallback when: overlap is False (or RAFT_TPU_NO_OVERLAP=1),
+    a single case, aero off / no wind cases (nothing to overlap), or —
+    under overlap='auto' — a sweep too small for the rotor stage to
+    matter (each chunk shape is its own compiled executable; tiny test
+    sweeps should not pay that).
+    """
+    import os
+
+    nc = len(wind)
+    if os.environ.get("RAFT_TPU_NO_OVERLAP") == "1" or overlap is False:
+        return None
+    widx = np.where(wind > 0.0)[0]
+    if nc <= 1 or not aero_on or len(widx) == 0:
+        return None
+    if overlap == "auto" and nd_aero * len(widx) < 256:
+        return None
+    calm = np.where(~(wind > 0.0))[0]
+    chunks = []
+    if len(calm):
+        chunks.append(calm)
+    if len(widx) >= 2:
+        half = (len(widx) + 1) // 2
+        chunks.extend([widx[:half], widx[half:]])
+    else:
+        chunks.append(widx)
+    return chunks
+
+
+def _chunked_aero_dynamics(model0, cases, wind, aero_on, pitch_mean,
+                           make_dev_args, nd_aero, nd_flat, return_xi,
+                           retry_nonconverged, label, tracer,
+                           overlap="auto"):
+    """The aero-second -> dynamics hand-off, split along the wind-case
+    axis into double-buffered chunks: the jitted dynamics dispatch for
+    chunk k is ASYNCHRONOUS (the old path blocked on one fused dispatch),
+    so it runs on the device while the host computes rotor loads for
+    chunk k+1; with one chunk this is exactly the old barrier path.
+
+    make_dev_args(case_idx, a_sub, b_sub) builds the (sharded/placed)
+    pipeline operands for that case subset; case-independent operands
+    should be placed once by the caller and closed over.
+
+    Returns (sol, a_hub, b_hub, F_aero2, telemetry, timing) where sol
+    carries the merged [nd_flat, nc] solve results + the bounded
+    non-convergence retry, and timing the stage spans/overlap metrics.
+    """
     from raft_tpu.utils.profiling import compiled_flops
 
+    nc = len(cases)
+    nw = model0.nw
+    chunks = _overlap_case_chunks(wind, aero_on, overlap, nd_aero)
+    barrier = chunks is None
+    if barrier:
+        chunks = [np.arange(nc)]
+    telemetry = _blank_rotor_telemetry()
+    a_hub = np.zeros((nd_aero, nc, nw))
+    b_hub = np.zeros((nd_aero, nc, nw))
+    F_aero2 = np.zeros((nd_aero, nc, 6))
     pipeline = _dynamics_pipeline(model0, return_xi)
-    t0 = time.perf_counter()
-    dyn = pipeline(*dev_args)
-    jax.block_until_ready(dyn)
-    t_dyn = time.perf_counter() - t0
-    dyn_flops = compiled_flops(pipeline, dev_args)
+    backend = jax.default_backend()
 
-    def unpack(dyn):
-        rep = dyn[1]
-        out = {
-            "std": np.asarray(dyn[0], np.float64).reshape(nd_flat, nc, 6),
-            "iters": np.asarray(rep.iters).reshape(nd_flat, nc),
-            "converged": np.asarray(rep.converged).reshape(nd_flat, nc),
-            "nonfinite": np.asarray(rep.nonfinite).reshape(nd_flat, nc),
-            "recovery_tier": np.asarray(
-                rep.recovery_tier).reshape(nd_flat, nc),
-            "residual": np.asarray(
-                rep.residual, np.float64).reshape(nd_flat, nc),
-            "cond": np.asarray(rep.cond, np.float64).reshape(nd_flat, nc),
-        }
-        if return_xi:
-            out["xr"] = np.asarray(dyn[2], np.float64).reshape(
-                nd_flat, nc, 6, model0.nw)
-            out["xi"] = np.asarray(dyn[3], np.float64).reshape(
-                nd_flat, nc, 6, model0.nw)
-        return out
+    t_engine0 = time.perf_counter()
+    t_rotor = 0.0
+    inflight = []
+    for k, ci in enumerate(chunks):
+        ci = np.asarray(ci, int)
+        wsub = wind[ci]
+        if aero_on and np.any(wsub > 0.0):
+            with tracer.span("aero_second", backend="cpu", chunk=k,
+                             cases=len(ci)) as sp:
+                a_c, b_c, F_c = _aero_second_pass(
+                    model0, [cases[i] for i in ci], wsub,
+                    pitch_mean[:, ci], telemetry=telemetry)
+            t_rotor += sp["t1"] - sp["t0"]
+            a_hub[:, ci] = a_c
+            b_hub[:, ci] = b_c
+            F_aero2[:, ci] = F_c
+        dev_args = make_dev_args(ci, a_hub[:, ci], b_hub[:, ci])
+        h = tracer.begin("dynamics", backend=backend, chunk=k,
+                         cases=len(ci))
+        dyn = pipeline(*dev_args)      # async dispatch: host continues
+        inflight.append((ci, dev_args, dyn, h))
 
-    sol = unpack(dyn)
+    parts = []
+    for ci, dev_args, dyn, h in inflight:
+        jax.block_until_ready(dyn)
+        tracer.end(h)
+        parts.append((ci, _unpack_dyn(dyn, nd_flat, len(ci), return_xi,
+                                      nw)))
+    t_engine = time.perf_counter() - t_engine0
+    dyn_flops = sum(
+        compiled_flops(pipeline, dev_args)
+        for _, dev_args, _, _ in inflight
+    )
+
+    # merge chunk columns back into [nd_flat, nc] order
+    sol = {}
+    for key, part0 in parts[0][1].items():
+        full = np.empty((nd_flat, nc) + part0.shape[2:], part0.dtype)
+        for ci, part in parts:
+            full[:, ci] = part[key]
+        sol[key] = full
+
+    # bounded retry: re-solve only the chunks carrying non-converged
+    # finite lanes (all retry dispatches issued async, then adopted per
+    # lane only where the retry converges — first-pass-healthy lanes
+    # stay bit-identical)
     retry_mask = ~sol["converged"] & ~sol["nonfinite"]
     sol["retried"] = np.zeros_like(retry_mask)
     if retry_nonconverged and retry_mask.any():
         pipe2 = _dynamics_pipeline(
             model0, return_xi, nIter=2 * model0.nIter, relax=0.4)
-        dyn2 = pipe2(*dev_args)
-        jax.block_until_ready(dyn2)
-        sol2 = unpack(dyn2)
-        use = retry_mask & sol2["converged"]
-        sol["std"] = np.where(use[:, :, None], sol2["std"], sol["std"])
-        for key in ("iters", "converged", "nonfinite", "recovery_tier",
-                    "residual", "cond"):
-            sol[key] = np.where(use, sol2[key], sol[key])
-        if return_xi:
-            for key in ("xr", "xi"):
-                sol[key] = np.where(
-                    use[:, :, None, None], sol2[key], sol[key])
+        redo = []
+        for ci, dev_args, _, _ in inflight:
+            if retry_mask[:, ci].any():
+                h = tracer.begin("dynamics_retry", backend=backend,
+                                 cases=len(ci))
+                redo.append((ci, pipe2(*dev_args), h))
+        n_rec = 0
+        for ci, dyn2, h in redo:
+            jax.block_until_ready(dyn2)
+            tracer.end(h)
+            part2 = _unpack_dyn(dyn2, nd_flat, len(ci), return_xi, nw)
+            use = retry_mask[:, ci] & part2["converged"]
+            n_rec += int(use.sum())
+            sol["std"][:, ci] = np.where(
+                use[:, :, None], part2["std"], sol["std"][:, ci])
+            for key in ("iters", "converged", "nonfinite",
+                        "recovery_tier", "residual", "cond"):
+                sol[key][:, ci] = np.where(use, part2[key], sol[key][:, ci])
+            if return_xi:
+                for key in ("xr", "xi"):
+                    sol[key][:, ci] = np.where(
+                        use[:, :, None, None], part2[key],
+                        sol[key][:, ci])
         sol["retried"] = retry_mask
         logger.warning(
             "%s: %d non-converged lane(s) retried with doubled nIter / "
             "relax=0.4; %d recovered",
-            label, int(retry_mask.sum()), int(use.sum()),
+            label, int(retry_mask.sum()), n_rec,
         )
-    return sol, t_dyn, dyn_flops
+
+    timing = {
+        "aero_second_s": t_rotor,
+        "dynamics_first_s": tracer.stage_wall("dynamics"),
+        "overlap_chunks": len(chunks),
+        "overlap_saved_s": tracer.overlap_saved_s(
+            "aero_second", "dynamics"),
+        "rotor_dyn_wall_s": t_engine,
+    }
+    return sol, a_hub, b_hub, F_aero2, telemetry, timing, dyn_flops
 
 
 def _quarantine_design_rows(res, fmask, lead_shape):
@@ -529,6 +685,8 @@ def run_draft_ballast_sweep(
     verbose=True,
     mesh=None,
     retry_nonconverged=True,
+    overlap="auto",
+    tracer=None,
 ):
     """Run the fused draft x ballast sweep.
 
@@ -553,11 +711,27 @@ def run_draft_ballast_sweep(
         the within-group draft axis across devices (``draft_group`` must
         be divisible by the mesh size); results are identical to the
         single-device path (asserted by the multichip dryrun).
+    overlap : 'auto' | True | False
+        Split the aero-second -> dynamics hand-off along the wind-case
+        axis into double-buffered chunks so the async dynamics dispatch
+        for chunk k runs while the host computes rotor loads for chunk
+        k+1 (see :func:`_chunked_aero_dynamics`); 'auto' engages it only
+        for sweeps large enough for the rotor stage to matter, False (or
+        RAFT_TPU_NO_OVERLAP=1) forces the barrier-preserving single
+        dispatch.
+    tracer : raft_tpu.trace.Tracer | None
+        Span recorder for the stage timeline (a fresh one is created per
+        run when None); returned as ``res["tracer"]`` and dumped as a
+        chrome://tracing JSON when RAFT_TPU_TRACE is set.
 
-    Returns dict with metrics [nD, nB, ...], timing breakdown, and the
+    Returns dict with metrics [nD, nB, ...], timing breakdown (including
+    the measured overlap savings), per-run rotor telemetry, and the
     mooring/statics intermediates the benchmark asserts against.
     """
+    from raft_tpu.trace import Tracer
+
     t_start = time.perf_counter()
+    tracer = tracer or Tracer("fused_sweep")
     model0 = Model(base_design, precision=precision)
     nD, nB = len(draft_scales), len(ballast_scales)
     nd = nD * nB
@@ -619,6 +793,7 @@ def run_draft_ballast_sweep(
     b = np.asarray(ballast_scales, np.float64)
     comb = [_ballast_combine(v, b) for v in variants]
     t_host = time.perf_counter() - t0
+    tracer.add("host_prep", t_host, backend="cpu")
 
     # ---- aero first pass: per-case mean loads at zero pitch ----
     # (design-independent, so one batched rotor evaluation serves the
@@ -630,6 +805,7 @@ def run_draft_ballast_sweep(
         if aero_on else np.zeros((nc, 6))
     )
     t_aero1 = time.perf_counter() - t0
+    tracer.add("aero_first", t_aero1, backend="cpu")
 
     # ---- mooring: all designs x distinct-mean-load cases in one f64 CPU
     # call.  Cases sharing the same mean load (all wind-free cases, and
@@ -662,20 +838,15 @@ def run_draft_ballast_sweep(
         expand(o) for o in out)
     warn_bridle_residual(moor_resid, label="design")
     t_moor = time.perf_counter() - t0
+    tracer.add("mooring", t_moor, backend="cpu")
 
-    # ---- aero second pass at the mean platform pitch of every design ----
-    t0 = time.perf_counter()
-    if aero_on:
-        a_hub, b_hub, F_aero2 = _aero_second_pass(
-            model0, cases, wind, r6[:, :, 4]
-        )
-    else:
-        a_hub = np.zeros((nd, nc, model0.nw))
-        b_hub = np.zeros((nd, nc, model0.nw))
-        F_aero2 = np.zeros((nd, nc, 6))
-    t_aero2 = time.perf_counter() - t0
-
-    # ---- dynamics: one jitted TPU dispatch ----
+    # ---- aero second pass + dynamics, overlapped along the case axis:
+    # case-independent operands are placed once, then the chunk engine
+    # interleaves host rotor work with async dynamics dispatches ----
+    if mesh is not None and draft_group % mesh.size:
+        raise ValueError(
+            f"draft_group ({draft_group}) must be divisible by the "
+            f"design-mesh size ({mesh.size})")
     dtype = model0.dtype
     G = nD // draft_group
     nodes_all = pad_and_stack_nodes([v.nodes.astype(dtype) for v in variants])
@@ -691,29 +862,32 @@ def run_draft_ballast_sweep(
         + np.stack([v.A_morison for v in variants])[:, None]
     )                                                          # [nD, nB, 6, 6]
 
-    dev_args = (
-        nodes_g,
-        zeta.astype(dtype),
-        np.asarray(beta, dtype),
-        shp(C_lin.astype(dtype)),
-        shp(M0_all.astype(dtype)),
-        shp(a_hub.reshape(nD, nB, nc, model0.nw).astype(dtype)),
-        shp(b_hub.reshape(nD, nB, nc, model0.nw).astype(dtype)),
-    )
-    if mesh is not None:
-        if draft_group % mesh.size:
-            raise ValueError(
-                f"draft_group ({draft_group}) must be divisible by the "
-                f"design-mesh size ({mesh.size})")
-        dev_args = _shard_pipeline_args(dev_args, mesh)
-    else:
-        dev_args = (jax.device_put(dev_args[0]),) + tuple(
-            jnp.asarray(a) for a in dev_args[1:])
-    sol, t_dyn_first, dyn_flops = _solve_fused_dynamics(
-        model0, dev_args, return_xi, nd, nc,
-        retry_nonconverged=retry_nonconverged,
-        label=f"fused sweep {nD}x{nB}",
-    )  # t_dyn_first includes compile on first call
+    put_d, put_r = _pipeline_placers(mesh)
+    nodes_dev = jax.tree.map(put_d, nodes_g) if mesh is not None \
+        else jax.device_put(nodes_g)
+    M0_dev = put_d(shp(M0_all.astype(dtype)))
+    beta_f = np.asarray(beta, dtype)
+
+    def make_dev_args(ci, a_sub, b_sub):
+        ncc = len(ci)
+        return (
+            nodes_dev,
+            put_r(zeta[ci].astype(dtype)),
+            put_r(beta_f[ci]),
+            put_d(shp(C_lin[:, :, ci].astype(dtype))),
+            M0_dev,
+            put_d(shp(a_sub.reshape(nD, nB, ncc, model0.nw)
+                      .astype(dtype))),
+            put_d(shp(b_sub.reshape(nD, nB, ncc, model0.nw)
+                      .astype(dtype))),
+        )
+
+    sol, a_hub, b_hub, F_aero2, rotor_tel, eng_timing, dyn_flops = \
+        _chunked_aero_dynamics(
+            model0, cases, wind, aero_on, r6[:, :, 4], make_dev_args,
+            nd, nd, return_xi, retry_nonconverged,
+            f"fused sweep {nD}x{nB}", tracer, overlap=overlap,
+        )  # dynamics_first_s includes compile on first call
     std = sol["std"]
     iters = sol["iters"]
     conv = sol["converged"]
@@ -757,12 +931,13 @@ def run_draft_ballast_sweep(
         # second-pass mean aero loads at the PRP (zero for wind-free cases)
         "F_aero0": F_aero2.reshape(nD, nB, nc, 6),
         "dynamics_flops": dyn_flops,
+        "rotor_telemetry": rotor_tel,
+        "tracer": tracer,
         "timing": {
             "host_prep_s": t_host,
             "aero_first_s": t_aero1,
             "mooring_s": t_moor,
-            "aero_second_s": t_aero2,
-            "dynamics_first_s": t_dyn_first,
+            **eng_timing,
             "total_s": time.perf_counter() - t_start,
         },
     }
@@ -780,14 +955,18 @@ def run_draft_ballast_sweep(
         for i, msg in failed_drafts
     ]
     res["failed_mask"] = fmask
+    tracer.maybe_dump_env()
     if verbose:
         tm = res["timing"]
         logger.info(
             "fused sweep %dx%d: host %.2fs, aero %.2fs, mooring %.2fs, "
-            "dynamics(first) %.2fs, total %.2fs",
+            "dynamics(first) %.2fs, overlap saved %.2fs "
+            "(%d chunk(s), %d host device(s)), total %.2fs",
             nD, nB, tm["host_prep_s"],
             tm["aero_first_s"] + tm["aero_second_s"], tm["mooring_s"],
-            tm["dynamics_first_s"], tm["total_s"],
+            tm["dynamics_first_s"], tm["overlap_saved_s"],
+            tm["overlap_chunks"], rotor_tel["rotor_host_devices"],
+            tm["total_s"],
         )
     return res
 
@@ -1001,6 +1180,8 @@ def run_design_sweep(
     verbose=True,
     mesh=None,
     retry_nonconverged=True,
+    overlap="auto",
+    tracer=None,
 ):
     """Fused sweep over an arbitrary list of design dicts — the general
     form of the reference's 5-parameter geometry study
@@ -1019,6 +1200,8 @@ def run_design_sweep(
         shards the within-group design axis across its devices
         (``group`` must be divisible by the mesh size), results
         identical to the single-device path.
+    overlap, tracer : case-axis aero/dynamics overlap and stage-span
+        recording, exactly as in :func:`run_draft_ballast_sweep`.
 
     All designs must share the cases table and frequency settings of
     ``designs[0]``.
@@ -1027,7 +1210,10 @@ def run_design_sweep(
     pitch_deg, std, ...) shaped [nd, ...]; reshape to the study's axes
     grid for contour matrices.
     """
+    from raft_tpu.trace import Tracer
+
     t_start = time.perf_counter()
+    tracer = tracer or Tracer("design_sweep")
     model0 = Model(designs[0], precision=precision)
     nd = len(designs)
 
@@ -1077,6 +1263,7 @@ def run_design_sweep(
     )
     bridles_all = _stack_bridles(variants)
     t_host = time.perf_counter() - t0
+    tracer.add("host_prep", t_host, backend="cpu")
 
     # ---- optional closed-form ballast-density trim ----
     rho_w, grav = model0.rho_water, model0.g
@@ -1118,6 +1305,7 @@ def run_design_sweep(
         if aero_on else np.zeros((nc, 6))
     )
     t_aero1 = time.perf_counter() - t0
+    tracer.add("aero_first", t_aero1, backend="cpu")
 
     # ---- mooring: designs x distinct-mean-load case groups ----
     t0 = time.perf_counter()
@@ -1139,23 +1327,17 @@ def run_design_sweep(
         expand(o) for o in out)
     warn_bridle_residual(moor_resid, label="design")
     t_moor = time.perf_counter() - t0
+    tracer.add("mooring", t_moor, backend="cpu")
 
-    # ---- aero second pass at mean pitch ----
-    t0 = time.perf_counter()
-    if aero_on:
-        a_hub, b_hub, F_aero2 = _aero_second_pass(
-            model0, cases, wind, r6[:, :, 4]
-        )
-    else:
-        a_hub = np.zeros((nd, nc, model0.nw))
-        b_hub = np.zeros((nd, nc, model0.nw))
-        F_aero2 = np.zeros((nd, nc, 6))
-    t_aero2 = time.perf_counter() - t0
-
-    # ---- dynamics: pad the design axis to a group multiple and reuse
-    # the draft x ballast pipeline with a unit ballast axis ----
+    # ---- aero second pass + dynamics, overlapped along the case axis:
+    # pad the design axis to a group multiple and reuse the draft x
+    # ballast pipeline with a unit ballast axis ----
     dtype = model0.dtype
     gd = min(group, nd)
+    if mesh is not None and gd % mesh.size:
+        raise ValueError(
+            f"group ({gd}) must be divisible by the design-mesh "
+            f"size ({mesh.size})")
     nd_pad = -(-nd // gd) * gd
     G = nd_pad // gd
     pad_idx = np.concatenate([np.arange(nd),
@@ -1172,29 +1354,29 @@ def run_design_sweep(
     )[pad_idx]                                          # [nd_pad, nc, 6, 6]
     M0_all = (M_struc + np.stack([v.A_morison for v in variants]))[pad_idx]
 
-    dev_args = (
-        nodes_g,
-        zeta.astype(dtype),
-        np.asarray(beta, dtype),
-        shp(C_lin.astype(dtype)),
-        shp(M0_all.astype(dtype)),
-        shp(a_hub[pad_idx].astype(dtype)),
-        shp(b_hub[pad_idx].astype(dtype)),
-    )
-    if mesh is not None:
-        if gd % mesh.size:
-            raise ValueError(
-                f"group ({gd}) must be divisible by the design-mesh "
-                f"size ({mesh.size})")
-        dev_args = _shard_pipeline_args(dev_args, mesh)
-    else:
-        dev_args = (jax.device_put(dev_args[0]),) + tuple(
-            jnp.asarray(a) for a in dev_args[1:])
-    sol, t_dyn, dyn_flops = _solve_fused_dynamics(
-        model0, dev_args, return_xi, nd_pad, nc,
-        retry_nonconverged=retry_nonconverged,
-        label=f"design sweep x{nd}",
-    )
+    put_d, put_r = _pipeline_placers(mesh)
+    nodes_dev = jax.tree.map(put_d, nodes_g) if mesh is not None \
+        else jax.device_put(nodes_g)
+    M0_dev = put_d(shp(M0_all.astype(dtype)))
+    beta_f = np.asarray(beta, dtype)
+
+    def make_dev_args(ci, a_sub, b_sub):
+        return (
+            nodes_dev,
+            put_r(zeta[ci].astype(dtype)),
+            put_r(beta_f[ci]),
+            put_d(shp(C_lin[:, ci].astype(dtype))),
+            M0_dev,
+            put_d(shp(a_sub[pad_idx].astype(dtype))),
+            put_d(shp(b_sub[pad_idx].astype(dtype))),
+        )
+
+    sol, a_hub, b_hub, F_aero2, rotor_tel, eng_timing, dyn_flops = \
+        _chunked_aero_dynamics(
+            model0, cases, wind, aero_on, r6[:, :, 4], make_dev_args,
+            nd, nd_pad, return_xi, retry_nonconverged,
+            f"design sweep x{nd}", tracer, overlap=overlap,
+        )
     std = sol["std"][:nd]
     iters = sol["iters"][:nd]
     conv = sol["converged"][:nd]
@@ -1223,12 +1405,13 @@ def run_design_sweep(
         "T_moor": T_moor,
         "moor_resid": moor_resid,
         "dynamics_flops": dyn_flops,
+        "rotor_telemetry": rotor_tel,
+        "tracer": tracer,
         "timing": {
             "host_prep_s": t_host,
             "aero_first_s": t_aero1,
             "mooring_s": t_moor,
-            "aero_second_s": t_aero2,
-            "dynamics_first_s": t_dyn,
+            **eng_timing,
             "total_s": time.perf_counter() - t_start,
         },
     }
@@ -1241,13 +1424,16 @@ def run_design_sweep(
     _quarantine_design_rows(res, fmask, (nd,))
     res["failed"] = [{"index": i, "error": msg} for i, msg in failed_pts]
     res["failed_mask"] = fmask
+    tracer.maybe_dump_env()
     if verbose:
         tm = res["timing"]
         logger.info(
             "design sweep x%d: host %.2fs, aero %.2fs, mooring %.2fs, "
-            "dynamics %.2fs, total %.2fs",
+            "dynamics %.2fs, overlap saved %.2fs (%d chunk(s)), "
+            "total %.2fs",
             nd, tm["host_prep_s"],
             tm["aero_first_s"] + tm["aero_second_s"], tm["mooring_s"],
-            tm["dynamics_first_s"], tm["total_s"],
+            tm["dynamics_first_s"], tm["overlap_saved_s"],
+            tm["overlap_chunks"], tm["total_s"],
         )
     return res

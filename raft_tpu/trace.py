@@ -1,0 +1,173 @@
+"""Stage-timeline span recorder for the heterogeneous (CPU + TPU) sweep
+pipeline.
+
+The fused sweep interleaves host rotor work with asynchronous device
+dynamics dispatches (sweep_fused.py); whether the two actually overlap —
+and by how much — must be *measured*, not asserted.  A :class:`Tracer`
+records monotonic start/stop spans per stage, per chunk, per backend, and
+can emit them as a chrome://tracing-compatible JSON (open in
+``chrome://tracing`` or https://ui.perfetto.dev) as well as reduce them to
+the flat per-stage seconds the benchmark's ``sweep_timing_breakdown``
+reports.
+
+Async device spans: a dispatch returns before the device finishes, so
+device stages are recorded with :meth:`Tracer.begin` at dispatch and
+:meth:`Tracer.end` when ``jax.block_until_ready`` returns — the span is
+the dispatch-to-ready critical path as the host observes it (it includes
+queueing, which is exactly what overlap is supposed to hide).
+
+Set ``RAFT_TPU_TRACE=/path/to/trace.json`` to make the sweep drivers dump
+their timeline automatically after every run (the file is overwritten
+atomically per run, last run wins).
+"""
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+
+__all__ = ["Tracer", "trace_path_from_env"]
+
+
+class Tracer:
+    """Monotonic span recorder.  Thread-safe; negligible overhead
+    (one ``perf_counter`` pair and a dict per span)."""
+
+    def __init__(self, label="raft_tpu"):
+        self.label = label
+        self.spans = []
+        self._lock = threading.Lock()
+        # wall-clock anchor so chrome traces from different processes
+        # can be lined up if needed
+        self.t0_unix = time.time()
+        self.t0 = time.perf_counter()
+
+    # ------------------------------------------------------------ recording
+
+    def begin(self, name, backend="host", chunk=None, **meta):
+        """Open a span; returns the handle to pass to :meth:`end`.
+        Use for async stages (device dispatch -> block_until_ready)."""
+        return {
+            "name": name, "backend": backend, "chunk": chunk,
+            "t0": time.perf_counter() - self.t0, "meta": meta,
+        }
+
+    def end(self, handle, **meta):
+        """Close a span opened by :meth:`begin` and record it."""
+        handle["t1"] = time.perf_counter() - self.t0
+        if meta:
+            handle["meta"].update(meta)
+        with self._lock:
+            self.spans.append(handle)
+        return handle["t1"] - handle["t0"]
+
+    @contextmanager
+    def span(self, name, backend="host", chunk=None, **meta):
+        """Context-managed synchronous span."""
+        h = self.begin(name, backend=backend, chunk=chunk, **meta)
+        try:
+            yield h
+        finally:
+            self.end(h)
+
+    def add(self, name, seconds, backend="host", chunk=None, **meta):
+        """Record a pre-measured duration ending now (for stages timed by
+        existing perf_counter pairs)."""
+        t1 = time.perf_counter() - self.t0
+        with self._lock:
+            self.spans.append({
+                "name": name, "backend": backend, "chunk": chunk,
+                "t0": t1 - float(seconds), "t1": t1, "meta": meta,
+            })
+
+    # ------------------------------------------------------------ reductions
+
+    def _named(self, name):
+        with self._lock:
+            return [s for s in self.spans if s["name"] == name and "t1" in s]
+
+    def stage_seconds(self):
+        """{stage name: summed span seconds} — per-chunk spans of one
+        stage accumulate (the 'how much work' view)."""
+        out = {}
+        with self._lock:
+            for s in self.spans:
+                if "t1" in s:
+                    out[s["name"]] = out.get(s["name"], 0.0) \
+                        + (s["t1"] - s["t0"])
+        return out
+
+    def stage_wall(self, *names):
+        """Union wall-clock of the named stages (first start -> last end;
+        the 'how long did the critical path take' view).  0.0 when no
+        matching span exists."""
+        spans = [s for n in names for s in self._named(n)]
+        if not spans:
+            return 0.0
+        return max(s["t1"] for s in spans) - min(s["t0"] for s in spans)
+
+    def overlap_saved_s(self, *names):
+        """Seconds the named stages ran concurrently: sum of their span
+        durations minus their union wall-clock.  0.0 on the barrier
+        (non-overlapped) path by construction."""
+        spans = [s for n in names for s in self._named(n)]
+        if not spans:
+            return 0.0
+        total = sum(s["t1"] - s["t0"] for s in spans)
+        return max(0.0, total - self.stage_wall(*names))
+
+    # -------------------------------------------------------------- emission
+
+    def chrome_trace(self):
+        """chrome://tracing JSON object (ph="X" complete events; one pid
+        per tracer label, one tid per backend so CPU and TPU stages render
+        as parallel tracks)."""
+        tids = {}
+        events = []
+        with self._lock:
+            spans = list(self.spans)
+        for s in spans:
+            if "t1" not in s:
+                continue
+            tid = tids.setdefault(s["backend"], len(tids) + 1)
+            args = {k: v for k, v in s.get("meta", {}).items()}
+            if s.get("chunk") is not None:
+                args["chunk"] = s["chunk"]
+            events.append({
+                "name": s["name"] if s.get("chunk") is None
+                else f"{s['name']}[{s['chunk']}]",
+                "cat": s["backend"], "ph": "X",
+                "ts": s["t0"] * 1e6, "dur": (s["t1"] - s["t0"]) * 1e6,
+                "pid": 1, "tid": tid, "args": args,
+            })
+        meta = [
+            {"name": "process_name", "ph": "M", "pid": 1,
+             "args": {"name": self.label}},
+        ] + [
+            {"name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+             "args": {"name": backend}}
+            for backend, tid in tids.items()
+        ]
+        return {"traceEvents": meta + events,
+                "displayTimeUnit": "ms",
+                "otherData": {"t0_unix": self.t0_unix}}
+
+    def dump(self, path):
+        """Atomic (write-then-rename) chrome-trace dump."""
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(self.chrome_trace(), fh)
+        os.replace(tmp, path)
+        return path
+
+    def maybe_dump_env(self):
+        """Dump to $RAFT_TPU_TRACE if set; returns the path or None."""
+        path = trace_path_from_env()
+        if path:
+            return self.dump(path)
+        return None
+
+
+def trace_path_from_env():
+    return os.environ.get("RAFT_TPU_TRACE") or None

@@ -1,0 +1,85 @@
+"""Pin the bench's BEM section against the class of failure that ate a
+driver round: ``bem_error: ValueError: too many values to unpack`` on the
+TPU-only branch of bench_bem (the convergence-anchor unpack drifted from
+full_hull_convergence's return arity, and CPU test runs never execute
+that branch).  Here the WHOLE TPU-form branch — real-block solve,
+blocked Gauss-Jordan, report_cost, and the real full_hull_convergence
+unpack — runs on the CPU backend with coarse meshes."""
+
+import numpy as np
+import pytest
+import yaml
+
+import jax
+
+import bench
+from raft_tpu import bem_solver
+from raft_tpu.designs import deep_spar
+
+
+@pytest.fixture()
+def cpu_as_tpu(monkeypatch):
+    """Route backend='tpu' placements to the CPU so the TPU-form code
+    paths compile and execute without TPU hardware (the established
+    pattern from tests/test_bem_solver.py)."""
+    import raft_tpu.utils.placement as placement
+
+    orig = placement.backend_sharding
+    monkeypatch.setattr(placement, "backend_sharding",
+                        lambda b: orig("cpu"))
+    monkeypatch.setattr(placement, "backend_devices",
+                        lambda b=None: jax.devices("cpu")[:1])
+
+
+def test_bench_bem_tpu_branch_runs_clean(cpu_as_tpu):
+    """bench_bem's full device branch (both mesh sizes, report_cost warm
+    calls, the speedup arithmetic) completes and returns finite figures —
+    no unpack mismatches anywhere down the call chain."""
+    res = bench.bench_bem(nw=2, nw_large=1, dz=8.0, dz_large=6.0,
+                          backend="tpu", converge=False)
+    assert "bem_device_s" in res and "bem_large_device_s" in res
+    assert res["bem_device_vs_cpu"] > 0
+    assert np.isfinite(res["bem_A_rel_err_device_vs_cpu"])
+    assert np.isfinite(res["bem_large_A_rel_err_device_vs_cpu"])
+
+
+def test_bench_bem_converge_unpack_arity(cpu_as_tpu, tmp_path):
+    """_bench_bem_converge consumes the REAL full_hull_convergence (on a
+    coarse synthetic spar written to disk), so any future change to the
+    helper's return arity fails here in tier-1 instead of as a lost
+    ``bem_error`` on the driver's TPU round."""
+    import json
+
+    design = deep_spar(n_cases=1)
+    design["platform"]["members"][0]["potMod"] = True
+    # numpy scalars -> plain floats so the design round-trips via YAML
+    design = json.loads(json.dumps(design, default=float))
+    path = tmp_path / "spar.yaml"
+    with open(path, "w") as fh:
+        yaml.safe_dump(design, fh, default_flow_style=None)
+    res = bench._bench_bem_converge("tpu", path=str(path),
+                                    sizes=(14.0, 12.0), nw=2)
+    assert res["bem_conv_nw"] == 2
+    assert len(res["bem_conv_panels"]) == 2
+    assert len(res["bem_conv_A_rel_max_by_dof"]) == 6
+    assert len(res["bem_conv_X_rel_max_surge_heave_pitch"]) == 3
+    assert isinstance(res["bem_conv_A_within_5pct"], bool)
+
+
+def test_blocked_gj_branch_forced_on_cpu(cpu_as_tpu):
+    """The real-block/blocked-GJ branch (padded N > 1024, 2N % 512 == 0)
+    solves cleanly on CPU and matches the plain complex-LU path — the
+    reproduction route the issue prescribes for TPU-only solve bugs."""
+    from raft_tpu.mesh import clip_waterplane, mesh_member
+
+    panels = clip_waterplane(mesh_member(
+        [0, 22], [6.5, 6.5], np.array([0.0, 0.0, -20.0]),
+        np.array([0.0, 0.0, 2.0]), 0.85, 0.85))
+    assert len(panels) > 1024          # forces the blocked-GJ solve
+    out_tpu_form = bem_solver.solve_bem(panels, [0.5], backend="tpu",
+                                        report_cost=True, n_devices=1)
+    assert out_tpu_form["npanels_solved"] > 1024
+    assert out_tpu_form.get("flops", 0.0) > 0.0
+    out_cpu = bem_solver.solve_bem(panels, [0.5], backend="cpu")
+    scale = float(np.abs(out_cpu["A"]).max())
+    assert np.abs(out_tpu_form["A"] - out_cpu["A"]).max() < 2e-4 * scale

@@ -1,0 +1,79 @@
+"""Bench budget hardening: the per-section SIGALRM watchdog must cut an
+overrunning section, record it as skipped, and still give every later
+section its slice — the failure mode being prevented is round 5's
+rc=124, where one section ate past the advisory budget until the
+external `timeout` killed the run with the driver line unprinted."""
+
+import json
+import time
+
+import bench
+
+
+def _run(sections, budget, tmp_path, cap=None):
+    out = {}
+    path = str(tmp_path / "out.json")
+    deadline = time.monotonic() + budget
+    bench.run_sections(sections, out, path, deadline, section_cap=cap)
+    with open(path) as fh:
+        assert json.load(fh) == json.loads(json.dumps(out))
+    return out
+
+
+def test_overrunning_section_is_cut_not_fatal(tmp_path):
+    calls = []
+
+    def slow():
+        calls.append("slow")
+        time.sleep(30.0)           # would eat the whole budget
+        return {"slow_done": True}
+
+    def fast():
+        calls.append("fast")
+        return {"fast_done": True}
+
+    # fair-share: slow's slice is half the budget, so fast still runs
+    out = _run([("slow", slow), ("fast", fast)], budget=3.0, tmp_path=tmp_path)
+    assert calls == ["slow", "fast"]
+    assert "slow_done" not in out
+    assert out["slow_error"].startswith("skipped: section watchdog")
+    # the later section still ran inside its own slice
+    assert out["fast_done"] is True
+    assert "fast_error" not in out
+    assert set(out["section_seconds"]) == {"slow", "fast"}
+
+
+def test_exhausted_budget_skips_before_start(tmp_path):
+    ran = []
+
+    def never():
+        ran.append(True)
+        return {}
+
+    out = _run([("late", never)], budget=-1.0, tmp_path=tmp_path)
+    assert not ran
+    assert out["late_error"] == "skipped: wall-clock budget exhausted"
+
+
+def test_section_cap_limits_even_with_budget_left(tmp_path):
+    def slow():
+        time.sleep(30.0)
+        return {"x": 1}
+
+    t0 = time.monotonic()
+    out = _run([("capped", slow)], budget=60.0, tmp_path=tmp_path, cap=1.0)
+    assert time.monotonic() - t0 < 10.0
+    assert out["capped_error"].startswith("skipped: section watchdog")
+
+
+def test_section_exception_recorded_and_run_continues(tmp_path):
+    def boom():
+        raise ValueError("too many values to unpack (expected 2)")
+
+    def fine():
+        return {"ok": 1}
+
+    out = _run([("boom", boom), ("fine", fine)], budget=30.0,
+               tmp_path=tmp_path)
+    assert out["boom_error"].startswith("ValueError")
+    assert out["ok"] == 1

@@ -5,6 +5,8 @@ The design YAML is read from the read-only reference mount — it is input
 data (the public OC3-Hywind spar description), not code.
 """
 
+import os
+
 import numpy as np
 import pytest
 import yaml
@@ -13,6 +15,13 @@ from raft_tpu.geometry import pack_nodes, process_members
 from raft_tpu.statics import compute_statics
 
 OC3 = "/root/reference/designs/OC3spar.yaml"
+
+if not os.path.exists(OC3):
+    # skip the whole module at collection when the read-only reference
+    # mount is absent (hosts without it used to report 7 standing
+    # errors from the fixture's FileNotFoundError instead of skips)
+    pytest.skip("reference design mount /root/reference absent",
+                allow_module_level=True)
 
 
 @pytest.fixture(scope="module")

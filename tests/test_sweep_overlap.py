@@ -1,0 +1,129 @@
+"""CPU/TPU stage overlap (PR-3 tentpole item 2): the fused sweep's
+aero-second -> dynamics hand-off split into double-buffered case chunks
+must reproduce the barrier path, fall back to a single dispatch when
+there is nothing to overlap, and record the stage timeline; the generic
+run_sweep driver's prep(k+1) || solve(k) software pipeline must be
+result-identical to the serial loop."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.designs import demo_semi, demo_semi_aero
+from raft_tpu.sweep_fused import (
+    _overlap_case_chunks,
+    run_draft_ballast_sweep,
+)
+
+
+def _aero_design(n_cases=4, n_wind=2):
+    d = demo_semi_aero(n_cases=n_cases, n_wind=n_wind,
+                       nw_settings=(0.05, 0.35))
+    d["settings"]["nIter"] = 10
+    return d
+
+
+def test_overlap_chunk_selection():
+    wind = np.array([0.0, 0.0, 8.0, 12.0])
+    # explicit overlap: calm chunk + two wind chunks
+    chunks = _overlap_case_chunks(wind, True, True, nd_aero=4)
+    assert [list(c) for c in chunks] == [[0, 1], [2], [3]]
+    # auto gate: tiny sweep stays on the barrier path
+    assert _overlap_case_chunks(wind, True, "auto", nd_aero=4) is None
+    # auto engages once the rotor stage is big enough to matter
+    assert _overlap_case_chunks(wind, True, "auto", nd_aero=256) is not None
+    # nothing to overlap: single case, aero off, or no wind cases
+    assert _overlap_case_chunks(np.array([8.0]), True, True, 256) is None
+    assert _overlap_case_chunks(wind, False, True, 256) is None
+    assert _overlap_case_chunks(np.zeros(4), True, True, 256) is None
+    # all-wind case table still split (no calm chunk)
+    chunks = _overlap_case_chunks(np.array([8.0, 10.0, 12.0]), True, True,
+                                  256)
+    assert [list(c) for c in chunks] == [[0, 1], [2]]
+
+
+def test_overlap_env_kill_switch(monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_NO_OVERLAP", "1")
+    wind = np.array([0.0, 8.0])
+    assert _overlap_case_chunks(wind, True, True, 1024) is None
+
+
+@pytest.mark.slow
+def test_fused_overlap_matches_barrier():
+    """Full aero-servo fused sweep, overlapped vs barrier: identical
+    metrics (the chunked dispatches solve the same per-case systems),
+    and the overlap run's timeline/telemetry recorded."""
+    base = _aero_design()
+    drafts, ballasts = [0.95, 1.05], [0.8, 1.2]
+    kw = dict(draft_group=1, verbose=False)
+    res_b = run_draft_ballast_sweep(base, drafts, ballasts,
+                                    overlap=False, **kw)
+    res_o = run_draft_ballast_sweep(base, drafts, ballasts,
+                                    overlap=True, **kw)
+
+    assert res_b["timing"]["overlap_chunks"] == 1
+    assert res_b["timing"]["overlap_saved_s"] == 0.0
+    assert res_o["timing"]["overlap_chunks"] == 3  # calm + 2 wind chunks
+    # rotor loads are per-lane independent: identical across chunkings
+    np.testing.assert_array_equal(res_o["F_aero0"], res_b["F_aero0"])
+    # dynamics chunks compile per case-count, so allow solver roundoff
+    np.testing.assert_allclose(res_o["std"], res_b["std"],
+                               rtol=2e-5, atol=1e-12)
+    np.testing.assert_array_equal(res_o["converged"], res_b["converged"])
+    np.testing.assert_allclose(res_o["Xi0"], res_b["Xi0"], rtol=1e-12)
+
+    # stage timeline: chunked rotor + dynamics spans recorded
+    tr = res_o["tracer"]
+    names = {s["name"] for s in tr.spans}
+    assert {"host_prep", "mooring", "aero_second", "dynamics"} <= names
+    dyn = [s for s in tr.spans if s["name"] == "dynamics"]
+    assert len(dyn) == 3
+    assert {s["chunk"] for s in dyn} == {0, 1, 2}
+
+    # guided-rotor telemetry: every lane accounted for
+    tel = res_o["rotor_telemetry"]
+    lanes = (tel["guided_lanes"] + tel["direct_fallback_lanes"]
+             + tel["small_batch_lanes"])
+    assert lanes == 4 * 2  # nd designs * n_wind cases (first pass excluded)
+    assert tel["rotor_host_devices"] >= 1
+
+
+@pytest.mark.slow
+def test_fused_single_case_bypasses_overlap():
+    """nc == 1 (one wind case): the barrier path must be used even when
+    overlap is requested."""
+    base = _aero_design(n_cases=1, n_wind=1)
+    res = run_draft_ballast_sweep(base, [1.0], [1.0], draft_group=1,
+                                  overlap=True, verbose=False)
+    assert res["timing"]["overlap_chunks"] == 1
+    assert res["timing"]["overlap_saved_s"] == 0.0
+    assert bool(np.all(res["converged"]))
+
+
+@pytest.mark.slow
+def test_run_sweep_pipelined_matches_serial(tmp_path):
+    """run_sweep with the prep/solve software pipeline on vs off: the
+    fetch/retry/collect tail is unchanged, so every result array must be
+    bit-identical, and checkpoints must land for every chunk."""
+    import os
+
+    from raft_tpu.sweep import run_sweep
+
+    base = demo_semi(n_cases=2, nw_settings=(0.05, 0.35))
+    base["settings"] = {"min_freq": 0.05, "max_freq": 0.35,
+                        "XiStart": 0.1, "nIter": 10}
+
+    def apply_point(design, point):
+        design["platform"]["members"][0]["d"] = [point["d"], point["d"]]
+        return design
+
+    points = [{"d": 9.5}, {"d": 10.0}, {"d": 10.5}]
+    res_s = run_sweep(base, points, apply_point, overlap=False,
+                      verbose=False)
+    out_dir = str(tmp_path / "ck")
+    res_p = run_sweep(base, points, apply_point, overlap=True,
+                      out_dir=out_dir, verbose=False)
+    for key in ("Xi", "converged", "iters", "mass", "GMT", "surge_std"):
+        np.testing.assert_array_equal(res_p[key], res_s[key])
+    n_dev = max(1, len(__import__("jax").devices()))
+    n_chunks = -(-len(points) // n_dev)
+    assert len(os.listdir(out_dir)) == n_chunks

@@ -1,0 +1,85 @@
+"""Span recorder (raft_tpu/trace.py): stage accounting, overlap math, and
+the chrome://tracing emission the sweep drivers dump via RAFT_TPU_TRACE."""
+
+import json
+
+import numpy as np
+import pytest
+
+from raft_tpu.trace import Tracer
+
+
+def _add(tr, name, t0, t1, backend="host", chunk=None):
+    """Inject a span with exact times (bypassing the clock)."""
+    tr.spans.append({"name": name, "backend": backend, "chunk": chunk,
+                     "t0": t0, "t1": t1, "meta": {}})
+
+
+def test_span_and_begin_end_record_durations():
+    tr = Tracer("test")
+    with tr.span("prep"):
+        pass
+    h = tr.begin("dynamics", backend="tpu", chunk=0)
+    dur = tr.end(h, lanes=4)
+    assert dur >= 0.0
+    names = [s["name"] for s in tr.spans]
+    assert names == ["prep", "dynamics"]
+    assert tr.spans[1]["backend"] == "tpu"
+    assert tr.spans[1]["meta"]["lanes"] == 4
+    secs = tr.stage_seconds()
+    assert set(secs) == {"prep", "dynamics"}
+    assert all(v >= 0.0 for v in secs.values())
+
+
+def test_overlap_accounting_exact():
+    """Two stages overlapping by 1 s: union wall 3 s, saved 1 s; the
+    barrier (sequential) layout saves exactly 0."""
+    tr = Tracer()
+    _add(tr, "rotor", 0.0, 2.0, backend="host", chunk=1)
+    _add(tr, "dynamics", 1.0, 3.0, backend="tpu", chunk=0)
+    assert tr.stage_wall("rotor", "dynamics") == pytest.approx(3.0)
+    assert tr.overlap_saved_s("rotor", "dynamics") == pytest.approx(1.0)
+    assert tr.stage_seconds() == pytest.approx(
+        {"rotor": 2.0, "dynamics": 2.0})
+
+    barrier = Tracer()
+    _add(barrier, "rotor", 0.0, 2.0)
+    _add(barrier, "dynamics", 2.0, 3.0)
+    assert barrier.overlap_saved_s("rotor", "dynamics") == pytest.approx(0.0)
+    # absent stages reduce to zero, not an error
+    assert barrier.stage_wall("nope") == 0.0
+    assert barrier.overlap_saved_s("nope") == 0.0
+
+
+def test_chrome_trace_schema_and_dump(tmp_path):
+    tr = Tracer("sweep")
+    _add(tr, "rotor", 0.0, 0.5, backend="host", chunk=2)
+    _add(tr, "dynamics", 0.25, 0.75, backend="tpu", chunk=2)
+    path = tr.dump(str(tmp_path / "trace.json"))
+    with open(path) as fh:
+        doc = json.load(fh)
+    events = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(events) == 2
+    # per-backend tracks, microsecond complete events, chunk in name+args
+    tids = {e["cat"]: e["tid"] for e in events}
+    assert len(set(tids.values())) == 2
+    ev = next(e for e in events if e["cat"] == "tpu")
+    assert ev["name"] == "dynamics[2]"
+    assert ev["ts"] == pytest.approx(0.25e6)
+    assert ev["dur"] == pytest.approx(0.5e6)
+    assert ev["args"]["chunk"] == 2
+    names = [e for e in doc["traceEvents"] if e["ph"] == "M"]
+    assert any(e["name"] == "process_name" for e in names)
+
+
+def test_env_dump(tmp_path, monkeypatch):
+    path = str(tmp_path / "env_trace.json")
+    tr = Tracer()
+    monkeypatch.delenv("RAFT_TPU_TRACE", raising=False)
+    assert tr.maybe_dump_env() is None
+    monkeypatch.setenv("RAFT_TPU_TRACE", path)
+    with tr.span("stage"):
+        np.zeros(3)
+    assert tr.maybe_dump_env() == path
+    with open(path) as fh:
+        assert json.load(fh)["traceEvents"]
