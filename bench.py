@@ -68,7 +68,8 @@ _COMPACT_KEYS = (
     "backend",
     "sweep_n_designs", "sweep_wall_s", "sweep_per_design_ms",
     "sweep_vs_baseline", "sweep_rao_linf_err", "sweep_converged_frac",
-    "sweep_rotor_stage_s", "sweep_overlap_saved_s", "sweep_host_devices",
+    "sweep_rotor_stage_s", "sweep_overlap_saved_s",
+    "sweep_overlap_cross_backend_s", "sweep_host_devices",
     "sweep243_vs_baseline", "sweep243_per_design_ms",
     "sweep1024_per_design_ms", "sweep4096_per_design_ms",
     "bem_panels", "bem_device_vs_cpu", "bem_large_panels",
@@ -77,8 +78,11 @@ _COMPACT_KEYS = (
     "bem_stream_A_within_5pct", "bem_stream_error",
     "bem_shard_devices", "bem_shard_speedup", "bem_shard_s",
     "grad_metrics", "grad_fd_rel_err",
+    "serve_p50_s", "serve_p95_s", "serve_occupancy_mean",
+    "serve_dispatches", "serve_requests", "serve_cold_vs_warm",
+    "serve_cold_first_s", "serve_warm_first_s",
     "rao_error", "sweep_error", "sweep243_error", "bem_error",
-    "bem_sharded_error", "grad_error",
+    "bem_sharded_error", "grad_error", "serve_error",
     "perf_docs_error", "sweep_scaling_error", "sweep1024_error",
     "sweep4096_error",
 )
@@ -164,7 +168,7 @@ def run_sections(sections, out, full_path, deadline, section_cap=None):
             cap = section_cap if cap is None else min(cap, section_cap)
         t_sec = time.monotonic()
         try:
-            with _watchdog(cap):
+            with _compile_watcher() as cw, _watchdog(cap):
                 out.update(fn() or {})
         except _SectionTimeout:
             out[f"{name}_error"] = (
@@ -173,8 +177,30 @@ def run_sections(sections, out, full_path, deadline, section_cap=None):
             out[f"{name}_error"] = f"{type(exc).__name__}: {exc}"
         out.setdefault("section_seconds", {})[name] = round(
             time.monotonic() - t_sec, 1)
+        # compile-time attribution per section (jax.monitoring counters):
+        # how much of the section's wall was XLA compilation, and whether
+        # the persistent on-disk cache served it — so warm-start claims
+        # (docs/performance.md §9) are recorded data, not reconciliation
+        if getattr(cw, "delta", None) is not None:
+            out[f"{name}_compile_s"] = round(
+                cw.delta["backend_compile_s"], 3)
+            out[f"{name}_persistent_cache_hit"] = bool(
+                cw.delta["persistent_cache_hits"] > 0)
         _write_full(out, full_path)
     return out
+
+
+def _compile_watcher():
+    """CompileWatcher when raft_tpu is importable; inert otherwise (the
+    --write-perf path must not need JAX)."""
+    try:
+        from raft_tpu.serve.cache import CompileWatcher
+
+        return CompileWatcher()
+    except Exception:  # pragma: no cover - defensive
+        import contextlib
+
+        return contextlib.nullcontext()
 
 
 def main(argv=None):
@@ -218,7 +244,8 @@ def main(argv=None):
     deadline = t0 + args.budget if args.budget > 0 else None
 
     if args.smoke:
-        sections = [("smoke", bench_smoke)]
+        sections = [("smoke", bench_smoke),
+                    ("serve_smoke", bench_serve_smoke)]
     else:
         import bench_sweep
 
@@ -229,20 +256,30 @@ def main(argv=None):
             # the enforced budget (per-design cost is constant, the
             # extrapolation is linear either way).  The third field is
             # the section's fair-share WEIGHT of the remaining budget
-            # (run_sections): sized from measured round-4/5 section
-            # costs so a generous budget runs everything while a tight
-            # one degrades section by section instead of losing the run.
+            # (run_sections), recalibrated from the RECORDED costs of
+            # the enforced-budget rounds (BENCH_FULL.json /
+            # BENCH_r03-r05 tails): rao ≈ 40 s incl. its 5.3 s NumPy
+            # baseline; sweep ≈ 310 s warm (50.4 s first run with a hot
+            # persistent cache, 8.3 s hot, 16-design baseline ≈ 245 s)
+            # and is the one section allowed to starve others when a
+            # cold cache pushes its first run toward the recorded
+            # 389 s; sweep243 ≈ 130 s (8-design baseline 115 s); the
+            # bem trio and grad were never fully recorded under the
+            # enforced budget (r04 bem_error, r05 rc=124), so their
+            # weights stay sized to the pre-budget estimates; serve is
+            # bounded by two CPU subprocesses plus one bucket compile.
             ("rao", bench_rao, 1.0),
             ("sweep", lambda: bench_sweep.run(baseline_limit=16,
-                                              verbose=False), 6.0),
+                                              verbose=False), 10.0),
             ("sweep_scaling", lambda: bench_sweep.run_scaling(
                 verbose=False), 1.5),
             ("sweep243", lambda: bench_sweep.run_geometry(
-                baseline_limit=8, verbose=False), 2.0),
+                baseline_limit=8, verbose=False), 4.0),
             ("bem", bench_bem, 3.0),
             ("bem_sharded", bench_bem_sharded, 0.5),
-            ("bem_stream", bench_bem_stream, 1.0),
-            ("grad", bench_gradients, 0.5),
+            ("bem_stream", bench_bem_stream, 1.5),
+            ("grad", bench_gradients, 1.0),
+            ("serve", bench_serve, 2.0),
         ]
 
     out = {}
@@ -576,6 +613,175 @@ def bench_gradients(params=(1, 3), eps=1e-4):
     }
 
 
+# ------------------------------------------------------------------ serve
+
+# Runs in a FRESH interpreter (cold vs warm restart must cross a process
+# boundary): warm the serve caches, then serve one first request and a
+# short steady stream, reporting the latencies.  CPU-pinned so the
+# subprocess never contends with the parent's TPU lock; the cache
+# mechanism being measured (persistent XLA cache + manifest warm-up +
+# serialized prep) is identical on every backend.
+_SERVE_PHASE_SCRIPT = """
+import sys, os, json, time
+sys.path.insert(0, os.environ["RAFT_TPU_BENCH_ROOT"])
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import raft_tpu
+from raft_tpu.designs import deep_spar
+from raft_tpu.serve import Engine, EngineConfig, warmup
+
+design = deep_spar(n_cases=4, nw_settings=(0.025, 0.6))
+phase = sys.argv[1]
+report = warmup(designs=[design] if phase == "cold" else None,
+                precision="float64",
+                cache_dir=os.environ["RAFT_TPU_CACHE_DIR"])
+eng = Engine(EngineConfig(precision="float64", window_ms=1.0,
+                          cache_dir=os.environ["RAFT_TPU_CACHE_DIR"]))
+t0 = time.perf_counter()
+res = eng.evaluate(design, timeout=560)
+t_first = time.perf_counter() - t0
+assert res.status == "ok", res.error
+steady = []
+for _ in range(5):
+    t0 = time.perf_counter(); eng.evaluate(design, timeout=560)
+    steady.append(time.perf_counter() - t0)
+eng.shutdown()
+print("RESULT " + json.dumps({
+    "first_s": t_first, "steady_s": float(np.median(steady)),
+    "warmup_wall_s": report["wall_s"],
+    "warmup_cache_hits": report["persistent_cache_hits"],
+}))
+"""
+
+
+def _serve_phase(phase, cache_dir):
+    import subprocess
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(
+            "w", suffix=".py", delete=False) as fh:
+        fh.write(_SERVE_PHASE_SCRIPT)
+        script = fh.name
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    env["RAFT_TPU_CACHE_DIR"] = cache_dir
+    env["RAFT_TPU_BENCH_ROOT"] = _ROOT
+    try:
+        proc = subprocess.run(
+            [sys.executable, script, phase], capture_output=True,
+            text=True, timeout=560, env=env)
+        line = [ln for ln in proc.stdout.splitlines()
+                if ln.startswith("RESULT ")]
+        if proc.returncode != 0 or not line:
+            raise RuntimeError(
+                f"serve {phase} phase failed: {proc.stderr[-800:]}")
+        return json.loads(line[-1][len("RESULT "):])
+    finally:
+        os.unlink(script)
+
+
+def bench_serve(n_requests=8, n_cases=6):
+    """The serving engine figures: request-latency percentiles and batch
+    occupancy of an in-process stream on the current backend, plus the
+    cold-vs-warm restart pair across fresh CPU interpreters (the compile/
+    warm-up cache layer's acceptance figure)."""
+    import tempfile
+
+    from __graft_entry__ import _flagship_design
+    from raft_tpu.serve import Engine, EngineConfig
+
+    # ---- in-process stream: one design family, distinct case tables
+    # per request (prep differs, bucket shared -> dispatches coalesce)
+    design = _flagship_design(0.025, 0.8, n_cases)     # 32 freq bins
+    keys = design["cases"]["keys"]
+    with tempfile.TemporaryDirectory() as tmp:
+        eng = Engine(EngineConfig(window_ms=25.0, cache_dir=tmp))
+        t0 = time.perf_counter()
+        first = eng.evaluate(design, timeout=560)   # cold in-process
+        t_first = time.perf_counter() - t0
+        assert first.status == "ok", first.error
+        variants = []
+        for r in range(n_requests):
+            rows = []
+            for row in design["cases"]["data"]:
+                d = dict(zip(keys, row))
+                d["wave_height"] = float(d["wave_height"]) + 0.05 * r
+                rows.append(d)
+            variants.append(rows)
+        handles = [eng.submit(design, cases=v) for v in variants]
+        results = [h.result(timeout=560) for h in handles]
+        snap = eng.snapshot()
+        eng.shutdown()
+    assert all(r.status == "ok" for r in results)
+    lat = np.array([r.latency_s for r in results])   # steady stream only
+    out = {
+        "serve_requests": snap["requests"],
+        "serve_dispatches": snap["dispatches"],
+        "serve_n_cases": n_cases,
+        "serve_first_result_s": round(t_first, 3),
+        "serve_p50_s": round(float(np.percentile(lat, 50)), 4),
+        "serve_p95_s": round(float(np.percentile(lat, 95)), 4),
+        "serve_occupancy_mean": round(float(np.mean(
+            [r.batch_occupancy for r in results])), 3),
+        "serve_batch_requests_mean": round(float(np.mean(
+            [r.batch_requests for r in results])), 2),
+        "serve_bucket_compiles": snap["bucket_compiles"],
+    }
+
+    # ---- cold vs warm restart across fresh interpreters (CPU) ----
+    with tempfile.TemporaryDirectory() as cache_dir:
+        cold = _serve_phase("cold", cache_dir)
+        warm = _serve_phase("warm", cache_dir)
+    out.update({
+        "serve_cold_first_s": round(cold["first_s"], 3),
+        "serve_warm_first_s": round(warm["first_s"], 3),
+        "serve_warm_steady_s": round(warm["steady_s"], 4),
+        "serve_warm_cache_hits": warm["warmup_cache_hits"],
+        "serve_cold_vs_warm": round(
+            cold["first_s"] / max(warm["first_s"], 1e-9), 1),
+        "serve_warm_first_vs_steady": round(
+            warm["first_s"] / max(warm["steady_s"], 1e-9), 2),
+    })
+    return out
+
+
+def bench_serve_smoke(n_requests=3):
+    """Tier-1-safe serve smoke: a tiny engine round-trip (mixed buckets,
+    batched dispatch, bit-parity summary stats) in seconds — a broken
+    serving engine is caught by `bench.py --smoke` in CI, not by a lost
+    driver round."""
+    import tempfile
+
+    from raft_tpu.designs import deep_spar
+    from raft_tpu.serve import Engine, EngineConfig
+
+    t0 = time.perf_counter()
+    designs = []
+    for i in range(n_requests):
+        d = deep_spar(n_cases=2, nw_settings=(0.05, 0.5))
+        d["platform"]["members"][0]["rho_fill"] = [1700.0 + 50.0 * i,
+                                                   0.0, 0.0]
+        designs.append(d)
+    with tempfile.TemporaryDirectory() as tmp:
+        eng = Engine(EngineConfig(precision="float64", window_ms=50.0,
+                                  cache_dir=tmp))
+        results = [h.result(timeout=400)
+                   for h in [eng.submit(d) for d in designs]]
+        snap = eng.snapshot()
+        eng.shutdown()
+    assert all(r.status == "ok" for r in results)
+    assert snap["dispatches"] < snap["requests"]
+    return {
+        "smoke_serve_requests": snap["requests"],
+        "smoke_serve_dispatches": snap["dispatches"],
+        "smoke_serve_occupancy": round(snap["occupancy_mean"], 3),
+        "smoke_serve_s": round(time.perf_counter() - t0, 3),
+    }
+
+
 # --------------------------------------------------------------- perf docs
 
 def compact_results(out):
@@ -610,14 +816,23 @@ def perf_md_text(d):
         row("sweep RAO L∞ parity vs the serial path",
             _fmt(d.get("sweep_rao_linf_err", float("nan"))))
     if "sweep_rotor_stage_s" in d:
-        row(
-            "heterogeneous overlap: host-sharded rotor ∥ async device "
-            "dynamics",
+        cell = (
             f"rotor stage {_fmt(d['sweep_rotor_stage_s'])} s on "
             f"{d.get('sweep_host_devices', '?')} host device(s), "
             f"{_fmt(d.get('sweep_overlap_saved_s', 0.0))} s hidden by "
             f"overlap across {d.get('sweep_overlap_chunks', '?')} "
-            "case chunk(s)",
+            "case chunk(s)"
+        )
+        if "sweep_overlap_cross_backend_s" in d:
+            cell += (
+                f" ({_fmt(d['sweep_overlap_cross_backend_s'])} s "
+                "genuinely CPU∥device, "
+                f"{_fmt(d.get('sweep_overlap_within_backend_s', 0.0))} s "
+                "among same-backend async chunks)"
+            )
+        row(
+            "heterogeneous overlap: host-sharded rotor ∥ async device "
+            "dynamics", cell,
         )
     if "sweep_rotor_telemetry" in d:
         t = d["sweep_rotor_telemetry"]
@@ -698,6 +913,25 @@ def perf_md_text(d):
             f"{d.get('grad_metrics', '?')} metrics × "
             f"{d.get('grad_params_checked', '?')} parameter columns "
             "(all 4 columns in tests/test_parametric.py)")
+    if "serve_p50_s" in d:
+        row(
+            f"**request serving: {d.get('serve_requests')} requests "
+            f"coalesced into {d.get('serve_dispatches')} bucket "
+            "dispatches**",
+            f"**p50 {_fmt(1e3 * d['serve_p50_s'], 1)} ms / p95 "
+            f"{_fmt(1e3 * d.get('serve_p95_s', 0.0), 1)} ms per request, "
+            f"batch occupancy {_fmt(d.get('serve_occupancy_mean', 0.0))}**",
+        )
+    if "serve_cold_vs_warm" in d:
+        row(
+            "serve cold vs warm restart (first request, fresh process)",
+            f"cold {_fmt(d.get('serve_cold_first_s'))} s → warm "
+            f"{_fmt(d.get('serve_warm_first_s'))} s "
+            f"(**{_fmt(d['serve_cold_vs_warm'], 1)}×**; warm first "
+            "request "
+            f"{_fmt(d.get('serve_warm_first_vs_steady', 0.0))}× its "
+            "steady-state latency)",
+        )
 
     lines = [
         "# PERF — measured numbers (generated)",

@@ -1,12 +1,25 @@
-"""Command-line entry point: ``python -m raft_tpu design.yaml [options]``
-(the reference's ``python raft_model.py`` __main__ path,
-reference raft/raft_model.py:1140-1147, as a proper CLI)."""
+"""Command-line entry point.
+
+``python -m raft_tpu design.yaml [options]`` — one-shot full analysis
+(the reference's ``python raft_model.py`` __main__ path, reference
+raft/raft_model.py:1140-1147, as a proper CLI).
+
+``python -m raft_tpu warmup [design.yaml ...]`` — ahead-of-time compile
+warm-up of the serving buckets (manifest-driven; see docs/serving.md).
+
+``python -m raft_tpu serve [design.yaml ...]`` — long-lived serving
+engine reading JSON-line requests from stdin and writing JSON-line
+results to stdout.
+"""
 
 import argparse
+import json
 import sys
 
+import numpy as np
 
-def main(argv=None):
+
+def _analyze_main(argv):
     p = argparse.ArgumentParser(
         prog="raft_tpu",
         description="Frequency-domain FOWT analysis (TPU-native RAFT)",
@@ -32,6 +45,125 @@ def main(argv=None):
         precision=args.precision, run_native_bem=args.bem,
         device=args.device,
     )
+
+
+def _serve_parser(prog, description):
+    p = argparse.ArgumentParser(prog=prog, description=description)
+    p.add_argument("designs", nargs="*",
+                   help="design YAML paths to seed/warm buckets from")
+    p.add_argument("--precision", choices=["float32", "float64"],
+                   default=None)
+    p.add_argument("--device", choices=["tpu", "cpu", "gpu"], default=None)
+    p.add_argument("--cache-dir", default=None,
+                   help="serve cache base (default: RAFT_TPU_CACHE_DIR / "
+                        "the persistent XLA cache dir)")
+    return p
+
+
+def _warmup_main(argv):
+    p = _serve_parser(
+        "raft_tpu warmup",
+        "AOT-compile the serving buckets recorded in the warm-up "
+        "manifest (plus any designs given), through the persistent "
+        "XLA compilation cache.")
+    args = p.parse_args(argv)
+
+    from raft_tpu.io.schema import load_design
+    from raft_tpu.serve import warmup
+
+    designs = [load_design(path) for path in args.designs]
+    report = warmup(designs=designs or None, precision=args.precision,
+                    cache_dir=args.cache_dir)
+    print(json.dumps(report))
+
+
+def _serve_main(argv):
+    p = _serve_parser(
+        "raft_tpu serve",
+        "Long-lived serving engine: JSON-line requests on stdin "
+        '({"design": "path.yaml", "cases": [...], "deadline_s": 10}), '
+        "JSON-line results on stdout.")
+    p.add_argument("--window-ms", type=float, default=None,
+                   help="micro-batching window (default "
+                        "RAFT_TPU_SERVE_WINDOW_MS or 5 ms)")
+    p.add_argument("--no-warmup", action="store_true",
+                   help="skip the manifest warm-up at startup")
+    p.add_argument("--xi", action="store_true",
+                   help="include the full complex response amplitudes "
+                        "in each result line")
+    args = p.parse_args(argv)
+
+    from raft_tpu.io.schema import load_design
+    from raft_tpu.serve import Engine, EngineConfig, warmup
+
+    cfg = EngineConfig(precision=args.precision, device=args.device,
+                       cache_dir=args.cache_dir)
+    if args.window_ms is not None:
+        cfg.window_ms = args.window_ms
+    designs = [load_design(path) for path in args.designs]
+    if not args.no_warmup:
+        warmup(designs=designs or None, precision=args.precision,
+               cache_dir=args.cache_dir)
+    with Engine(cfg) as eng:
+        print(json.dumps({"event": "ready",
+                          **{k: v for k, v in eng.snapshot().items()
+                             if not isinstance(v, (list, dict))}}),
+              flush=True)
+        pending = []
+        for line in sys.stdin:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                req = json.loads(line)
+                design = req["design"]
+                if isinstance(design, str):
+                    design = load_design(design)
+                pending.append(eng.submit(
+                    design, cases=req.get("cases"),
+                    deadline_s=req.get("deadline_s")))
+            except Exception as e:  # noqa: BLE001 — bad line, keep serving
+                print(json.dumps({"event": "error",
+                                  "error": f"{type(e).__name__}: {e}"}),
+                      flush=True)
+                continue
+            # drain results in submission order as they complete
+            while pending and pending[0].done():
+                _emit_result(pending.pop(0).result(0), args.xi)
+        for h in pending:
+            _emit_result(h.result(600), args.xi)
+        print(json.dumps({"event": "shutdown", **{
+            k: v for k, v in eng.snapshot().items()
+            if not isinstance(v, (list, dict))}}), flush=True)
+
+
+def _emit_result(res, include_xi=False):
+    doc = {
+        "event": "result", "rid": res.rid, "status": res.status,
+        "latency_s": round(res.latency_s, 4),
+        "batch_requests": res.batch_requests,
+        "batch_occupancy": round(res.batch_occupancy, 3),
+    }
+    if res.error:
+        doc["error"] = res.error
+    if res.status == "ok":
+        doc["std"] = res.std.tolist()
+        rep = res.solve_report
+        doc["converged"] = np.asarray(rep["converged"]).tolist()
+        doc["nonfinite"] = np.asarray(rep["nonfinite"]).tolist()
+        if include_xi:
+            doc["Xi_re"] = res.Xi.real.tolist()
+            doc["Xi_im"] = res.Xi.imag.tolist()
+    print(json.dumps(doc), flush=True)
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "warmup":
+        return _warmup_main(argv[1:])
+    if argv and argv[0] == "serve":
+        return _serve_main(argv[1:])
+    return _analyze_main(argv)
 
 
 if __name__ == "__main__":

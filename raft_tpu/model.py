@@ -165,9 +165,21 @@ class Model:
         ``Model(design, device='cpu')`` on a TPU host runs an f64 CPU
         solve and ``device='tpu'`` runs the f32 TPU graph.  Host-side
         stages (statics, mooring, rotor BEM) always run f64 on CPU.
+    slots : raft_tpu.serve.buckets.BucketSpec | None
+        Canonical serving bucket: when given, ``analyze_cases`` pads its
+        dynamics dispatch (nodes zero-padded, cases packed into lanes) and
+        runs the serving engine's fixed-shape slot executable for that
+        bucket instead of compiling a per-design-shape pipeline.  Results
+        are then bit-identical to the same request served by
+        ``raft_tpu.serve.Engine`` in any megabatch of the bucket (same
+        compiled program, per-lane-independent arithmetic — see
+        docs/serving.md).  None (default) keeps the exact-shape pipeline,
+        whose differently-shaped program may differ from the served path
+        by float-reassociation noise.
     """
 
-    def __init__(self, design, nTurbines=1, precision=None, device=None):
+    def __init__(self, design, nTurbines=1, precision=None, device=None,
+                 slots=None):
         if not isinstance(design, dict):
             design = load_design(design)
         self.design = design
@@ -232,6 +244,7 @@ class Model:
         self.dtype = np.float32 if precision == "float32" else np.float64
         self.cdtype = np.complex64 if precision == "float32" else np.complex128
 
+        self.slots = slots
         self.statics = None
         self._ICG_turbine = None
         self.results = {}
@@ -674,22 +687,34 @@ class Model:
         nLines = T_moor.shape[-1] // 2
 
         # ---- the batched device solve ----
-        if self._pipeline is None:
-            with timer("pipeline_compile"):
-                self._pipeline = self._build_pipeline()
-        with timer("rao_solve"), tracer.span(
-                "dynamics", backend=jax.default_backend()):
-            if self._sharding is not None:
-                # committed inputs pin the jitted graph to the requested
-                # backend (jit follows input placement)
-                dev_args = tuple(
-                    jax.device_put(np.asarray(a), self._sharding)
-                    for a in args
-                )
-            else:
-                dev_args = tuple(jnp.asarray(a) for a in args)
-            xr, xi, report = self._pipeline(*dev_args)
-            jax.block_until_ready(xr)
+        if self.slots is not None:
+            # serving-bucket mode: the dispatch runs the canonical
+            # fixed-shape slot executable of this bucket, shared with the
+            # raft_tpu.serve engine — results bit-identical to the same
+            # request served in any megabatch of the bucket
+            from raft_tpu.serve.buckets import slotted_case_dispatch
+
+            with timer("rao_solve"), tracer.span(
+                    "dynamics", backend=jax.default_backend()):
+                xr, xi, report = slotted_case_dispatch(
+                    self, self.slots, args)
+        else:
+            if self._pipeline is None:
+                with timer("pipeline_compile"):
+                    self._pipeline = self._build_pipeline()
+            with timer("rao_solve"), tracer.span(
+                    "dynamics", backend=jax.default_backend()):
+                if self._sharding is not None:
+                    # committed inputs pin the jitted graph to the
+                    # requested backend (jit follows input placement)
+                    dev_args = tuple(
+                        jax.device_put(np.asarray(a), self._sharding)
+                        for a in args
+                    )
+                else:
+                    dev_args = tuple(jnp.asarray(a) for a in args)
+                xr, xi, report = self._pipeline(*dev_args)
+                jax.block_until_ready(xr)
         Xi = np.asarray(xr, np.float64) + 1j * np.asarray(xi, np.float64)  # [case,6,nw]
         self.Xi = Xi
         self.zeta = zeta

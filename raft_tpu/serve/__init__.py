@@ -1,0 +1,51 @@
+"""raft_tpu.serve — request-serving engine over the batched case solve.
+
+The batch entry points (Model.analyze_cases, the sweep drivers) evaluate
+one design or one pre-assembled sweep per process invocation; a serving
+deployment instead sees a *stream* of independent design-evaluation
+requests and must answer each at interactive latency.  This subsystem
+provides the three layers that turn the existing solve stack into that
+long-lived engine:
+
+ - **shape buckets** (:mod:`raft_tpu.serve.buckets`): every request is
+   padded into one of a small set of canonical fixed shapes
+   (frequency-grid length, node count, flattened case-slot capacity), so
+   the whole deployment runs a handful of compiled executables — the
+   fixed-shape trick that keeps sharded rotor lanes bit-identical (PR 3)
+   applied to the serving batch axis;
+ - a **dynamic micro-batcher** (:mod:`raft_tpu.serve.engine`): queued
+   requests coalesce per bucket inside a bounded batching window into one
+   padded megabatch dispatch, with per-request fault isolation and the
+   solver-health reports (raft_tpu/health.py) routed back per request;
+ - a **warm-up/compile cache** (:mod:`raft_tpu.serve.cache`): a manifest
+   of observed buckets keyed on (backend, shapes, flags, code version)
+   drives ahead-of-time ``jit(...).lower().compile()`` warm-up through
+   JAX's persistent compilation cache, and host-side preparation
+   artifacts are serialized per design, so a restarted server answers its
+   first request at warm-path latency.
+
+Entry points: ``python -m raft_tpu serve|warmup`` (CLI) and the
+in-process :class:`Engine` API used by tests and ``bench.py``.
+Design document: docs/serving.md.
+"""
+
+from raft_tpu.serve.buckets import (  # noqa: F401
+    BucketSpec,
+    SlotPhysics,
+    choose_bucket,
+    slot_pipeline,
+    slotted_case_dispatch,
+)
+from raft_tpu.serve.cache import (  # noqa: F401
+    CompileWatcher,
+    PrepCache,
+    WarmupManifest,
+    serve_cache_dir,
+    warmup,
+)
+from raft_tpu.serve.engine import (  # noqa: F401
+    Engine,
+    EngineConfig,
+    Request,
+    RequestResult,
+)

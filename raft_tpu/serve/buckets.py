@@ -1,0 +1,320 @@
+"""Shape buckets and the canonical slot pipeline for the serving engine.
+
+A serving deployment must not compile per request: XLA recompilation is
+the 389-second wall between a cold process and its first answer
+(BENCH_FULL.json: 389.4 s cold vs 8.3 s warm for the same sweep).  TPU
+scientific frameworks amortize that cost by running a small set of
+ahead-of-time compiled, fixed-shape programs and batching work into them
+(arXiv:2108.11076); this module defines those programs for the case
+dynamics solve.
+
+A **bucket** is a canonical program shape: ``(nw, n_nodes, n_slots)`` —
+the frequency-grid length, the zero-padded strip-node count, and the
+flattened (request x case) lane capacity.  The slot pipeline for a bucket
+is ``jit(vmap(one_case))`` with EVERY operand batched over the slot axis,
+including the node bundle, so lanes of different designs coexist in one
+dispatch.
+
+Bit-identity is the load-bearing property (the same fixed-shape trick
+that keeps PR 3's sharded rotor lanes bit-identical): within ONE compiled
+executable a lane's result depends only on that lane's inputs — vmapped
+lanes are data-independent, and the drag-linearization ``while_loop``
+freezes converged lanes per-lane under JAX's batched-cond semantics — so
+a request evaluated alone and the same request coalesced into a full
+megabatch produce identical bits.  ``Model(design, slots=spec)`` routes
+the unbatched ``analyze_cases`` dispatch through the same executable,
+which is what makes "served == direct" an equality, not a tolerance.
+(Programs of *different* shapes do drift: XLA's shape-dependent fusion
+re-associates reductions by ~1 ulp, and the fixed point's 1% stopping
+test can amplify that to ~1e-4 — measured; hence canonical shapes, not
+per-request shapes.)
+"""
+
+import dataclasses
+from functools import lru_cache
+from typing import NamedTuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.geometry import HydroNodes
+from raft_tpu.model import make_case_dynamics
+
+# float fields of HydroNodes by rank (node axis leading); masks are bool
+_NODE_FIELD_SHAPES = {
+    "r": (3,), "q": (3,),
+    "qMat": (3, 3), "p1Mat": (3, 3), "p2Mat": (3, 3),
+}
+_NODE_BOOL_FIELDS = ("submerged", "strip_mask")
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketSpec:
+    """Canonical program shape of one serving bucket.
+
+    nw      : frequency-grid length (exact — never padded: the fixed
+              point couples frequencies through the drag-RMS integrals,
+              so a padded grid would change the physics)
+    n_nodes : strip-node count, zero-padded (inert by construction, same
+              padding contract as sweep.pad_and_stack_nodes)
+    n_slots : flattened (request x case) lane capacity of one dispatch
+    """
+
+    nw: int
+    n_nodes: int
+    n_slots: int
+
+    def as_dict(self):
+        return dataclasses.asdict(self)
+
+
+class SlotPhysics(NamedTuple):
+    """The scalars (and frequency grid) baked into a slot executable as
+    compile-time constants — everything :func:`make_case_dynamics` closes
+    over.  Hashable so it keys the module-level pipeline cache, and
+    JSON-serializable (via :meth:`as_dict`) so the warm-up manifest can
+    rebuild the executable in a fresh process without a design file."""
+
+    w_bytes: bytes
+    k_bytes: bytes
+    nw: int
+    depth: float
+    rho: float
+    g: float
+    XiStart: float
+    nIter: int
+    dtype_name: str
+    cdtype_name: str
+
+    @classmethod
+    def from_model(cls, model):
+        return cls(
+            w_bytes=np.asarray(model.w, np.float64).tobytes(),
+            k_bytes=np.asarray(model.k, np.float64).tobytes(),
+            nw=int(model.nw),
+            depth=float(model.depth),
+            rho=float(model.rho_water),
+            g=float(model.g),
+            XiStart=float(model.XiStart),
+            nIter=int(model.nIter),
+            dtype_name=np.dtype(model.dtype).name,
+            cdtype_name=np.dtype(model.cdtype).name,
+        )
+
+    def as_dict(self):
+        d = self._asdict()
+        d["w"] = np.frombuffer(self.w_bytes, np.float64).tolist()
+        d["k"] = np.frombuffer(self.k_bytes, np.float64).tolist()
+        del d["w_bytes"], d["k_bytes"]
+        return d
+
+    @classmethod
+    def from_dict(cls, d):
+        d = dict(d)
+        w = np.asarray(d.pop("w"), np.float64)
+        k = np.asarray(d.pop("k"), np.float64)
+        return cls(w_bytes=w.tobytes(), k_bytes=k.tobytes(), **d)
+
+
+@lru_cache(maxsize=32)
+def _slot_pipeline_cached(physics, checkable=False):
+    """The canonical slot executable family for one physics
+    configuration: ``jit(vmap(one_case))`` with nodes batched per lane.
+    Shapes are bound at call/lower time, so one cached jit serves every
+    bucket of this physics; XLA's jit cache (and the persistent on-disk
+    compilation cache) key the per-shape executables."""
+    w = np.frombuffer(physics.w_bytes, np.float64, count=physics.nw)
+    k = np.frombuffer(physics.k_bytes, np.float64, count=physics.nw)
+    dtype = np.dtype(physics.dtype_name).type
+    cdtype = np.dtype(physics.cdtype_name).type
+    one_case = make_case_dynamics(
+        w, k, physics.depth, physics.rho, physics.g, physics.XiStart,
+        physics.nIter, dtype, cdtype, checkable=checkable,
+    )
+    return jax.jit(jax.vmap(one_case))
+
+
+def slot_pipeline(physics, checkable=False):
+    """Public accessor for the cached slot executable family."""
+    return _slot_pipeline_cached(physics, bool(checkable))
+
+
+# ------------------------------------------------------------------ shapes
+
+def _ceil_to(n, q):
+    return int(-(-int(n) // int(q)) * int(q))
+
+
+def choose_bucket(nw, n_nodes, n_cases, node_quantum=32,
+                  slot_ladder=(8, 16, 32, 64, 128), coalesce=2):
+    """Pick the canonical bucket for a request shape.
+
+    node_quantum : node counts round up to this multiple, so designs of
+        one family (whose re-discretized node counts wobble by a few)
+        share an executable.  The padding is inert (zero strip volumes,
+        False masks).
+    slot_ladder : allowed lane capacities.  The chosen capacity is the
+        smallest ladder entry holding ``coalesce`` requests of this case
+        count (at least one), so the micro-batcher has headroom to
+        coalesce before a new shape would be needed.
+    """
+    n_nodes_b = _ceil_to(max(n_nodes, 1), node_quantum)
+    want = max(int(n_cases), 1) * max(int(coalesce), 1)
+    for L in slot_ladder:
+        if L >= want:
+            return BucketSpec(int(nw), n_nodes_b, int(L))
+    if slot_ladder[-1] >= n_cases:
+        return BucketSpec(int(nw), n_nodes_b, int(slot_ladder[-1]))
+    return BucketSpec(int(nw), n_nodes_b, _ceil_to(n_cases,
+                                                   slot_ladder[0]))
+
+
+def pad_nodes(nodes, n_nodes):
+    """Zero-pad a HydroNodes bundle's node axis to ``n_nodes`` (same
+    inert-padding contract as sweep.pad_and_stack_nodes: zero volumes/
+    areas and False masks contribute exactly nothing)."""
+    N = nodes.r.shape[0]
+    if N == n_nodes:
+        return nodes
+    if N > n_nodes:
+        raise ValueError(
+            f"design has {N} strip nodes > bucket n_nodes={n_nodes}")
+    pad = n_nodes - N
+    out = {}
+    for f in dataclasses.fields(HydroNodes):
+        a = getattr(nodes, f.name)
+        out[f.name] = np.concatenate(
+            [a, np.zeros((pad,) + a.shape[1:], a.dtype)], axis=0)
+    return HydroNodes(**out)
+
+
+def _stack_nodes(nodes_list):
+    return HydroNodes(**{
+        f.name: np.stack([getattr(n, f.name) for n in nodes_list])
+        for f in dataclasses.fields(HydroNodes)
+    })
+
+
+def pack_slots(entries, spec):
+    """Pack prepared requests into one bucket megabatch.
+
+    entries : list of ``(nodes, args)`` per request — ``nodes`` a
+        HydroNodes bundle already cast to the working dtype, ``args`` the
+        7-tuple from ``Model.prepare_case_inputs`` with leading [nc].
+    Returns ``(nodes_slots, args_slots, slot_ranges)``: the [n_slots]
+    stacked operands and per-request ``(start, stop)`` lane ranges.
+
+    Padding lanes replicate the first real lane — always-finite work that
+    converges with the batch (vmap freezing keeps real lanes exact
+    regardless), and whose results are dropped at unpack.
+    """
+    total = sum(e[1][0].shape[0] for e in entries)
+    if total > spec.n_slots:
+        raise ValueError(
+            f"pack_slots: {total} case lanes exceed bucket capacity "
+            f"{spec.n_slots}")
+    nodes_slots, args_cols = [], [[] for _ in range(7)]
+    slot_ranges, cursor = [], 0
+    for nodes, args in entries:
+        nc = args[0].shape[0]
+        padded = pad_nodes(nodes, spec.n_nodes)
+        nodes_slots.extend([padded] * nc)
+        for j in range(7):
+            args_cols[j].append(np.asarray(args[j]))
+        slot_ranges.append((cursor, cursor + nc))
+        cursor += nc
+    for j in range(7):
+        args_cols[j] = np.concatenate(args_cols[j], axis=0)
+    pad = spec.n_slots - cursor
+    if pad:
+        nodes_slots.extend([nodes_slots[0]] * pad)
+        for j in range(7):
+            fill = np.repeat(args_cols[j][:1], pad, axis=0)
+            args_cols[j] = np.concatenate([args_cols[j], fill], axis=0)
+    return _stack_nodes(nodes_slots), tuple(args_cols), slot_ranges
+
+
+def dispatch_slots(physics, spec, nodes_slots, args_slots, sharding=None,
+                   checkable=False):
+    """Run one bucket megabatch through the canonical executable.
+    Returns the raw [n_slots] device outputs (callers unpack by slot
+    range).  ``sharding`` optionally commits the operands to a backend
+    (the Model(device=...) path)."""
+    fn = slot_pipeline(physics, checkable)
+    if sharding is not None:
+        put = lambda a: jax.device_put(np.asarray(a), sharding)  # noqa: E731
+    else:
+        put = jnp.asarray
+    nodes_dev = jax.tree.map(put, nodes_slots)
+    dev_args = tuple(put(a) for a in args_slots)
+    out = fn(nodes_dev, *dev_args)
+    jax.block_until_ready(out[0])
+    return out
+
+
+def slotted_case_dispatch(model, spec, args):
+    """The single-request path: dispatch one Model's prepared case inputs
+    through its bucket's canonical executable (what ``Model(design,
+    slots=spec)`` routes ``analyze_cases`` to).  Returns
+    ``(xr[nc], xi[nc], report[nc])`` exactly like the un-bucketed
+    pipeline — and bit-identical to the same request served inside any
+    engine megabatch of this bucket, because it IS the same executable."""
+    from raft_tpu.health import apply_debug_nans
+
+    nc = args[0].shape[0]
+    if spec.nw != model.nw:
+        raise ValueError(
+            f"bucket nw={spec.nw} != model nw={model.nw} (frequency grids "
+            "never pad; pick the bucket with choose_bucket)")
+    if nc > spec.n_slots:
+        raise ValueError(
+            f"{nc} cases exceed bucket capacity n_slots={spec.n_slots}")
+    physics = SlotPhysics.from_model(model)
+    nodes = model.nodes.astype(model.dtype)
+    nodes_slots, args_slots, ranges = pack_slots([(nodes, args)], spec)
+    xr, xi, report = dispatch_slots(
+        physics, spec, nodes_slots, args_slots,
+        sharding=model._sharding, checkable=apply_debug_nans(),
+    )
+    a, b = ranges[0]
+    take = lambda arr: np.asarray(arr)[a:b]  # noqa: E731
+    return take(xr), take(xi), jax.tree.map(take, report)
+
+
+def bucket_avals(physics, spec):
+    """ShapeDtypeStruct avals of one bucket's operands — what AOT warm-up
+    lowers against (no real data needed)."""
+    L, N, nw = spec.n_slots, spec.n_nodes, spec.nw
+    dtype = np.dtype(physics.dtype_name)
+    s = jax.ShapeDtypeStruct
+    nfields = {}
+    for f in dataclasses.fields(HydroNodes):
+        if f.name in _NODE_BOOL_FIELDS:
+            nfields[f.name] = s((L, N), np.bool_)
+        else:
+            tail = _NODE_FIELD_SHAPES.get(f.name, ())
+            nfields[f.name] = s((L, N) + tail, dtype)
+    nodes = HydroNodes(**nfields)
+    args = (
+        s((L, nw), dtype),             # zeta
+        s((L,), dtype),                # beta
+        s((L, 6, 6), dtype),           # C_lin
+        s((L, nw, 6, 6), dtype),       # M_lin
+        s((L, nw, 6, 6), dtype),       # B_lin
+        s((L, nw, 6), dtype),          # F_add_r
+        s((L, nw, 6), dtype),          # F_add_i
+    )
+    return nodes, args
+
+
+def compile_bucket(physics, spec, checkable=False):
+    """AOT-compile one bucket's executable (``jit(...).lower().compile()``)
+    against its avals.  With the persistent compilation cache configured
+    (raft_tpu/__init__.py), the compiled artifact lands on disk and a
+    fresh process re-running this call retrieves it instead of
+    recompiling — the warm-restart mechanism of the serve cache layer."""
+    fn = slot_pipeline(physics, checkable)
+    nodes, args = bucket_avals(physics, spec)
+    return fn.lower(nodes, *args).compile()

@@ -1,0 +1,443 @@
+"""Warm-up / compile-cache layer for the serving engine.
+
+The cold-start problem this solves is measured, not hypothetical:
+BENCH_FULL.json records 389.4 s for the first (compiling) run of a sweep
+whose warm re-run takes 8.3 s — nearly the entire cost of a fresh process
+is XLA recompilation of programs that were already compiled yesterday.
+Three mechanisms close the gap:
+
+ 1. **The persistent XLA compilation cache** (wired in raft_tpu/__init__,
+    ``RAFT_TPU_CACHE_DIR``): compiled executables land on disk.  The serve
+    layer drops the min-compile-time threshold to zero while serving, so
+    even fast CPU compiles persist.
+ 2. **A warm-up manifest** (this module): a JSON record of every bucket
+    the deployment has served — the canonical shapes plus the physics
+    scalars and frequency grid the executable bakes in as constants —
+    keyed on ``(backend, x64 flag, working dtype, code version)``.
+    ``warmup()`` replays the manifest through
+    ``jit(...).lower().compile()``: in a fresh process each compile is
+    answered from the persistent cache (counted via ``jax.monitoring``
+    events), then executed once on padding lanes so the first real
+    request pays no allocator/dispatch warm-up either.  An entry whose
+    recorded flags do not match the running process is REFUSED with a
+    logged reason — a stale executable family (different x64 mode,
+    different code version) must never be claimed warm.
+ 3. **A host-prep cache**: the per-design host-side preparation (geometry
+    packing, statics, mooring equilibrium, aero means — everything
+    ``Model.prepare_case_inputs`` produces) serialized per design hash,
+    so a restarted server also skips the f64 CPU setup for designs it has
+    seen.  Entries embed the same flag key and are ignored on mismatch.
+
+Invalidation rules are documented in docs/serving.md.
+"""
+
+import dataclasses
+import hashlib
+import json
+import os
+import threading
+import time
+from zipfile import BadZipFile
+
+import numpy as np
+
+import jax
+
+from raft_tpu.geometry import HydroNodes
+from raft_tpu.serve.buckets import (
+    BucketSpec,
+    SlotPhysics,
+    bucket_avals,
+    compile_bucket,
+    slot_pipeline,
+)
+from raft_tpu.utils.profiling import logger
+
+MANIFEST_NAME = "serve_manifest.json"
+
+# ------------------------------------------------------------- monitoring
+# One module-level listener pair accumulates JAX's compile/cache events;
+# CompileWatcher snapshots the counters around a region.  (Listeners are
+# process-global and cannot be individually unregistered, hence the
+# accumulate-and-snapshot structure.)
+
+_counters = {
+    "backend_compile_s": 0.0,
+    "backend_compiles": 0,
+    "persistent_cache_hits": 0,
+    "cache_requests": 0,
+}
+_counters_lock = threading.Lock()
+_listeners_installed = [False]
+
+
+def _on_event(name, **kw):
+    with _counters_lock:
+        if name == "/jax/compilation_cache/cache_hits":
+            _counters["persistent_cache_hits"] += 1
+        elif name == "/jax/compilation_cache/compile_requests_use_cache":
+            _counters["cache_requests"] += 1
+
+
+def _on_duration(name, secs, **kw):
+    if name == "/jax/core/compile/backend_compile_duration":
+        with _counters_lock:
+            _counters["backend_compile_s"] += float(secs)
+            _counters["backend_compiles"] += 1
+
+
+def install_compile_listeners():
+    """Idempotently register the jax.monitoring listeners that feed
+    :class:`CompileWatcher` (and bench.py's per-section compile
+    accounting).  jax._src.monitoring is a private surface: failure to
+    register degrades to zero counters, never breaks serving."""
+    if _listeners_installed[0]:
+        return
+    try:
+        from jax._src import monitoring
+
+        monitoring.register_event_listener(_on_event)
+        monitoring.register_event_duration_secs_listener(_on_duration)
+    except (ImportError, AttributeError) as e:  # pragma: no cover
+        logger.warning("serve: compile counters unavailable (%s)", e)
+    _listeners_installed[0] = True
+
+
+def compile_counters():
+    with _counters_lock:
+        return dict(_counters)
+
+
+class CompileWatcher:
+    """Snapshot the compile/cache counters around a region::
+
+        with CompileWatcher() as w:
+            fn.lower(...).compile()
+        w.delta  # {"backend_compile_s", "backend_compiles",
+                 #  "persistent_cache_hits", "cache_requests"}
+
+    ``backend_compile_duration`` fires on every compile *request* (it
+    wraps the compile-or-get-cached call), so "served from the persistent
+    cache" is ``persistent_cache_hits > 0``, not ``backend_compiles ==
+    0``.
+    """
+
+    def __enter__(self):
+        install_compile_listeners()
+        self._t0 = time.perf_counter()
+        self._before = compile_counters()
+        return self
+
+    def __exit__(self, *exc):
+        after = compile_counters()
+        self.delta = {k: after[k] - self._before[k] for k in after}
+        self.wall_s = time.perf_counter() - self._t0
+        return False
+
+
+# ------------------------------------------------------------- cache dirs
+
+def serve_cache_dir(override=None):
+    """Directory for serve artifacts (manifest + prep cache), colocated
+    with the persistent XLA compilation cache so one ``RAFT_TPU_CACHE_DIR``
+    governs both.  Falls back to ~/.cache/raft_tpu_serve when no
+    compilation cache is configured (read-only home, opt-out)."""
+    base = (
+        override
+        or os.environ.get("RAFT_TPU_CACHE_DIR")
+        or jax.config.jax_compilation_cache_dir
+        or os.path.expanduser("~/.cache/raft_tpu_serve")
+    )
+    path = os.path.join(base, "serve")
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+def persist_all_compiles():
+    """Drop the persistent-cache admission thresholds so every executable
+    the serving process compiles lands on disk (the package default only
+    persists compiles over 2 s — fine for batch TPU work, wrong for a
+    server whose CPU buckets compile in fractions of that)."""
+    if jax.config.jax_compilation_cache_dir:
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+
+# ----------------------------------------------------------- flags / keys
+
+_CODE_VERSION_MODULES = (
+    "raft_tpu.dynamics", "raft_tpu.hydro", "raft_tpu.waves",
+    "raft_tpu.geometry", "raft_tpu.model", "raft_tpu.serve.buckets",
+)
+
+
+def code_version():
+    """Hash of the source files whose changes invalidate compiled bucket
+    executables and prep artifacts.  Part of every manifest/prep key, so
+    a code upgrade refuses stale caches instead of serving them."""
+    import importlib
+
+    h = hashlib.sha256()
+    for name in _CODE_VERSION_MODULES:
+        mod = importlib.import_module(name)
+        with open(mod.__file__, "rb") as fh:
+            h.update(fh.read())
+    return h.hexdigest()[:12]
+
+
+def current_flags():
+    """The executable-compatibility key of the running process."""
+    return {
+        "backend": jax.default_backend(),
+        "x64": bool(jax.config.jax_enable_x64),
+        "jax": jax.__version__,
+        "code_version": code_version(),
+    }
+
+
+def flags_mismatch(entry_flags, flags=None):
+    """Human-readable reason an entry's flags refuse reuse, or None."""
+    flags = flags or current_flags()
+    for key in ("backend", "x64", "code_version", "jax"):
+        if entry_flags.get(key) != flags.get(key):
+            return (f"{key}={entry_flags.get(key)!r} recorded but "
+                    f"{flags.get(key)!r} running")
+    return None
+
+
+def design_prep_key(design, cases, precision):
+    """Prep-cache key: the full design + case table + working precision +
+    code version (host prep is code-version sensitive too)."""
+    payload = json.dumps([design, cases, precision], sort_keys=True,
+                         default=float)
+    h = hashlib.sha256(payload.encode())
+    h.update(code_version().encode())
+    return h.hexdigest()[:24]
+
+
+# --------------------------------------------------------------- manifest
+
+class WarmupManifest:
+    """The on-disk record of buckets to warm: one JSON file, atomically
+    rewritten, holding ``{"spec", "physics", "flags", "created"}``
+    entries.  Entries are deduplicated on (spec, physics, backend, x64,
+    dtype); flags decide reuse at warm-up time."""
+
+    def __init__(self, path=None, cache_dir=None):
+        self.path = path or os.path.join(
+            serve_cache_dir(cache_dir), MANIFEST_NAME)
+        self._lock = threading.Lock()
+
+    def load(self):
+        try:
+            with open(self.path) as fh:
+                doc = json.load(fh)
+            return doc.get("entries", [])
+        except (OSError, ValueError):
+            return []
+
+    def _entry_key(self, entry):
+        f = entry.get("flags", {})
+        return json.dumps(
+            [entry.get("spec"), entry.get("physics"),
+             f.get("backend"), f.get("x64")], sort_keys=True)
+
+    def record(self, physics, spec, flags=None):
+        """Add (or refresh) one bucket entry; returns True when the
+        manifest changed."""
+        entry = {
+            "spec": spec.as_dict(),
+            "physics": physics.as_dict(),
+            "flags": flags or current_flags(),
+            "created": time.time(),
+        }
+        with self._lock:
+            entries = self.load()
+            key = self._entry_key(entry)
+            fresh = [e for e in entries if self._entry_key(e) != key]
+            changed = len(fresh) == len(entries)
+            fresh.append(entry)
+            tmp = f"{self.path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as fh:
+                json.dump({"entries": fresh}, fh, indent=1)
+            os.replace(tmp, self.path)
+        return changed
+
+
+def warmup(manifest=None, designs=None, cases=None, precision=None,
+           cache_dir=None, execute=True):
+    """Ahead-of-time warm-up of every admissible bucket executable.
+
+    manifest : WarmupManifest | path | None — the bucket record to replay
+        (default: the serve cache dir's manifest).
+    designs : optional design dicts to seed buckets from directly (each
+        is recorded into the manifest as a side effect) — how a fresh
+        deployment warms before its first request.
+    execute : also run each warmed executable once on padding lanes, so
+        the first real dispatch pays no allocator/transfer warm-up.
+
+    Returns a report dict: per-bucket compile seconds and persistent-
+    cache hit counts, plus the REFUSED entries with their mismatch
+    reasons (stale flags never warm silently).
+    """
+    from raft_tpu.model import Model
+
+    persist_all_compiles()
+    install_compile_listeners()
+    if manifest is None or isinstance(manifest, str):
+        manifest = WarmupManifest(manifest, cache_dir=cache_dir)
+    flags = current_flags()
+
+    jobs = []
+    for design in designs or []:
+        model = Model(design, precision=precision)
+        from raft_tpu.serve.buckets import choose_bucket
+
+        case_rows = cases
+        if case_rows is None:
+            from raft_tpu.io.schema import cases_as_dicts
+
+            case_rows = cases_as_dicts(model.design)
+        spec = choose_bucket(
+            model.nw, model.nodes.r.shape[0], len(case_rows))
+        physics = SlotPhysics.from_model(model)
+        manifest.record(physics, spec, flags)
+        jobs.append((physics, spec))
+
+    rejected = []
+    for entry in manifest.load():
+        reason = flags_mismatch(entry.get("flags", {}), flags)
+        if reason:
+            rejected.append({"spec": entry.get("spec"), "reason": reason})
+            logger.warning(
+                "serve warmup: manifest entry refused (%s); it will be "
+                "recompiled when its bucket is next served", reason)
+            continue
+        physics = SlotPhysics.from_dict(entry["physics"])
+        spec = BucketSpec(**entry["spec"])
+        if precision is not None and physics.dtype_name != precision:
+            continue   # an explicit precision narrows what we warm
+        if (physics, spec) not in jobs:
+            jobs.append((physics, spec))
+
+    warmed = []
+    t0 = time.perf_counter()
+    for physics, spec in jobs:
+        with CompileWatcher() as w:
+            if execute:
+                # drive the jit wrapper itself (trace + compile-or-fetch
+                # + one execution on padding lanes): the engine's first
+                # real dispatch then finds jit's in-memory executable
+                # cache hot, not just the on-disk artifact
+                _execute_padding(physics, spec)
+            else:
+                compile_bucket(physics, spec)
+        warmed.append({
+            "spec": spec.as_dict(),
+            "compile_s": round(w.wall_s, 3),
+            "backend_compile_s": round(w.delta["backend_compile_s"], 3),
+            "persistent_cache_hits": w.delta["persistent_cache_hits"],
+        })
+    report = {
+        "flags": flags,
+        "manifest": manifest.path,
+        "warmed": warmed,
+        "rejected": rejected,
+        "n_warmed": len(warmed),
+        "n_rejected": len(rejected),
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "persistent_cache_hits": sum(
+            e["persistent_cache_hits"] for e in warmed),
+    }
+    return report
+
+
+def _execute_padding(physics, spec):
+    """One jit-path execution on always-finite padding lanes (zeta=0, a
+    positive-definite system): traces, compiles (or fetches from the
+    persistent cache), and runs the bucket executable — so the first real
+    request pays neither compilation nor allocator/dispatch warm-up."""
+    nodes_av, args_av = bucket_avals(physics, spec)
+    dtype = np.dtype(physics.dtype_name)
+    nodes = HydroNodes(**{
+        f.name: np.zeros(getattr(nodes_av, f.name).shape,
+                         getattr(nodes_av, f.name).dtype)
+        for f in dataclasses.fields(HydroNodes)
+    })
+    w = np.frombuffer(physics.w_bytes, np.float64, count=physics.nw)
+    c0 = 1.0 + float(np.max(w)) ** 2        # C - w^2 M stays PD
+    args = []
+    for i, av in enumerate(args_av):
+        a = np.zeros(av.shape, av.dtype)
+        if i == 2:
+            a = a + c0 * np.eye(6, dtype=dtype)
+        elif i == 3:
+            a = a + np.eye(6, dtype=dtype)
+        args.append(a)
+    out = slot_pipeline(physics)(nodes, *args)
+    jax.block_until_ready(out[0])
+
+
+# -------------------------------------------------------------- prep cache
+
+class PrepCache:
+    """Serialized host-side preparation per design: the HydroNodes bundle
+    and the 7 prepared case-input arrays (plus the physics scalars), as
+    one .npz per design hash.  A restarted server loads these instead of
+    re-running geometry/statics/mooring/aero — and because the stored
+    arrays are the exact bits process 1 computed, the served response is
+    unchanged across the restart."""
+
+    def __init__(self, cache_dir=None):
+        self.dir = os.path.join(serve_cache_dir(cache_dir), "prep")
+        os.makedirs(self.dir, exist_ok=True)
+
+    def _path(self, key):
+        return os.path.join(self.dir, f"prep_{key}.npz")
+
+    def save(self, key, nodes, args, physics):
+        payload = {f"node_{f.name}": getattr(nodes, f.name)
+                   for f in dataclasses.fields(HydroNodes)}
+        for i, a in enumerate(args):
+            payload[f"arg_{i}"] = np.asarray(a)
+        payload["meta"] = np.array(json.dumps({
+            "physics": physics.as_dict(),
+            "flags": current_flags(),
+            "created": time.time(),
+        }))
+        tmp = self._path(key) + f".tmp.{os.getpid()}"
+        np.savez(tmp, **payload)
+        # np.savez appends .npz to the tmp name
+        os.replace(tmp + ".npz", self._path(key))
+
+    def load(self, key):
+        """-> (nodes, args, physics) or None (absent/corrupt/stale)."""
+        path = self._path(key)
+        if not os.path.exists(path):
+            return None
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                meta = json.loads(str(z["meta"]))
+                reason = flags_mismatch(meta.get("flags", {}))
+                if reason:
+                    logger.warning(
+                        "serve prep cache: entry %s refused (%s)",
+                        key, reason)
+                    return None
+                nodes = HydroNodes(**{
+                    f.name: z[f"node_{f.name}"]
+                    for f in dataclasses.fields(HydroNodes)
+                })
+                args = tuple(z[f"arg_{i}"] for i in range(7))
+                physics = SlotPhysics.from_dict(meta["physics"])
+            return nodes, args, physics
+        except (OSError, ValueError, KeyError, BadZipFile) as e:
+            # np.load raises zipfile.BadZipFile on truncated archives
+            logger.warning(
+                "serve prep cache: deleting unreadable entry %s (%s: %s)",
+                key, type(e).__name__, e)
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            return None

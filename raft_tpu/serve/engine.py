@@ -1,0 +1,462 @@
+"""The dynamic micro-batching engine: a long-lived request server over
+the batched case solve.
+
+Requests (design dict + cases + optional deadline) enter a queue; a
+single batcher thread coalesces them per shape bucket inside a bounded
+batching window and dispatches each bucket group as ONE padded megabatch
+through the canonical slot executable (raft_tpu/serve/buckets.py).  The
+differentiable-BEM serving assumption (arXiv:2501.06988) — a long-lived
+solver process amortizing setup across many queries — is realized by
+three caches: the per-bucket compiled executables (persistent across
+restarts via the warm-up manifest, raft_tpu/serve/cache.py), the
+in-process prep memo, and the on-disk prep cache.
+
+Fault isolation, per request:
+ - a request whose HOST-SIDE preparation raises (bad geometry, mooring
+   equilibrium failure) fails alone — its result carries the error and
+   its batch-mates dispatch normally (the sweep drivers' quarantine
+   contract, raft_tpu/health.py);
+ - a request whose lanes go NON-FINITE in-graph is frozen by the
+   dynamics NaN quarantine and reported through its own SolveReport
+   slice; neighboring lanes are bit-unaffected (vmap lanes are
+   data-independent — asserted in tests/test_serve.py);
+ - a request whose deadline expires before its batch flushes is REJECTED
+   without dispatch (admission control; docs/serving.md).
+"""
+
+import dataclasses
+import os
+import threading
+import time
+from collections import OrderedDict
+
+import numpy as np
+
+import jax
+
+from raft_tpu.health import log_report, report_dict
+from raft_tpu.serve.buckets import (
+    SlotPhysics,
+    choose_bucket,
+    dispatch_slots,
+    pack_slots,
+)
+from raft_tpu.serve.cache import (
+    CompileWatcher,
+    PrepCache,
+    WarmupManifest,
+    design_prep_key,
+    install_compile_listeners,
+    persist_all_compiles,
+    warmup,
+)
+from raft_tpu.utils.profiling import logger
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    """Engine knobs (env defaults; see docs/usage.md env table).
+
+    window_ms : micro-batching window — how long a freshly arrived
+        request may wait for bucket-mates before its batch flushes.
+        Latency floor vs batch occupancy knob.
+    node_quantum / slot_ladder / coalesce : bucket quantization
+        (buckets.choose_bucket).
+    """
+
+    precision: str = None
+    device: str = None
+    window_ms: float = dataclasses.field(
+        default_factory=lambda: _env_float("RAFT_TPU_SERVE_WINDOW_MS", 5.0))
+    node_quantum: int = dataclasses.field(
+        default_factory=lambda: int(
+            _env_float("RAFT_TPU_SERVE_NODE_QUANTUM", 32)))
+    slot_ladder: tuple = (8, 16, 32, 64, 128)
+    coalesce: int = 2
+    use_prep_cache: bool = True
+    warm_on_start: bool = False
+    record_manifest: bool = True
+    cache_dir: str = None
+
+
+@dataclasses.dataclass
+class Request:
+    """One design-evaluation request."""
+
+    design: dict
+    cases: list = None          # None -> the design's cases table
+    deadline_s: float = None    # relative to submit; None = no deadline
+    rid: int = 0
+    t_submit: float = 0.0
+
+
+@dataclasses.dataclass
+class RequestResult:
+    """Per-request outcome.  ``status``:
+    'ok' — solved (check ``solve_report`` for per-case health);
+    'failed' — host-side preparation raised (``error``);
+    'rejected_deadline' — admission control dropped it before dispatch.
+    """
+
+    rid: int
+    status: str
+    error: str = None
+    Xi: np.ndarray = None            # [nc, 6, nw] complex
+    std: np.ndarray = None           # [nc, 6]
+    solve_report: dict = None        # per-case health arrays
+    bucket: object = None            # BucketSpec served under
+    latency_s: float = 0.0           # submit -> result
+    queue_s: float = 0.0             # submit -> dispatch start
+    batch_requests: int = 0          # requests coalesced in the dispatch
+    batch_occupancy: float = 0.0     # real lanes / bucket slots
+
+    @property
+    def ok(self):
+        return self.status == "ok"
+
+
+class _Pending:
+    """Submit handle: ``result(timeout)`` blocks for the RequestResult."""
+
+    def __init__(self, rid):
+        self.rid = rid
+        self._event = threading.Event()
+        self._result = None
+
+    def _set(self, result):
+        self._result = result
+        self._event.set()
+
+    def done(self):
+        return self._event.is_set()
+
+    def result(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise TimeoutError(f"request {self.rid} still pending")
+        return self._result
+
+
+class _Prepped:
+    """Host-side preparation of one design: everything a dispatch lane
+    needs (nodes in working dtype, the 7 case-input arrays, physics key,
+    bucket)."""
+
+    __slots__ = ("nodes", "args", "physics", "spec", "nc", "dw")
+
+    def __init__(self, nodes, args, physics, spec, dw):
+        self.nodes = nodes
+        self.args = args
+        self.physics = physics
+        self.spec = spec
+        self.nc = args[0].shape[0]
+        self.dw = dw
+
+
+class Engine:
+    """Long-lived serving engine.  Thread-safe ``submit``; a single
+    batcher thread owns batching, dispatch, and result delivery.
+
+    >>> eng = Engine()
+    >>> handle = eng.submit(design)
+    >>> res = handle.result(timeout=300)
+    >>> res.Xi.shape     # [ncase, 6, nw]
+    """
+
+    def __init__(self, config=None, **overrides):
+        self.config = config or EngineConfig(**overrides)
+        install_compile_listeners()
+        persist_all_compiles()
+        self._queue = []                       # [(Request, _Pending, _Prepped|Exception)]
+        self._lock = threading.Lock()
+        self._wake = threading.Condition(self._lock)
+        self._stop = False
+        self._rid = 0
+        self._prep_memo = OrderedDict()        # design key -> _Prepped
+        self._prep_memo_cap = 128
+        self._prep_lock = threading.Lock()     # batcher + bucket_for callers
+        self._prep_cache = (PrepCache(self.config.cache_dir)
+                            if self.config.use_prep_cache else None)
+        self._manifest = (WarmupManifest(cache_dir=self.config.cache_dir)
+                          if self.config.record_manifest else None)
+        self.stats = {
+            "requests": 0, "dispatches": 0, "failed": 0,
+            "rejected_deadline": 0, "latency_s": [], "occupancy": [],
+            "batch_requests": [], "prep_cache_hits": 0,
+            "prep_memo_hits": 0, "bucket_compiles": [],
+            "first_result_s": None, "warmup": None,
+        }
+        self._t_start = time.perf_counter()
+        if self.config.warm_on_start:
+            self.stats["warmup"] = warmup(
+                manifest=self._manifest, precision=self.config.precision,
+                cache_dir=self.config.cache_dir)
+        self._thread = threading.Thread(
+            target=self._run, name="raft-serve-batcher", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------- client
+
+    def submit(self, design, cases=None, deadline_s=None):
+        """Enqueue one request; returns a handle with ``result(timeout)``."""
+        with self._lock:
+            if self._stop:
+                raise RuntimeError("engine is shut down")
+            self._rid += 1
+            req = Request(design=design, cases=cases,
+                          deadline_s=deadline_s, rid=self._rid,
+                          t_submit=time.perf_counter())
+            pend = _Pending(req.rid)
+            self._queue.append((req, pend))
+            self.stats["requests"] += 1
+            self._wake.notify()
+        return pend
+
+    def evaluate(self, design, cases=None, timeout=600.0):
+        """Synchronous convenience: submit + wait."""
+        return self.submit(design, cases).result(timeout)
+
+    def bucket_for(self, design, cases=None):
+        """The bucket a request for this design will serve under (used by
+        tests and by callers who want the matching direct
+        ``Model(design, slots=...)``)."""
+        prepped = self._prepare(Request(design=design, cases=cases))
+        return prepped.spec
+
+    def shutdown(self, wait=True):
+        with self._lock:
+            self._stop = True
+            self._wake.notify()
+        if wait:
+            self._thread.join(timeout=30)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+    # ------------------------------------------------------------ batcher
+
+    def _run(self):
+        while True:
+            with self._lock:
+                while not self._queue and not self._stop:
+                    self._wake.wait()
+                if self._stop and not self._queue:
+                    return
+                t_first = min(r.t_submit for r, _ in self._queue)
+            # batching window: wait out the remainder, bounded by the
+            # earliest deadline in the queue
+            window = self.config.window_ms / 1e3
+            while True:
+                with self._lock:
+                    if self._stop:
+                        break
+                    now = time.perf_counter()
+                    remaining = (t_first + window) - now
+                    deadlines = [
+                        r.t_submit + r.deadline_s
+                        for r, _ in self._queue if r.deadline_s
+                    ]
+                    if deadlines:
+                        remaining = min(
+                            remaining, min(deadlines) - now)
+                if remaining <= 0:
+                    break
+                time.sleep(min(remaining, 0.25 * window + 1e-4))
+            with self._lock:
+                batch = self._queue
+                self._queue = []
+            if batch:
+                try:
+                    self._serve_batch(batch)
+                except Exception:  # pragma: no cover — keep the thread up
+                    logger.exception("serve batcher: batch failed")
+                    for req, pend in batch:
+                        if not pend.done():
+                            pend._set(RequestResult(
+                                rid=req.rid, status="failed",
+                                error="internal batcher error"))
+
+    # ------------------------------------------------------------- prep
+
+    def _prepare(self, req):
+        """Host-side prep with the three-level cache (in-process memo ->
+        on-disk prep cache -> full Model build)."""
+        from raft_tpu.model import Model
+
+        key = design_prep_key(req.design, req.cases,
+                              self.config.precision)
+        with self._prep_lock:
+            memo = self._prep_memo.get(key)
+            if memo is not None:
+                self._prep_memo.move_to_end(key)
+                self.stats["prep_memo_hits"] += 1
+                return memo
+
+        prepped = None
+        if self._prep_cache is not None:
+            hit = self._prep_cache.load(key)
+            if hit is not None:
+                nodes, args, physics = hit
+                w = np.frombuffer(physics.w_bytes, np.float64,
+                                  count=physics.nw)
+                spec = choose_bucket(
+                    physics.nw, nodes.r.shape[0], args[0].shape[0],
+                    node_quantum=self.config.node_quantum,
+                    slot_ladder=self.config.slot_ladder,
+                    coalesce=self.config.coalesce)
+                prepped = _Prepped(nodes, args, physics, spec,
+                                   float(w[1] - w[0]))
+                self.stats["prep_cache_hits"] += 1
+
+        if prepped is None:
+            model = Model(req.design, precision=self.config.precision,
+                          device=self.config.device)
+            model.analyze_unloaded()
+            args, _aux = model.prepare_case_inputs(
+                cases=req.cases, verbose=False)
+            physics = SlotPhysics.from_model(model)
+            nodes = model.nodes.astype(model.dtype)
+            spec = choose_bucket(
+                model.nw, nodes.r.shape[0], args[0].shape[0],
+                node_quantum=self.config.node_quantum,
+                slot_ladder=self.config.slot_ladder,
+                coalesce=self.config.coalesce)
+            prepped = _Prepped(nodes, args, physics, spec,
+                               float(model.dw))
+            if self._prep_cache is not None:
+                try:
+                    self._prep_cache.save(key, nodes, args, physics)
+                except OSError as e:
+                    logger.warning("serve prep cache write failed: %s", e)
+            if self._manifest is not None:
+                self._manifest.record(physics, prepped.spec)
+
+        with self._prep_lock:
+            self._prep_memo[key] = prepped
+            while len(self._prep_memo) > self._prep_memo_cap:
+                self._prep_memo.popitem(last=False)
+        return prepped
+
+    # ----------------------------------------------------------- dispatch
+
+    def _serve_batch(self, batch):
+        now = time.perf_counter()
+        groups = OrderedDict()   # (physics, spec) -> [(req, pend, prepped)]
+        for req, pend in batch:
+            # deadline admission: reject before paying prep/dispatch
+            if (req.deadline_s is not None
+                    and now > req.t_submit + req.deadline_s):
+                self.stats["rejected_deadline"] += 1
+                pend._set(RequestResult(
+                    rid=req.rid, status="rejected_deadline",
+                    error=f"deadline {req.deadline_s}s expired in queue",
+                    latency_s=now - req.t_submit))
+                continue
+            try:
+                prepped = self._prepare(req)
+            except Exception as e:  # noqa: BLE001 — quarantine prep faults
+                self.stats["failed"] += 1
+                logger.warning(
+                    "serve request %d quarantined: prep raised (%s: %s)",
+                    req.rid, type(e).__name__, e)
+                pend._set(RequestResult(
+                    rid=req.rid, status="failed",
+                    error=f"{type(e).__name__}: {e}",
+                    latency_s=time.perf_counter() - req.t_submit))
+                continue
+            groups.setdefault((prepped.physics, prepped.spec), []) \
+                  .append((req, pend, prepped))
+
+        for (physics, spec), members in groups.items():
+            # fill dispatches FIFO up to the bucket's slot capacity
+            cursor = 0
+            while cursor < len(members):
+                take, lanes = [], 0
+                while cursor < len(members):
+                    nc = members[cursor][2].nc
+                    if take and lanes + nc > spec.n_slots:
+                        break
+                    take.append(members[cursor])
+                    lanes += nc
+                    cursor += 1
+                self._dispatch_group(physics, spec, take, lanes)
+
+    def _dispatch_group(self, physics, spec, members, lanes):
+        t0 = time.perf_counter()
+        entries = [(p.nodes, p.args) for _, _, p in members]
+        with CompileWatcher() as w:
+            nodes_s, args_s, ranges = pack_slots(entries, spec)
+            sharding = None
+            if self.config.device is not None:
+                from raft_tpu.utils.placement import backend_sharding
+
+                sharding = backend_sharding(self.config.device)
+            xr, xi, report = dispatch_slots(
+                physics, spec, nodes_s, args_s, sharding=sharding)
+        if w.delta["backend_compiles"] or w.delta["persistent_cache_hits"]:
+            self.stats["bucket_compiles"].append({
+                "spec": spec.as_dict(),
+                "compile_s": round(w.delta["backend_compile_s"], 3),
+                "persistent_cache_hits":
+                    w.delta["persistent_cache_hits"],
+            })
+        xr = np.asarray(xr)
+        xi = np.asarray(xi)
+        occupancy = lanes / spec.n_slots
+        self.stats["dispatches"] += 1
+        self.stats["occupancy"].append(occupancy)
+        self.stats["batch_requests"].append(len(members))
+        t_done = time.perf_counter()
+        for (req, pend, prepped), (a, b) in zip(members, ranges):
+            Xi = xr[a:b] + 1j * xi[a:b]
+            rep = jax.tree.map(lambda arr: np.asarray(arr)[a:b], report)
+            log_report(rep, label=f"serve request {req.rid} case",
+                       log=logger)
+            std = np.sqrt(
+                np.sum(xr[a:b] ** 2 + xi[a:b] ** 2, axis=-1) * prepped.dw)
+            latency = t_done - req.t_submit
+            self.stats["latency_s"].append(latency)
+            if self.stats["first_result_s"] is None:
+                self.stats["first_result_s"] = latency
+            pend._set(RequestResult(
+                rid=req.rid, status="ok", Xi=Xi, std=std,
+                solve_report=report_dict(rep), bucket=spec,
+                latency_s=latency, queue_s=t0 - req.t_submit,
+                batch_requests=len(members),
+                batch_occupancy=occupancy))
+
+    # -------------------------------------------------------------- stats
+
+    def snapshot(self):
+        """Flat stats summary (bench.py's serve section reads this)."""
+        lat = np.asarray(self.stats["latency_s"], float)
+        occ = np.asarray(self.stats["occupancy"], float)
+        out = {
+            "requests": self.stats["requests"],
+            "dispatches": self.stats["dispatches"],
+            "failed": self.stats["failed"],
+            "rejected_deadline": self.stats["rejected_deadline"],
+            "prep_cache_hits": self.stats["prep_cache_hits"],
+            "prep_memo_hits": self.stats["prep_memo_hits"],
+            "first_result_s": self.stats["first_result_s"],
+            "bucket_compiles": self.stats["bucket_compiles"],
+            "warmup": self.stats["warmup"],
+        }
+        if len(lat):
+            out["latency_p50_s"] = float(np.percentile(lat, 50))
+            out["latency_p95_s"] = float(np.percentile(lat, 95))
+        if len(occ):
+            out["occupancy_mean"] = float(occ.mean())
+            out["batch_requests_mean"] = float(
+                np.mean(self.stats["batch_requests"]))
+        return out

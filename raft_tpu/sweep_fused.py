@@ -644,12 +644,22 @@ def _chunked_aero_dynamics(model0, cases, wind, aero_on, pitch_mean,
             label, int(retry_mask.sum()), n_rec,
         )
 
+    # overlap accounting: the union-vs-sum savings PLUS its per-backend
+    # decomposition (cross_backend_s = seconds the CPU rotor stage and the
+    # device dynamics were simultaneously busy; within_backend_s = extra
+    # concurrency among same-backend spans, e.g. double-buffered async
+    # dynamics chunks in flight together — the two used to be conflated
+    # in overlap_saved_s, ROADMAP open item)
+    decomp = tracer.overlap_backend_decomposition("aero_second", "dynamics")
     timing = {
         "aero_second_s": t_rotor,
         "dynamics_first_s": tracer.stage_wall("dynamics"),
         "overlap_chunks": len(chunks),
         "overlap_saved_s": tracer.overlap_saved_s(
             "aero_second", "dynamics"),
+        "overlap_cross_backend_s": decomp["cross_backend_s"],
+        "overlap_within_backend_s": sum(
+            decomp["within_backend_s"].values()),
         "rotor_dyn_wall_s": t_engine,
     }
     return sol, a_hub, b_hub, F_aero2, telemetry, timing, dyn_flops

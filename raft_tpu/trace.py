@@ -117,6 +117,70 @@ class Tracer:
         total = sum(s["t1"] - s["t0"] for s in spans)
         return max(0.0, total - self.stage_wall(*names))
 
+    @staticmethod
+    def _union_s(spans):
+        """Union wall-clock of a span list (merged-interval length)."""
+        ivals = sorted((s["t0"], s["t1"]) for s in spans)
+        total, end = 0.0, -float("inf")
+        for t0, t1 in ivals:
+            if t0 > end:
+                total += t1 - t0
+                end = t1
+            elif t1 > end:
+                total += t1 - end
+                end = t1
+        return total
+
+    def backend_busy_s(self, *names):
+        """{backend: union wall-clock seconds} of the named stages' spans,
+        grouped by the spans' ``backend`` tag.  Unlike
+        :meth:`stage_seconds`, concurrent spans on ONE backend (e.g. the
+        two double-buffered async dynamics chunks both in flight) count
+        their union once — this is the 'how long was that backend busy'
+        view that the cross-backend overlap decomposition needs."""
+        by_backend = {}
+        for n in names:
+            for s in self._named(n):
+                by_backend.setdefault(s["backend"], []).append(s)
+        return {b: self._union_s(sp) for b, sp in by_backend.items()}
+
+    def overlap_backend_decomposition(self, *names):
+        """Split :meth:`overlap_saved_s` into concurrency ACROSS backends
+        vs concurrency WITHIN one backend.
+
+        ``overlap_saved_s`` is (sum of span durations) − (union wall), so
+        it also counts e.g. two async device chunks in flight at once —
+        not only CPU-vs-device overlap.  The decomposition:
+
+          within[b] = Σ durations on backend b − union wall on backend b
+          cross     = Σ_b union[b] − union wall over all backends
+
+        ``cross`` is the seconds at least two *different* backends were
+        simultaneously busy (genuine heterogeneous overlap); Σ within +
+        cross == overlap_saved_s up to float rounding.  Returns
+        ``{"saved_s", "cross_backend_s", "within_backend_s": {b: ...}}``.
+        """
+        by_backend = {}
+        for n in names:
+            for s in self._named(n):
+                by_backend.setdefault(s["backend"], []).append(s)
+        if not by_backend:
+            return {"saved_s": 0.0, "cross_backend_s": 0.0,
+                    "within_backend_s": {}}
+        union_b = {b: self._union_s(sp) for b, sp in by_backend.items()}
+        union_all = self._union_s(
+            [s for sp in by_backend.values() for s in sp])
+        within = {
+            b: max(0.0, sum(s["t1"] - s["t0"] for s in sp) - union_b[b])
+            for b, sp in by_backend.items()
+        }
+        cross = max(0.0, sum(union_b.values()) - union_all)
+        return {
+            "saved_s": sum(within.values()) + cross,
+            "cross_backend_s": cross,
+            "within_backend_s": within,
+        }
+
     # -------------------------------------------------------------- emission
 
     def chrome_trace(self):

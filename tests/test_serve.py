@@ -1,0 +1,177 @@
+"""Serving engine (raft_tpu/serve): shape-bucketed dynamic batching.
+
+The contract under test is the acceptance criterion of the serve
+subsystem: queued requests coalesce into FEWER dispatches than requests,
+every request's served response is BIT-identical to the unbatched
+``Model.analyze_cases`` path run under the same bucket (the canonical
+fixed-shape executable both paths share), and one poisoned request —
+host-side raiser or in-graph NaN — never contaminates its batch-mates.
+"""
+
+import numpy as np
+import pytest
+
+from raft_tpu.designs import deep_spar, demo_semi
+from raft_tpu.model import Model
+from raft_tpu.serve import Engine, EngineConfig
+from raft_tpu.serve.buckets import (
+    BucketSpec,
+    choose_bucket,
+    pack_slots,
+)
+
+NW = (0.05, 0.5)    # small frequency grid keeps compiles cheap
+
+
+def _spar(rho_fill=1800.0):
+    d = deep_spar(n_cases=2, nw_settings=NW)
+    d["platform"]["members"][0]["rho_fill"] = [float(rho_fill), 0.0, 0.0]
+    return d
+
+
+def _engine(tmp_path, **kw):
+    kw.setdefault("precision", "float64")
+    kw.setdefault("window_ms", 100.0)
+    kw.setdefault("cache_dir", str(tmp_path))
+    return Engine(EngineConfig(**kw))
+
+
+# --------------------------------------------------------------- buckets
+
+def test_choose_bucket_quantization():
+    spec = choose_bucket(40, 49, 2, node_quantum=32, coalesce=2)
+    assert spec == BucketSpec(nw=40, n_nodes=64, n_slots=8)
+    # same family, slightly different node count -> same bucket
+    assert choose_bucket(40, 60, 2, node_quantum=32, coalesce=2) == spec
+    # case count past the ladder's coalesce target climbs the ladder
+    assert choose_bucket(40, 49, 12, coalesce=2).n_slots == 32
+    # a single huge request still fits (capacity >= nc)
+    assert choose_bucket(40, 49, 200, coalesce=2).n_slots >= 200
+
+
+def test_pack_slots_capacity_guard():
+    d = _spar()
+    m = Model(d, precision="float64")
+    m.analyze_unloaded()
+    args, _ = m.prepare_case_inputs(verbose=False)
+    nodes = m.nodes.astype(m.dtype)
+    spec = BucketSpec(nw=m.nw, n_nodes=nodes.r.shape[0], n_slots=2)
+    _, _, ranges = pack_slots([(nodes, args)], spec)
+    assert ranges == [(0, 2)]
+    with pytest.raises(ValueError, match="exceed bucket capacity"):
+        pack_slots([(nodes, args), (nodes, args)], spec)
+
+
+def test_model_slots_validation():
+    d = _spar()
+    m = Model(d, precision="float64",
+              slots=BucketSpec(nw=999, n_nodes=64, n_slots=8))
+    m.analyze_unloaded()
+    with pytest.raises(ValueError, match="bucket nw"):
+        m.analyze_cases()
+
+
+# ---------------------------------------------------------------- engine
+
+@pytest.fixture(scope="module")
+def served(tmp_path_factory):
+    """Three mixed-bucket requests through one engine: two spar ballast
+    variants (same bucket) plus a semisub (different node count ->
+    different bucket)."""
+    tmp = tmp_path_factory.mktemp("serve_cache")
+    designs = [_spar(1800.0), _spar(1500.0),
+               demo_semi(n_cases=2, nw_settings=NW)]
+    with _engine(tmp) as eng:
+        handles = [eng.submit(d) for d in designs]
+        results = [h.result(timeout=600) for h in handles]
+        snap = eng.snapshot()
+    return designs, results, snap
+
+
+def test_batched_dispatch_count_below_request_count(served):
+    designs, results, snap = served
+    assert all(r.status == "ok" for r in results)
+    assert snap["requests"] == 3
+    assert snap["dispatches"] < snap["requests"]
+    # the two spar variants shared one bucket and one dispatch
+    assert results[0].bucket == results[1].bucket
+    assert results[0].batch_requests == 2
+    assert results[2].bucket != results[0].bucket
+    # occupancy: 4 real lanes of an 8-slot bucket in the shared dispatch
+    assert results[0].batch_occupancy == pytest.approx(0.5)
+
+
+def test_served_results_bit_identical_to_direct_analyze_cases(served):
+    """Every request in the batch == the unbatched Model.analyze_cases
+    path under the same bucket, to the bit: both run the bucket's one
+    canonical executable, and lanes are data-independent."""
+    designs, results, _ = served
+    for d, r in zip(designs, results):
+        m = Model(d, precision="float64", slots=r.bucket)
+        m.analyze_unloaded()
+        m.analyze_cases(display=0)
+        assert np.array_equal(r.Xi, m.Xi)
+        assert np.array_equal(r.solve_report["converged"],
+                              m.results["solve_report"]["converged"])
+        assert r.solve_report["converged"].all()
+        # the engine's std summary matches the Xi it returned
+        dw = m.dw
+        std = np.sqrt(np.sum(np.abs(r.Xi) ** 2, axis=-1) * dw)
+        np.testing.assert_allclose(r.std, std, rtol=1e-12)
+
+
+def test_poisoned_request_quarantined_without_failing_batchmates(tmp_path):
+    """One request with NaN wave input (in-graph poison) and one whose
+    prep raises (host-side poison), coalesced with a healthy request:
+    the healthy request's bits must equal a solo uninjected run."""
+    healthy = _spar(1800.0)
+    poisoned = _spar(1500.0)
+    poisoned["cases"]["data"][0][7] = float("nan")   # wave_height -> NaN
+    raiser = _spar(1600.0)
+    del raiser["mooring"]                            # prep KeyError
+
+    with _engine(tmp_path) as eng:
+        hs = [eng.submit(d) for d in (healthy, poisoned, raiser)]
+        res = [h.result(timeout=600) for h in hs]
+        snap = eng.snapshot()
+    ok, bad, failed = res
+
+    assert failed.status == "failed"
+    assert "KeyError" in failed.error
+    assert failed.Xi is None
+
+    # in-graph poison: served, but its own report flags the NaN lanes
+    assert bad.status == "ok"
+    assert bad.solve_report["nonfinite"].any()
+    assert np.isfinite(bad.Xi).all()     # quarantine froze, not NaN'd
+
+    # the healthy batch-mate is bit-identical to a solo run
+    assert ok.status == "ok"
+    assert not ok.solve_report["nonfinite"].any()
+    with _engine(tmp_path, window_ms=1.0) as eng2:
+        solo = eng2.evaluate(healthy, timeout=600)
+    assert np.array_equal(ok.Xi, solo.Xi)
+    # the poisoned+healthy pair still coalesced (same bucket)
+    assert snap["failed"] == 1
+
+
+def test_deadline_admission_rejects_expired_requests(tmp_path):
+    d = _spar()
+    with _engine(tmp_path, window_ms=250.0) as eng:
+        eng.evaluate(d, timeout=600)        # warm prep+executable
+        late = eng.submit(d, deadline_s=1e-4)
+        res = late.result(timeout=60)
+        snap = eng.snapshot()
+    assert res.status == "rejected_deadline"
+    assert res.Xi is None
+    assert snap["rejected_deadline"] == 1
+
+
+def test_prep_memo_serves_repeat_designs(tmp_path):
+    d = _spar()
+    with _engine(tmp_path, window_ms=1.0) as eng:
+        eng.evaluate(d, timeout=600)
+        eng.evaluate(d, timeout=600)
+        snap = eng.snapshot()
+    assert snap["prep_memo_hits"] >= 1
+    assert snap["dispatches"] == 2

@@ -1,0 +1,165 @@
+"""Serve warm-up/compile-cache layer (raft_tpu/serve/cache.py).
+
+Two properties, per the serving acceptance criteria:
+
+ - **warm restart**: after ``warmup`` in one process, a FRESH interpreter
+   pointed at the same cache dir serves its first request without
+   recompiling (the persistent-cache hit counter says the executable came
+   from disk) and within 5x its own warm steady-state per-request
+   latency;
+ - **stale refusal**: a manifest entry recorded under a different flag
+   set (x64 mode, backend, code version) is refused with a reason, never
+   silently re-used.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Runs in a fresh interpreter: phase "cold" warms the cache from nothing
+# and serves a few requests; phase "warm" must find everything on disk.
+_RUNNER = """
+import sys, os, json, time
+sys.path.insert(0, __REPO_ROOT__)
+import jax
+jax.config.update("jax_platforms", "cpu")   # the axon plugin ignores env
+import numpy as np
+import raft_tpu  # wires the persistent compilation cache to the env dir
+from raft_tpu.designs import deep_spar
+from raft_tpu.serve import Engine, EngineConfig, warmup
+
+cache_dir = os.environ["RAFT_TPU_CACHE_DIR"]
+design = deep_spar(n_cases=2, nw_settings=(0.05, 0.5))
+phase = sys.argv[1]
+
+report = warmup(designs=[design] if phase == "cold" else None,
+                precision="float64", cache_dir=cache_dir)
+eng = Engine(EngineConfig(precision="float64", window_ms=1.0,
+                          cache_dir=cache_dir))
+t0 = time.perf_counter()
+res = eng.evaluate(design, timeout=600)
+t_first = time.perf_counter() - t0
+assert res.status == "ok", res.error
+steady = []
+for _ in range(5):
+    t0 = time.perf_counter()
+    eng.evaluate(design, timeout=600)
+    steady.append(time.perf_counter() - t0)
+snap = eng.snapshot()
+eng.shutdown()
+print("RESULT " + json.dumps({
+    "phase": phase,
+    "warmed": report["n_warmed"],
+    "rejected": report["n_rejected"],
+    "warmup_cache_hits": report["persistent_cache_hits"],
+    "warmup_wall_s": report["wall_s"],
+    "first_request_s": t_first,
+    "steady_median_s": float(np.median(steady)),
+    "prep_cache_hits": snap["prep_cache_hits"],
+}))
+"""
+
+
+def _run_phase(tmp_path, phase):
+    script = os.path.join(str(tmp_path), "serve_phase.py")
+    with open(script, "w") as fh:
+        fh.write(_RUNNER.replace("__REPO_ROOT__", repr(ROOT)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)          # 1 host device: fastest
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    env["RAFT_TPU_CACHE_DIR"] = os.path.join(str(tmp_path), "cache")
+    proc = subprocess.run(
+        [sys.executable, script, phase],
+        capture_output=True, text=True, timeout=560, env=env,
+        cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [ln for ln in proc.stdout.splitlines()
+            if ln.startswith("RESULT ")][-1]
+    return json.loads(line[len("RESULT "):])
+
+
+def test_warm_restart_serves_first_request_without_recompiling(tmp_path):
+    cold = _run_phase(tmp_path, "cold")
+    assert cold["warmed"] == 1
+    assert cold["rejected"] == 0
+
+    warm = _run_phase(tmp_path, "warm")
+    # the manifest replayed the bucket, and the executable came from the
+    # persistent compilation cache, not a recompile
+    assert warm["warmed"] == 1
+    assert warm["warmup_cache_hits"] >= 1
+    # host prep came from the serialized prep cache
+    assert warm["prep_cache_hits"] >= 1
+    # acceptance bound: first request of the restarted process lands
+    # within 5x its own warm steady-state per-request latency
+    assert warm["first_request_s"] < 5.0 * warm["steady_median_s"], warm
+    # and nowhere near the cold process's compile-dominated first answer
+    assert warm["first_request_s"] < cold["first_request_s"]
+
+
+def test_stale_manifest_flags_refused(tmp_path):
+    """A manifest recorded under different flags must not warm."""
+    import numpy as np
+
+    from raft_tpu.designs import deep_spar
+    from raft_tpu.model import Model
+    from raft_tpu.serve.buckets import SlotPhysics, choose_bucket
+    from raft_tpu.serve.cache import WarmupManifest, current_flags, warmup
+
+    design = deep_spar(n_cases=2, nw_settings=(0.05, 0.5))
+    model = Model(design, precision="float64")
+    physics = SlotPhysics.from_model(model)
+    spec = choose_bucket(model.nw, model.nodes.r.shape[0], 2)
+
+    stale = dict(current_flags())
+    stale["code_version"] = "0" * 12        # an older build wrote this
+    manifest = WarmupManifest(cache_dir=str(tmp_path))
+    manifest.record(physics, spec, flags=stale)
+
+    report = warmup(manifest=manifest, cache_dir=str(tmp_path))
+    assert report["n_warmed"] == 0
+    assert report["n_rejected"] == 1
+    assert "code_version" in report["rejected"][0]["reason"]
+
+    # same entry re-recorded under the live flags is admissible again
+    manifest.record(physics, spec)
+    report = warmup(manifest=manifest, cache_dir=str(tmp_path))
+    assert report["n_warmed"] == 1
+    assert report["n_rejected"] == 0
+
+
+def test_prep_cache_refuses_and_deletes_corrupt_entries(tmp_path):
+    import numpy as np
+
+    from raft_tpu.designs import deep_spar
+    from raft_tpu.model import Model
+    from raft_tpu.serve.buckets import SlotPhysics
+    from raft_tpu.serve.cache import PrepCache, design_prep_key
+
+    design = deep_spar(n_cases=2, nw_settings=(0.05, 0.5))
+    model = Model(design, precision="float64")
+    model.analyze_unloaded()
+    args, _ = model.prepare_case_inputs(verbose=False)
+    physics = SlotPhysics.from_model(model)
+    cache = PrepCache(cache_dir=str(tmp_path))
+    key = design_prep_key(design, None, "float64")
+    cache.save(key, model.nodes.astype(model.dtype), args, physics)
+
+    nodes2, args2, physics2 = cache.load(key)
+    assert physics2 == physics
+    for a, b in zip(args, args2):
+        assert np.array_equal(np.asarray(a), b)
+
+    # truncate the archive: load must delete it and report a miss
+    path = cache._path(key)
+    with open(path, "r+b") as fh:
+        fh.truncate(100)
+    assert cache.load(key) is None
+    assert not os.path.exists(path)

@@ -51,6 +51,38 @@ def test_overlap_accounting_exact():
     assert barrier.overlap_saved_s("nope") == 0.0
 
 
+def test_backend_overlap_decomposition_exact():
+    """Cross-backend vs within-backend concurrency separated exactly:
+    two device chunks overlapping each other by 1 s (within), and the
+    host stage overlapping the device union by 2 s (cross)."""
+    tr = Tracer()
+    _add(tr, "aero_second", 0.0, 2.0, backend="cpu", chunk=1)
+    _add(tr, "dynamics", 0.0, 2.0, backend="tpu", chunk=0)
+    _add(tr, "dynamics", 1.0, 3.0, backend="tpu", chunk=1)
+    busy = tr.backend_busy_s("aero_second", "dynamics")
+    assert busy == pytest.approx({"cpu": 2.0, "tpu": 3.0})
+    d = tr.overlap_backend_decomposition("aero_second", "dynamics")
+    # union(cpu)=2, union(tpu)=3, union(all)=3 -> cross = 2+3-3 = 2
+    assert d["cross_backend_s"] == pytest.approx(2.0)
+    # tpu spans sum 4 vs union 3 -> 1 s of same-backend concurrency
+    assert d["within_backend_s"] == pytest.approx({"cpu": 0.0, "tpu": 1.0})
+    # decomposition is exhaustive: within + cross == overlap_saved_s
+    assert d["saved_s"] == pytest.approx(
+        tr.overlap_saved_s("aero_second", "dynamics"))
+
+    # barrier layout: everything zero
+    barrier = Tracer()
+    _add(barrier, "aero_second", 0.0, 1.0, backend="cpu")
+    _add(barrier, "dynamics", 1.0, 2.0, backend="tpu")
+    d = barrier.overlap_backend_decomposition("aero_second", "dynamics")
+    assert d["cross_backend_s"] == 0.0
+    assert d["saved_s"] == 0.0
+    # absent stages reduce cleanly
+    empty = Tracer().overlap_backend_decomposition("nope")
+    assert empty == {"saved_s": 0.0, "cross_backend_s": 0.0,
+                     "within_backend_s": {}}
+
+
 def test_chrome_trace_schema_and_dump(tmp_path):
     tr = Tracer("sweep")
     _add(tr, "rotor", 0.0, 0.5, backend="host", chunk=2)
