@@ -81,8 +81,11 @@ _COMPACT_KEYS = (
     "serve_p50_s", "serve_p95_s", "serve_occupancy_mean",
     "serve_dispatches", "serve_requests", "serve_cold_vs_warm",
     "serve_cold_first_s", "serve_warm_first_s",
+    "serve_rejected_overload", "serve_watchdog_trips",
+    "serve_breaker_transitions",
     "rao_error", "sweep_error", "sweep243_error", "bem_error",
     "bem_sharded_error", "grad_error", "serve_error",
+    "chaos_smoke_error",
     "perf_docs_error", "sweep_scaling_error", "sweep1024_error",
     "sweep4096_error",
 )
@@ -245,7 +248,8 @@ def main(argv=None):
 
     if args.smoke:
         sections = [("smoke", bench_smoke),
-                    ("serve_smoke", bench_serve_smoke)]
+                    ("serve_smoke", bench_serve_smoke),
+                    ("chaos_smoke", bench_chaos_smoke)]
     else:
         import bench_sweep
 
@@ -720,6 +724,14 @@ def bench_serve(n_requests=8, n_cases=6):
     out = {
         "serve_requests": snap["requests"],
         "serve_dispatches": snap["dispatches"],
+        # fault-envelope counters: all zero on a healthy run, and the
+        # recorded proof of it (shedding, watchdog, breaker state machine)
+        "serve_rejected_overload": snap["rejected_overload"],
+        "serve_rejected_circuit": snap["rejected_circuit"],
+        "serve_watchdog_trips": snap["watchdog_trips"],
+        "serve_dispatch_retries": snap["dispatch_retries"],
+        "serve_breaker_transitions": snap["breaker_transitions"],
+        "serve_breakers": snap["breakers"],
         "serve_n_cases": n_cases,
         "serve_first_result_s": round(t_first, 3),
         "serve_p50_s": round(float(np.percentile(lat, 50)), 4),
@@ -779,6 +791,55 @@ def bench_serve_smoke(n_requests=3):
         "smoke_serve_dispatches": snap["dispatches"],
         "smoke_serve_occupancy": round(snap["occupancy_mean"], 3),
         "smoke_serve_s": round(time.perf_counter() - t0, 3),
+    }
+
+
+def bench_chaos_smoke():
+    """Tier-1-safe chaos smoke: one injected fault (a host-prep raiser on
+    request 2) end-to-end through the serving engine — the victim fails
+    alone, its batch-mate serves bit-identically to an uninjected run,
+    and the chaos accounting shows exactly one fire.  A regressed fault
+    envelope is caught by `bench.py --smoke` in CI, not in production."""
+    import tempfile
+
+    from raft_tpu.designs import deep_spar
+    from raft_tpu.serve import Engine, EngineConfig
+
+    t0 = time.perf_counter()
+
+    def spar(rho):
+        d = deep_spar(n_cases=2, nw_settings=(0.05, 0.5))
+        d["platform"]["members"][0]["rho_fill"] = [float(rho), 0.0, 0.0]
+        return d
+
+    old = os.environ.get("RAFT_TPU_CHAOS")
+    with tempfile.TemporaryDirectory() as tmp:
+        cfg = dict(precision="float64", window_ms=50.0, cache_dir=tmp)
+        try:
+            os.environ["RAFT_TPU_CHAOS"] = "prep_raise@2:7"
+            with Engine(EngineConfig(**cfg)) as eng:
+                h1 = eng.submit(spar(1800.0))       # healthy
+                h2 = eng.submit(spar(1500.0))       # injected victim
+                r1, r2 = h1.result(400), h2.result(400)
+                snap = eng.snapshot()
+        finally:
+            if old is None:
+                os.environ.pop("RAFT_TPU_CHAOS", None)
+            else:
+                os.environ["RAFT_TPU_CHAOS"] = old
+        assert r2.status == "failed" and "chaos" in r2.error, r2
+        assert r1.status == "ok", r1.error
+        assert snap["chaos"]["total_fires"] == 1
+        # healthy mate vs an uninjected engine: bit-identical
+        with Engine(EngineConfig(**cfg)) as eng:
+            solo = eng.evaluate(spar(1800.0), timeout=400)
+        assert solo.status == "ok", solo.error
+        assert np.array_equal(r1.Xi, solo.Xi)
+    return {
+        "chaos_smoke_fault": "prep_raise@2:7",
+        "chaos_smoke_victim_status": r2.status,
+        "chaos_smoke_mate_bit_identical": True,
+        "chaos_smoke_s": round(time.perf_counter() - t0, 3),
     }
 
 

@@ -77,12 +77,25 @@ def _warmup_main(argv):
     print(json.dumps(report))
 
 
+class _SignalShutdown(BaseException):
+    """Raised by the SIGTERM/SIGINT handlers to unblock the stdin read
+    so the serve loop can drain gracefully.  A BaseException so the
+    request loop's per-line ``except Exception`` can never swallow a
+    signal that lands mid-body."""
+
+    def __init__(self, signum):
+        super().__init__(f"signal {signum}")
+        self.signum = signum
+
+
 def _serve_main(argv):
     p = _serve_parser(
         "raft_tpu serve",
         "Long-lived serving engine: JSON-line requests on stdin "
         '({"design": "path.yaml", "cases": [...], "deadline_s": 10}), '
-        "JSON-line results on stdout.")
+        "JSON-line results on stdout.  SIGTERM/SIGINT shut down "
+        "gracefully: in-flight batches drain and every outstanding "
+        'handle resolves with a terminal status ("shutdown" at worst).')
     p.add_argument("--window-ms", type=float, default=None,
                    help="micro-batching window (default "
                         "RAFT_TPU_SERVE_WINDOW_MS or 5 ms)")
@@ -92,6 +105,8 @@ def _serve_main(argv):
                    help="include the full complex response amplitudes "
                         "in each result line")
     args = p.parse_args(argv)
+
+    import signal
 
     from raft_tpu.io.schema import load_design
     from raft_tpu.serve import Engine, EngineConfig, warmup
@@ -104,12 +119,22 @@ def _serve_main(argv):
     if not args.no_warmup:
         warmup(designs=designs or None, precision=args.precision,
                cache_dir=args.cache_dir)
-    with Engine(cfg) as eng:
+
+    def _on_signal(signum, frame):
+        raise _SignalShutdown(signum)
+
+    old_handlers = {
+        s: signal.signal(s, _on_signal)
+        for s in (signal.SIGTERM, signal.SIGINT)
+    }
+    eng = Engine(cfg)
+    sig = None
+    pending = []
+    try:
         print(json.dumps({"event": "ready",
                           **{k: v for k, v in eng.snapshot().items()
                              if not isinstance(v, (list, dict))}}),
               flush=True)
-        pending = []
         for line in sys.stdin:
             line = line.strip()
             if not line:
@@ -130,9 +155,26 @@ def _serve_main(argv):
             # drain results in submission order as they complete
             while pending and pending[0].done():
                 _emit_result(pending.pop(0).result(0), args.xi)
+    except _SignalShutdown as e:
+        sig = e.signum
+    finally:
+        for s, h in old_handlers.items():
+            signal.signal(s, h)
+        # graceful shutdown: EOF drains the queue fully; a signal
+        # finishes the in-flight dispatch and resolves everything still
+        # queued with status="shutdown".  Either way the engine
+        # guarantees every handle a terminal status, so the emits below
+        # can never block past the shutdown timeout.
+        eng.shutdown(wait=True, drain=(sig is None))
         for h in pending:
-            _emit_result(h.result(600), args.xi)
-        print(json.dumps({"event": "shutdown", **{
+            try:
+                _emit_result(h.result(timeout=30), args.xi)
+            except TimeoutError:  # pragma: no cover — belt and braces
+                print(json.dumps({"event": "result", "rid": h.rid,
+                                  "status": "shutdown",
+                                  "error": "unresolved at shutdown"}),
+                      flush=True)
+        print(json.dumps({"event": "shutdown", "signal": sig, **{
             k: v for k, v in eng.snapshot().items()
             if not isinstance(v, (list, dict))}}), flush=True)
 

@@ -157,6 +157,24 @@ def log_report(rep, label="case", log=None, limit=10):
 
 
 # ---------------------------------------------------------------------------
+# Fault-injection surface: how the chaos harness (raft_tpu/chaos.py)
+# produces an in-graph non-finite lane.  Lives HERE, next to the
+# quarantine contract it exercises: a NaN'd wave-excitation spectrum
+# makes the first dynamics iterate non-finite, the traced fixed point
+# freezes that lane at its last finite state, and batch-mates are
+# bit-unaffected (vmap lanes are data-independent; docs/robustness.md).
+# ---------------------------------------------------------------------------
+
+def inject_nonfinite_excitation(args, value=float("nan")):
+    """Return a COPY of the prepared case-input 7-tuple
+    (``Model.prepare_case_inputs`` order) with the wave-excitation
+    spectrum ``zeta`` (args[0]) replaced by ``value`` in every lane.
+    Never mutates its input — cached prep artifacts stay pristine."""
+    z0 = np.asarray(args[0])
+    return (np.full(z0.shape, value, z0.dtype),) + tuple(args[1:])
+
+
+# ---------------------------------------------------------------------------
 # RAFT_TPU_DEBUG_NANS: opt-in debugging switch.  When set, jax_debug_nans is
 # enabled (XLA re-runs the offending primitive un-jitted and raises at the
 # first NaN) and Model builds the scan-based "checkable" fixed point that

@@ -24,6 +24,14 @@ long-lived engine:
    artifacts are serialized per design, so a restarted server answers its
    first request at warm-path latency.
 
+The engine runs inside a production fault envelope (docs/robustness.md):
+a bounded queue with load shedding, a dispatch watchdog, per-(backend,
+bucket) circuit breakers with CPU degrade, transient-error retry under
+the unified resilience policies (raft_tpu/resilience.py), and a
+terminal-status guarantee for every submitted handle — all exercised
+deterministically by the chaos harness (raft_tpu/chaos.py,
+``RAFT_TPU_CHAOS``).
+
 Entry points: ``python -m raft_tpu serve|warmup`` (CLI) and the
 in-process :class:`Engine` API used by tests and ``bench.py``.
 Design document: docs/serving.md.
@@ -44,6 +52,7 @@ from raft_tpu.serve.cache import (  # noqa: F401
     warmup,
 )
 from raft_tpu.serve.engine import (  # noqa: F401
+    TERMINAL_STATUSES,
     Engine,
     EngineConfig,
     Request,

@@ -55,6 +55,15 @@ from raft_tpu.utils.profiling import logger
 
 MANIFEST_NAME = "serve_manifest.json"
 
+
+def _chaos_injector():
+    """The process's chaos injector (raft_tpu/chaos.py), or None.  Only
+    the corrupt_cache fault hooks this module; imported lazily so the
+    cache layer has no hard dependency on the chaos harness."""
+    from raft_tpu.chaos import get_injector
+
+    return get_injector()
+
 # ------------------------------------------------------------- monitoring
 # One module-level listener pair accumulates JAX's compile/cache events;
 # CompileWatcher snapshots the counters around a region.  (Listeners are
@@ -229,12 +238,45 @@ class WarmupManifest:
         self._lock = threading.Lock()
 
     def load(self):
+        """Entries of the manifest, REFUSING (with a logged reason) a
+        half-written/corrupt file or schema-invalid entries instead of
+        crashing ``warmup()`` — a bad manifest must degrade to a cold
+        start, never take the server down."""
+        if not os.path.exists(self.path):
+            return []
         try:
             with open(self.path) as fh:
                 doc = json.load(fh)
-            return doc.get("entries", [])
-        except (OSError, ValueError):
+        except OSError as e:
+            logger.warning(
+                "serve manifest %s unreadable (%s); warming nothing "
+                "from it", self.path, e)
             return []
+        except ValueError as e:
+            logger.warning(
+                "serve manifest %s refused: corrupt/half-written JSON "
+                "(%s); warming nothing from it", self.path, e)
+            return []
+        entries = doc.get("entries") if isinstance(doc, dict) else None
+        if not isinstance(entries, list):
+            logger.warning(
+                "serve manifest %s refused: unexpected document shape "
+                "(%s); warming nothing from it",
+                self.path, type(doc).__name__)
+            return []
+        good = []
+        for i, entry in enumerate(entries):
+            if (isinstance(entry, dict)
+                    and isinstance(entry.get("spec"), dict)
+                    and isinstance(entry.get("physics"), dict)
+                    and isinstance(entry.get("flags"), dict)):
+                good.append(entry)
+            else:
+                logger.warning(
+                    "serve manifest %s: entry %d refused (missing/"
+                    "malformed spec/physics/flags); skipped",
+                    self.path, i)
+        return good
 
     def _entry_key(self, entry):
         f = entry.get("flags", {})
@@ -313,8 +355,15 @@ def warmup(manifest=None, designs=None, cases=None, precision=None,
                 "serve warmup: manifest entry refused (%s); it will be "
                 "recompiled when its bucket is next served", reason)
             continue
-        physics = SlotPhysics.from_dict(entry["physics"])
-        spec = BucketSpec(**entry["spec"])
+        try:
+            physics = SlotPhysics.from_dict(entry["physics"])
+            spec = BucketSpec(**entry["spec"])
+        except (TypeError, KeyError, ValueError) as e:
+            reason = f"unparseable entry ({type(e).__name__}: {e})"
+            rejected.append({"spec": entry.get("spec"), "reason": reason})
+            logger.warning("serve warmup: manifest entry refused (%s)",
+                           reason)
+            continue
         if precision is not None and physics.dtype_name != precision:
             continue   # an explicit precision narrows what we warm
         if (physics, spec) not in jobs:
@@ -409,6 +458,9 @@ class PrepCache:
         np.savez(tmp, **payload)
         # np.savez appends .npz to the tmp name
         os.replace(tmp + ".npz", self._path(key))
+        inj = _chaos_injector()
+        if inj is not None:
+            inj.corrupt_if("corrupt_cache", self._path(key))
 
     def load(self, key):
         """-> (nodes, args, physics) or None (absent/corrupt/stale)."""

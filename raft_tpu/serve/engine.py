@@ -1,15 +1,44 @@
 """The dynamic micro-batching engine: a long-lived request server over
 the batched case solve.
 
-Requests (design dict + cases + optional deadline) enter a queue; a
-single batcher thread coalesces them per shape bucket inside a bounded
-batching window and dispatches each bucket group as ONE padded megabatch
-through the canonical slot executable (raft_tpu/serve/buckets.py).  The
-differentiable-BEM serving assumption (arXiv:2501.06988) — a long-lived
-solver process amortizing setup across many queries — is realized by
-three caches: the per-bucket compiled executables (persistent across
-restarts via the warm-up manifest, raft_tpu/serve/cache.py), the
-in-process prep memo, and the on-disk prep cache.
+Requests (design dict + cases + optional deadline) enter a bounded
+queue; a single batcher thread coalesces them per shape bucket inside a
+bounded batching window and dispatches each bucket group as ONE padded
+megabatch through the canonical slot executable
+(raft_tpu/serve/buckets.py).  The differentiable-BEM serving assumption
+(arXiv:2501.06988) — a long-lived solver process amortizing setup across
+many queries — is realized by three caches: the per-bucket compiled
+executables (persistent across restarts via the warm-up manifest,
+raft_tpu/serve/cache.py), the in-process prep memo, and the on-disk prep
+cache.
+
+The production fault envelope (docs/robustness.md, "Serving fault
+envelope"):
+
+ - **prep worker pool** — host-side preparation runs in a small thread
+   pool off the batcher thread, so one cold-prep request no longer
+   head-of-line-blocks its batch-mates (prep is host-side only; the slot
+   executables and therefore the served bits are unchanged);
+ - **bounded queue + load shedding** — beyond the high-water mark
+   (``RAFT_TPU_SERVE_MAX_QUEUE``) new submits resolve immediately with
+   ``status="rejected_overload"`` until the queue drains below the
+   low-water mark;
+ - **dispatch watchdog** — a watchdog thread detects a wall-clock-stuck
+   executable (``RAFT_TPU_WATCHDOG_S``), fails that batch's handles with
+   ``status="watchdog_timeout"``, and trips the bucket's circuit
+   breaker;
+ - **circuit breaker per (backend, bucket)** — while open, requests for
+   that bucket degrade to the CPU backend (when the default backend is
+   an accelerator) or fast-fail with ``status="rejected_circuit"``
+   instead of queueing behind a corpse; after a cooldown one half-open
+   probe decides whether to close;
+ - **transient-error retry** — a dispatch raising
+   ``resilience.TransientError`` is re-attempted (same packed operands,
+   deterministic backoff) up to the retry policy's bound;
+ - **terminal-status guarantee** — every submitted handle reaches
+   exactly ONE terminal status (first resolution wins; shutdown resolves
+   all stragglers with ``status="shutdown"``), so no handle can block
+   past its own ``result(timeout)``.
 
 Fault isolation, per request:
  - a request whose HOST-SIDE preparation raises (bad geometry, mooring
@@ -19,9 +48,11 @@ Fault isolation, per request:
  - a request whose lanes go NON-FINITE in-graph is frozen by the
    dynamics NaN quarantine and reported through its own SolveReport
    slice; neighboring lanes are bit-unaffected (vmap lanes are
-   data-independent — asserted in tests/test_serve.py);
+   data-independent — asserted in tests/test_serve.py and the chaos
+   matrix, tests/test_chaos.py);
  - a request whose deadline expires before its batch flushes is REJECTED
-   without dispatch (admission control; docs/serving.md).
+   without dispatch (admission control at submit AND at dispatch;
+   docs/serving.md).
 """
 
 import dataclasses
@@ -29,12 +60,21 @@ import os
 import threading
 import time
 from collections import OrderedDict
+from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
 import jax
 
+from raft_tpu.chaos import ChaosBackendError, ChaosError, get_injector
 from raft_tpu.health import log_report, report_dict
+from raft_tpu.resilience import (
+    BackoffPolicy,
+    BreakerBoard,
+    RetryPolicy,
+    TransientError,
+    WatchdogTimeout,
+)
 from raft_tpu.serve.buckets import (
     SlotPhysics,
     choose_bucket,
@@ -52,12 +92,22 @@ from raft_tpu.serve.cache import (
 )
 from raft_tpu.utils.profiling import logger
 
+#: every status a RequestResult can carry; all are terminal.
+TERMINAL_STATUSES = (
+    "ok", "failed", "rejected_deadline", "rejected_overload",
+    "rejected_circuit", "watchdog_timeout", "shutdown",
+)
+
 
 def _env_float(name, default):
     try:
         return float(os.environ.get(name, default))
     except ValueError:
         return default
+
+
+def _env_int(name, default):
+    return int(_env_float(name, default))
 
 
 @dataclasses.dataclass
@@ -69,6 +119,20 @@ class EngineConfig:
         Latency floor vs batch occupancy knob.
     node_quantum / slot_ladder / coalesce : bucket quantization
         (buckets.choose_bucket).
+    max_queue / low_water : load-shedding marks — submits are shed with
+        ``rejected_overload`` once the queue holds ``max_queue`` entries,
+        until it drains below ``low_water``.
+    watchdog_s : wall-clock budget of ONE bucket dispatch before the
+        watchdog fails the batch and trips the breaker.
+    prep_workers / prep_wait_s : size of the host-prep worker pool and
+        how long a flushing batch waits for stragglers' prep before
+        deferring them to a later dispatch.
+    dispatch_retries : extra attempts for a dispatch that raised a
+        TransientError (0 disables).
+    breaker_threshold / breaker_cooldown_s : circuit-breaker automaton
+        parameters, per (backend, bucket).
+    degrade_to_cpu : when a breaker is open and the default backend is
+        an accelerator, serve that bucket on CPU instead of fast-failing.
     """
 
     precision: str = None
@@ -76,14 +140,36 @@ class EngineConfig:
     window_ms: float = dataclasses.field(
         default_factory=lambda: _env_float("RAFT_TPU_SERVE_WINDOW_MS", 5.0))
     node_quantum: int = dataclasses.field(
-        default_factory=lambda: int(
-            _env_float("RAFT_TPU_SERVE_NODE_QUANTUM", 32)))
+        default_factory=lambda: _env_int("RAFT_TPU_SERVE_NODE_QUANTUM", 32))
     slot_ladder: tuple = (8, 16, 32, 64, 128)
     coalesce: int = 2
     use_prep_cache: bool = True
     warm_on_start: bool = False
     record_manifest: bool = True
     cache_dir: str = None
+    max_queue: int = dataclasses.field(
+        default_factory=lambda: _env_int("RAFT_TPU_SERVE_MAX_QUEUE", 256))
+    low_water: int = dataclasses.field(
+        default_factory=lambda: _env_int("RAFT_TPU_SERVE_LOW_WATER", 0))
+    watchdog_s: float = dataclasses.field(
+        default_factory=lambda: _env_float("RAFT_TPU_WATCHDOG_S", 120.0))
+    prep_workers: int = dataclasses.field(
+        default_factory=lambda: _env_int("RAFT_TPU_SERVE_PREP_WORKERS", 2))
+    prep_wait_s: float = dataclasses.field(
+        default_factory=lambda: _env_float(
+            "RAFT_TPU_SERVE_PREP_WAIT_S", 30.0))
+    dispatch_retries: int = dataclasses.field(
+        default_factory=lambda: _env_int("RAFT_TPU_DISPATCH_RETRIES", 1))
+    breaker_threshold: int = dataclasses.field(
+        default_factory=lambda: _env_int("RAFT_TPU_BREAKER_THRESHOLD", 3))
+    breaker_cooldown_s: float = dataclasses.field(
+        default_factory=lambda: _env_float(
+            "RAFT_TPU_BREAKER_COOLDOWN_S", 30.0))
+    degrade_to_cpu: bool = True
+
+    def __post_init__(self):
+        if self.low_water <= 0:
+            self.low_water = max(1, self.max_queue // 2)
 
 
 @dataclasses.dataclass
@@ -99,10 +185,18 @@ class Request:
 
 @dataclasses.dataclass
 class RequestResult:
-    """Per-request outcome.  ``status``:
+    """Per-request outcome.  ``status`` (all terminal — see
+    TERMINAL_STATUSES):
     'ok' — solved (check ``solve_report`` for per-case health);
-    'failed' — host-side preparation raised (``error``);
-    'rejected_deadline' — admission control dropped it before dispatch.
+    'failed' — host-side preparation or dispatch raised (``error``);
+    'rejected_deadline' — admission control dropped it (at submit when
+        ``deadline_s <= 0`` or the predicted queue wait already exceeds
+        it; at dispatch when it expired in the queue);
+    'rejected_overload' — the bounded queue shed it (high-water mark);
+    'rejected_circuit' — the bucket's circuit breaker is open and no
+        degrade path exists;
+    'watchdog_timeout' — its dispatch exceeded the wall-clock watchdog;
+    'shutdown' — the engine stopped before it could be served.
     """
 
     rid: int
@@ -116,6 +210,7 @@ class RequestResult:
     queue_s: float = 0.0             # submit -> dispatch start
     batch_requests: int = 0          # requests coalesced in the dispatch
     batch_occupancy: float = 0.0     # real lanes / bucket slots
+    backend: str = None              # backend the dispatch ran on
 
     @property
     def ok(self):
@@ -123,16 +218,27 @@ class RequestResult:
 
 
 class _Pending:
-    """Submit handle: ``result(timeout)`` blocks for the RequestResult."""
+    """Submit handle: ``result(timeout)`` blocks for the RequestResult.
+
+    Exactly-once resolution: the first ``_set`` wins and every later one
+    is a no-op returning False (the engine counts those as
+    ``late_resolutions``).  A ``result(timeout)`` expiry raises
+    TimeoutError but does NOT detach the handle — the engine still
+    guarantees it a terminal status (at latest, ``status="shutdown"``
+    when the engine stops)."""
 
     def __init__(self, rid):
         self.rid = rid
         self._event = threading.Event()
         self._result = None
+        self._once = threading.Lock()
 
     def _set(self, result):
+        if not self._once.acquire(blocking=False):
+            return False           # already resolved: first writer won
         self._result = result
         self._event.set()
+        return True
 
     def done(self):
         return self._event.is_set()
@@ -159,9 +265,24 @@ class _Prepped:
         self.dw = dw
 
 
+class _Entry:
+    """One queued request: its handle plus the async prep future."""
+
+    __slots__ = ("req", "pend", "fut", "windowed", "grace_until")
+
+    def __init__(self, req, pend, fut):
+        self.req = req
+        self.pend = pend
+        self.fut = fut
+        self.windowed = False      # has been through one batching window
+        self.grace_until = None    # prep-straggler deadline, set at flush
+
+
 class Engine:
     """Long-lived serving engine.  Thread-safe ``submit``; a single
-    batcher thread owns batching, dispatch, and result delivery.
+    batcher thread owns batching, dispatch, and result delivery, with
+    prep fanned out to a worker pool and dispatches guarded by the
+    watchdog/breaker envelope.
 
     >>> eng = Engine()
     >>> handle = eng.submit(design)
@@ -173,21 +294,49 @@ class Engine:
         self.config = config or EngineConfig(**overrides)
         install_compile_listeners()
         persist_all_compiles()
-        self._queue = []                       # [(Request, _Pending, _Prepped|Exception)]
-        self._lock = threading.Lock()
+        self._queue = []                       # [_Entry]
+        # RLock: a prep future that is ALREADY done runs its
+        # done-callback synchronously inside submit's locked section
+        self._lock = threading.RLock()
         self._wake = threading.Condition(self._lock)
         self._stop = False
+        self._drain = True
+        self._shedding = False
         self._rid = 0
+        self._outstanding = {}                 # rid -> _Pending
         self._prep_memo = OrderedDict()        # design key -> _Prepped
         self._prep_memo_cap = 128
-        self._prep_lock = threading.Lock()     # batcher + bucket_for callers
+        self._prep_lock = threading.Lock()     # memo: pool + bucket_for
+        self._prep_futs = {}                   # design key -> Future
+        self._prep_pool = ThreadPoolExecutor(
+            max_workers=max(1, self.config.prep_workers),
+            thread_name_prefix="raft-serve-prep")
         self._prep_cache = (PrepCache(self.config.cache_dir)
                             if self.config.use_prep_cache else None)
         self._manifest = (WarmupManifest(cache_dir=self.config.cache_dir)
                           if self.config.record_manifest else None)
+        self._chaos = get_injector()
+        self._breakers = BreakerBoard(
+            failure_threshold=self.config.breaker_threshold,
+            cooldown_s=self.config.breaker_cooldown_s)
+        self._dispatch_policy = RetryPolicy(
+            max_attempts=1 + max(0, self.config.dispatch_retries),
+            backoff=BackoffPolicy(base_s=0.02, max_s=0.5,
+                                  seed=self._chaos.seed
+                                  if self._chaos else 0),
+            retry_on=(TransientError,), name="serve dispatch")
+        self._ema_dispatch_s = None
+        self._watch_lock = threading.Lock()
+        self._inflight = None                  # dict | None (watchdog)
         self.stats = {
             "requests": 0, "dispatches": 0, "failed": 0,
-            "rejected_deadline": 0, "latency_s": [], "occupancy": [],
+            "rejected_deadline": 0, "rejected_overload": 0,
+            "rejected_circuit": 0, "watchdog_timeout": 0,
+            "watchdog_trips": 0, "dispatch_retries": 0,
+            "shed_events": 0, "shed_recoveries": 0,
+            "prep_deferred": 0, "late_resolutions": 0,
+            "shutdown_resolved": 0, "degraded_dispatches": 0,
+            "latency_s": [], "occupancy": [],
             "batch_requests": [], "prep_cache_hits": 0,
             "prep_memo_hits": 0, "bucket_compiles": [],
             "first_result_s": None, "warmup": None,
@@ -200,21 +349,68 @@ class Engine:
         self._thread = threading.Thread(
             target=self._run, name="raft-serve-batcher", daemon=True)
         self._thread.start()
+        self._watchdog = threading.Thread(
+            target=self._watchdog_loop, name="raft-serve-watchdog",
+            daemon=True)
+        self._watchdog.start()
 
     # ------------------------------------------------------------- client
 
     def submit(self, design, cases=None, deadline_s=None):
-        """Enqueue one request; returns a handle with ``result(timeout)``."""
+        """Enqueue one request; returns a handle with ``result(timeout)``.
+
+        Admission control runs here: hopeless deadlines
+        (``deadline_s <= 0`` or below the predicted queue wait) resolve
+        immediately with ``rejected_deadline``, and an over-high-water
+        queue sheds with ``rejected_overload`` — neither occupies a
+        queue slot."""
+        now = time.perf_counter()
         with self._lock:
             if self._stop:
                 raise RuntimeError("engine is shut down")
             self._rid += 1
-            req = Request(design=design, cases=cases,
-                          deadline_s=deadline_s, rid=self._rid,
-                          t_submit=time.perf_counter())
-            pend = _Pending(req.rid)
-            self._queue.append((req, pend))
+            rid = self._rid
             self.stats["requests"] += 1
+            pend = _Pending(rid)
+            # --- deadline admission (satellite: reject on submit) ---
+            if deadline_s is not None:
+                predicted = self._predicted_wait_locked(now)
+                if deadline_s <= 0 or deadline_s < predicted:
+                    self.stats["rejected_deadline"] += 1
+                    pend._set(RequestResult(
+                        rid=rid, status="rejected_deadline",
+                        error=(f"deadline {deadline_s}s hopeless at "
+                               f"submit (predicted wait "
+                               f"{predicted:.3f}s)")))
+                    return pend
+            # --- load shedding (high-water / low-water) ---
+            qlen = len(self._queue)
+            if self._shedding and qlen <= self.config.low_water:
+                self._shedding = False
+                self.stats["shed_recoveries"] += 1
+                logger.warning(
+                    "serve: queue drained to %d (low-water %d); load "
+                    "shedding disengaged", qlen, self.config.low_water)
+            if not self._shedding and qlen >= self.config.max_queue:
+                self._shedding = True
+                self.stats["shed_events"] += 1
+                logger.warning(
+                    "serve: queue at %d (high-water %d); shedding new "
+                    "requests with rejected_overload until it drains "
+                    "below %d", qlen, self.config.max_queue,
+                    self.config.low_water)
+            if self._shedding:
+                self.stats["rejected_overload"] += 1
+                pend._set(RequestResult(
+                    rid=rid, status="rejected_overload",
+                    error=(f"queue at {qlen} >= high-water "
+                           f"{self.config.max_queue}")))
+                return pend
+            req = Request(design=design, cases=cases,
+                          deadline_s=deadline_s, rid=rid, t_submit=now)
+            fut = self._submit_prep_locked(req)
+            self._queue.append(_Entry(req, pend, fut))
+            self._outstanding[rid] = pend
             self._wake.notify()
         return pend
 
@@ -229,12 +425,28 @@ class Engine:
         prepped = self._prepare(Request(design=design, cases=cases))
         return prepped.spec
 
-    def shutdown(self, wait=True):
+    def shutdown(self, wait=True, drain=True, timeout=30.0):
+        """Stop the engine.  ``drain=True`` serves what is already queued
+        (bounded by ``prep_wait_s`` for unfinished preps); ``drain=False``
+        finishes only the in-flight dispatch and resolves everything
+        still queued with ``status="shutdown"``.  Either way EVERY
+        outstanding handle reaches a terminal status: if the batcher
+        cannot exit within ``timeout`` (a truly stuck dispatch), the
+        stragglers are force-resolved here."""
         with self._lock:
             self._stop = True
-            self._wake.notify()
+            self._drain = bool(drain)
+            self._wake.notify_all()
+        # without drain, queued-but-unstarted preps are pointless work
+        self._prep_pool.shutdown(wait=False, cancel_futures=not drain)
         if wait:
-            self._thread.join(timeout=30)
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                logger.warning(
+                    "serve shutdown: batcher still busy after %.1fs; "
+                    "force-resolving outstanding handles", timeout)
+            self._finalize_outstanding()
+        return self
 
     def __enter__(self):
         return self
@@ -243,55 +455,77 @@ class Engine:
         self.shutdown()
         return False
 
-    # ------------------------------------------------------------ batcher
+    # --------------------------------------------------------- resolution
 
-    def _run(self):
-        while True:
+    def _resolve(self, pend, result):
+        """Deliver a terminal result exactly once; keeps the outstanding
+        registry and the late-resolution counter honest."""
+        if pend._set(result):
             with self._lock:
-                while not self._queue and not self._stop:
-                    self._wake.wait()
-                if self._stop and not self._queue:
-                    return
-                t_first = min(r.t_submit for r, _ in self._queue)
-            # batching window: wait out the remainder, bounded by the
-            # earliest deadline in the queue
-            window = self.config.window_ms / 1e3
-            while True:
-                with self._lock:
-                    if self._stop:
-                        break
-                    now = time.perf_counter()
-                    remaining = (t_first + window) - now
-                    deadlines = [
-                        r.t_submit + r.deadline_s
-                        for r, _ in self._queue if r.deadline_s
-                    ]
-                    if deadlines:
-                        remaining = min(
-                            remaining, min(deadlines) - now)
-                if remaining <= 0:
-                    break
-                time.sleep(min(remaining, 0.25 * window + 1e-4))
-            with self._lock:
-                batch = self._queue
-                self._queue = []
-            if batch:
-                try:
-                    self._serve_batch(batch)
-                except Exception:  # pragma: no cover — keep the thread up
-                    logger.exception("serve batcher: batch failed")
-                    for req, pend in batch:
-                        if not pend.done():
-                            pend._set(RequestResult(
-                                rid=req.rid, status="failed",
-                                error="internal batcher error"))
+                self._outstanding.pop(pend.rid, None)
+            return True
+        self.stats["late_resolutions"] += 1
+        return False
 
-    # ------------------------------------------------------------- prep
+    def _finalize_outstanding(self):
+        """Resolve every still-pending handle with ``shutdown`` — the
+        no-handle-blocks-forever guarantee."""
+        with self._lock:
+            leftovers = list(self._outstanding.values())
+            self._queue = []
+        for pend in leftovers:
+            if self._resolve(pend, RequestResult(
+                    rid=pend.rid, status="shutdown",
+                    error="engine stopped before this request was "
+                          "served")):
+                self.stats["shutdown_resolved"] += 1
+
+    def _predicted_wait_locked(self, now):
+        """Conservative lower bound on this submit's queue wait: the
+        estimated remainder of the dispatch currently in flight (EMA of
+        recent dispatch walls).  Zero when idle or without history —
+        admission must never reject a servable request."""
+        ema = self._ema_dispatch_s
+        if ema is None:
+            return 0.0
+        with self._watch_lock:
+            inf = self._inflight
+            if inf is None:
+                return 0.0
+            return max(0.0, ema - (now - inf["t0"]))
+
+    # --------------------------------------------------------------- prep
+
+    def _submit_prep_locked(self, req):
+        """Schedule host-side prep on the worker pool (deduplicated per
+        design key); completion wakes the batcher.  Called under
+        self._lock."""
+        key = design_prep_key(req.design, req.cases, self.config.precision)
+        fut = self._prep_futs.get(key)
+        if fut is not None and not fut.done():
+            return fut
+        fut = self._prep_pool.submit(self._prepare, req)
+        self._prep_futs[key] = fut
+        if len(self._prep_futs) > 4 * self._prep_memo_cap:
+            self._prep_futs = {k: f for k, f in self._prep_futs.items()
+                               if not f.done()}
+            self._prep_futs[key] = fut
+        fut.add_done_callback(self._on_prep_done)
+        return fut
+
+    def _on_prep_done(self, _fut):
+        with self._lock:
+            self._wake.notify_all()
 
     def _prepare(self, req):
         """Host-side prep with the three-level cache (in-process memo ->
-        on-disk prep cache -> full Model build)."""
+        on-disk prep cache -> full Model build).  Chaos hooks: prep_raise
+        / prep_slow fire here, per request id."""
         from raft_tpu.model import Model
+
+        if self._chaos is not None:
+            self._chaos.raise_if("prep_raise", req.rid, exc=ChaosError)
+            self._chaos.stall_if("prep_slow", req.rid)
 
         key = design_prep_key(req.design, req.cases,
                               self.config.precision)
@@ -347,29 +581,150 @@ class Engine:
                 self._prep_memo.popitem(last=False)
         return prepped
 
+    # ------------------------------------------------------------ batcher
+
+    def _run(self):
+        try:
+            while True:
+                with self._lock:
+                    # wait for actionable work: a ready prep, a fresh
+                    # (never-windowed) entry, or stop
+                    while not self._stop and not any(
+                            e.fut.done() or not e.windowed
+                            for e in self._queue):
+                        self._wake.wait(0.25 if self._queue else None)
+                    if self._stop:
+                        break
+                    t_first = min(
+                        (e.req.t_submit for e in self._queue
+                         if not e.windowed),
+                        default=time.perf_counter())
+                    for e in self._queue:
+                        e.windowed = True
+                self._window_wait(t_first)
+                if self._stop_requested():
+                    break
+                batch = self._collect_batch()
+                if batch:
+                    try:
+                        self._serve_batch(batch)
+                    except Exception:  # noqa: BLE001 — keep thread up
+                        logger.exception("serve batcher: batch failed")
+                        for entry in batch:
+                            self._resolve(entry.pend, RequestResult(
+                                rid=entry.req.rid, status="failed",
+                                error="internal batcher error"))
+            if self._drain:
+                self._drain_queue()
+        except Exception:  # pragma: no cover — last-ditch guard
+            logger.exception("serve batcher crashed")
+        finally:
+            self._finalize_outstanding()
+
+    def _stop_requested(self):
+        with self._lock:
+            return self._stop
+
+    def _window_wait(self, t_first):
+        """Sleep out the remainder of the batching window, bounded by the
+        earliest queued deadline and the stop flag."""
+        window = self.config.window_ms / 1e3
+        while True:
+            with self._lock:
+                if self._stop:
+                    return
+                now = time.perf_counter()
+                remaining = (t_first + window) - now
+                deadlines = [
+                    e.req.t_submit + e.req.deadline_s
+                    for e in self._queue if e.req.deadline_s
+                ]
+                if deadlines:
+                    remaining = min(remaining, min(deadlines) - now)
+            if remaining <= 0:
+                return
+            time.sleep(min(remaining, 0.25 * window + 1e-4))
+
+    def _collect_batch(self):
+        """Take every entry whose prep finished; wait a bounded grace for
+        stragglers (so same-window mates still coalesce — prep runs in
+        parallel, max not sum); defer entries whose prep is still running
+        after the grace (they dispatch when their prep completes, without
+        holding anyone else up)."""
+        grace = max(self.config.prep_wait_s, 0.0)
+        now = time.perf_counter()
+        with self._lock:
+            for e in self._queue:
+                if e.grace_until is None:
+                    e.grace_until = now + grace
+            while True:
+                now = time.perf_counter()
+                pending = [e for e in self._queue
+                           if not e.fut.done() and now < e.grace_until]
+                if not pending or self._stop:
+                    break
+                self._wake.wait(min(
+                    0.05, max(1e-3, min(e.grace_until for e in pending)
+                              - now)))
+            batch = [e for e in self._queue if e.fut.done()]
+            deferred = [e for e in self._queue if not e.fut.done()]
+            if deferred and batch:
+                self.stats["prep_deferred"] += len(deferred)
+                logger.warning(
+                    "serve: %d request(s) deferred past the %.1fs prep "
+                    "grace; batch-mates dispatch without them",
+                    len(deferred), grace)
+            self._queue = deferred
+        return batch
+
+    def _drain_queue(self):
+        """Stop-with-drain: keep serving ready entries until the queue is
+        empty or the drain patience (prep_wait_s, at least 1 s) runs out;
+        the finalizer resolves anything left with ``shutdown``."""
+        deadline = time.perf_counter() + max(self.config.prep_wait_s, 1.0)
+        while time.perf_counter() < deadline:
+            with self._lock:
+                if not self._queue:
+                    return
+                batch = [e for e in self._queue if e.fut.done()]
+                self._queue = [e for e in self._queue
+                               if not e.fut.done()]
+            if batch:
+                try:
+                    self._serve_batch(batch)
+                except Exception:  # noqa: BLE001 — resolve, keep draining
+                    logger.exception("serve drain: batch failed")
+                    for entry in batch:
+                        self._resolve(entry.pend, RequestResult(
+                            rid=entry.req.rid, status="failed",
+                            error="internal batcher error"))
+            else:
+                time.sleep(0.02)
+
     # ----------------------------------------------------------- dispatch
 
     def _serve_batch(self, batch):
         now = time.perf_counter()
         groups = OrderedDict()   # (physics, spec) -> [(req, pend, prepped)]
-        for req, pend in batch:
-            # deadline admission: reject before paying prep/dispatch
+        for entry in batch:
+            req, pend = entry.req, entry.pend
+            # deadline admission: reject before paying dispatch
             if (req.deadline_s is not None
                     and now > req.t_submit + req.deadline_s):
                 self.stats["rejected_deadline"] += 1
-                pend._set(RequestResult(
+                self._resolve(pend, RequestResult(
                     rid=req.rid, status="rejected_deadline",
                     error=f"deadline {req.deadline_s}s expired in queue",
                     latency_s=now - req.t_submit))
                 continue
             try:
-                prepped = self._prepare(req)
+                prepped = entry.fut.result(timeout=0)
             except Exception as e:  # noqa: BLE001 — quarantine prep faults
                 self.stats["failed"] += 1
                 logger.warning(
                     "serve request %d quarantined: prep raised (%s: %s)",
                     req.rid, type(e).__name__, e)
-                pend._set(RequestResult(
+                self._resolve(pend, RequestResult(
                     rid=req.rid, status="failed",
                     error=f"{type(e).__name__}: {e}",
                     latency_s=time.perf_counter() - req.t_submit))
@@ -391,18 +746,125 @@ class Engine:
                     cursor += 1
                 self._dispatch_group(physics, spec, take, lanes)
 
-    def _dispatch_group(self, physics, spec, members, lanes):
-        t0 = time.perf_counter()
-        entries = [(p.nodes, p.args) for _, _, p in members]
-        with CompileWatcher() as w:
-            nodes_s, args_s, ranges = pack_slots(entries, spec)
-            sharding = None
-            if self.config.device is not None:
-                from raft_tpu.utils.placement import backend_sharding
+    def _member_entries(self, members):
+        """(nodes, args) pack list with the chaos nan_lane hook applied
+        per request (poisons a COPY; memoized prep stays pristine)."""
+        entries = []
+        for req, _pend, p in members:
+            args = p.args
+            if self._chaos is not None:
+                args = self._chaos.poison_if("nan_lane", req.rid, args)
+            entries.append((p.nodes, args))
+        return entries
 
-                sharding = backend_sharding(self.config.device)
-            xr, xi, report = dispatch_slots(
-                physics, spec, nodes_s, args_s, sharding=sharding)
+    def _dispatch_group(self, physics, spec, members, lanes):
+        backend = self.config.device or jax.default_backend()
+        key = (backend, spec)
+        breaker = self._breakers.get(key)
+        if not breaker.allow():
+            if self._can_degrade(backend):
+                self._dispatch_degraded(physics, spec, members, lanes)
+                return
+            for req, pend, _p in members:
+                self.stats["rejected_circuit"] += 1
+                self._resolve(pend, RequestResult(
+                    rid=req.rid, status="rejected_circuit", bucket=spec,
+                    error=(f"circuit open for {key[0]}/{spec} "
+                           "(recent watchdog/backend failures); retry "
+                           "after the breaker cooldown"),
+                    latency_s=time.perf_counter() - req.t_submit))
+            return
+        self._dispatch_guarded(physics, spec, members, lanes, breaker,
+                               backend=backend,
+                               sharding=self._sharding_for(
+                                   self.config.device))
+
+    def _can_degrade(self, backend):
+        if not self.config.degrade_to_cpu or backend == "cpu":
+            return False
+        try:
+            return bool(jax.devices("cpu"))
+        except RuntimeError:
+            return False
+
+    def _dispatch_degraded(self, physics, spec, members, lanes):
+        """Open-breaker degrade path: serve the bucket on the CPU backend
+        under its own breaker key (host-side prep is backend-agnostic;
+        only the dispatch placement changes)."""
+        breaker = self._breakers.get(("cpu-degraded", spec))
+        if not breaker.allow():
+            for req, pend, _p in members:
+                self.stats["rejected_circuit"] += 1
+                self._resolve(pend, RequestResult(
+                    rid=req.rid, status="rejected_circuit", bucket=spec,
+                    error="circuit open on the primary AND degraded-CPU "
+                          "paths",
+                    latency_s=time.perf_counter() - req.t_submit))
+            return
+        self.stats["degraded_dispatches"] += 1
+        logger.warning(
+            "serve: circuit open for %s; degrading bucket %s to the CPU "
+            "backend", self.config.device or jax.default_backend(), spec)
+        self._dispatch_guarded(physics, spec, members, lanes, breaker,
+                               backend="cpu-degraded",
+                               sharding=self._sharding_for("cpu"))
+
+    @staticmethod
+    def _sharding_for(device):
+        if device is None:
+            return None
+        from raft_tpu.utils.placement import backend_sharding
+
+        return backend_sharding(device)
+
+    def _dispatch_guarded(self, physics, spec, members, lanes, breaker,
+                          backend, sharding):
+        """One bucket dispatch under the full envelope: watchdog wall
+        clock, transient-error retry (same packed operands), breaker
+        accounting, then per-request result delivery."""
+        t0 = time.perf_counter()
+        entries = self._member_entries(members)
+        try:
+            with CompileWatcher() as w:
+                nodes_s, args_s, ranges = pack_slots(entries, spec)
+
+                def _call():
+                    if self._chaos is not None:
+                        self._chaos.stall_if("dispatch_stall")
+                        self._chaos.raise_if(
+                            "backend_error", exc=ChaosBackendError)
+                    return dispatch_slots(physics, spec, nodes_s, args_s,
+                                          sharding=sharding)
+
+                out = self._dispatch_policy.run(
+                    lambda: self._watched_call(_call),
+                    key=str((backend, spec)),
+                    on_retry=self._count_dispatch_retry)
+        except WatchdogTimeout as e:
+            self.stats["watchdog_trips"] += 1
+            breaker.trip(f"watchdog_timeout after "
+                         f"{self.config.watchdog_s:.1f}s")
+            for req, pend, _p in members:
+                self.stats["watchdog_timeout"] += 1
+                self._resolve(pend, RequestResult(
+                    rid=req.rid, status="watchdog_timeout", bucket=spec,
+                    error=str(e), backend=backend,
+                    latency_s=time.perf_counter() - req.t_submit))
+            return
+        except Exception as e:  # noqa: BLE001 — fail batch, record, go on
+            breaker.record_failure(f"{type(e).__name__}")
+            logger.warning(
+                "serve dispatch failed for bucket %s on %s (%s: %s)",
+                spec, backend, type(e).__name__, e)
+            for req, pend, _p in members:
+                self.stats["failed"] += 1
+                self._resolve(pend, RequestResult(
+                    rid=req.rid, status="failed", bucket=spec,
+                    error=f"{type(e).__name__}: {e}", backend=backend,
+                    latency_s=time.perf_counter() - req.t_submit))
+            return
+        breaker.record_success()
+        xr, xi, report = out
         if w.delta["backend_compiles"] or w.delta["persistent_cache_hits"]:
             self.stats["bucket_compiles"].append({
                 "spec": spec.as_dict(),
@@ -417,6 +879,9 @@ class Engine:
         self.stats["occupancy"].append(occupancy)
         self.stats["batch_requests"].append(len(members))
         t_done = time.perf_counter()
+        dt = t_done - t0
+        self._ema_dispatch_s = (dt if self._ema_dispatch_s is None
+                                else 0.3 * dt + 0.7 * self._ema_dispatch_s)
         for (req, pend, prepped), (a, b) in zip(members, ranges):
             Xi = xr[a:b] + 1j * xi[a:b]
             rep = jax.tree.map(lambda arr: np.asarray(arr)[a:b], report)
@@ -428,12 +893,81 @@ class Engine:
             self.stats["latency_s"].append(latency)
             if self.stats["first_result_s"] is None:
                 self.stats["first_result_s"] = latency
-            pend._set(RequestResult(
+            self._resolve(pend, RequestResult(
                 rid=req.rid, status="ok", Xi=Xi, std=std,
                 solve_report=report_dict(rep), bucket=spec,
                 latency_s=latency, queue_s=t0 - req.t_submit,
                 batch_requests=len(members),
-                batch_occupancy=occupancy))
+                batch_occupancy=occupancy, backend=backend))
+
+    def _count_dispatch_retry(self, _attempt, _exc):
+        self.stats["dispatch_retries"] += 1
+
+    # ----------------------------------------------------------- watchdog
+
+    def _watched_call(self, fn):
+        """Run one dispatch attempt on a daemon thread and hand its
+        wall-clock fate to the watchdog thread: if the watchdog abandons
+        it, raise WatchdogTimeout here (the worker, if it ever finishes,
+        discards its late result)."""
+        inf = {
+            "t0": time.perf_counter(),
+            "settled": threading.Event(),
+            "abandoned": False,
+            "box": {},
+        }
+
+        def runner():
+            try:
+                value = fn()
+                err = None
+            except BaseException as e:  # noqa: BLE001 — marshalled below
+                value, err = None, e
+            with self._watch_lock:
+                if inf["abandoned"]:
+                    logger.warning(
+                        "serve watchdog: abandoned dispatch completed "
+                        "late (%.1fs); result discarded",
+                        time.perf_counter() - inf["t0"])
+                    return
+                inf["box"]["value"] = value
+                inf["box"]["error"] = err
+            inf["settled"].set()
+
+        with self._watch_lock:
+            self._inflight = inf
+        worker = threading.Thread(
+            target=runner, name="raft-serve-dispatch", daemon=True)
+        worker.start()
+        inf["settled"].wait()
+        with self._watch_lock:
+            self._inflight = None
+            abandoned = inf["abandoned"]
+        if abandoned:
+            raise WatchdogTimeout(
+                f"dispatch exceeded the {self.config.watchdog_s:.1f}s "
+                "watchdog budget (executable wall-clock-stuck)")
+        if inf["box"]["error"] is not None:
+            raise inf["box"]["error"]
+        return inf["box"]["value"]
+
+    def _watchdog_loop(self):
+        """Watchdog thread: scans the in-flight dispatch record and
+        abandons any dispatch that has exceeded the wall-clock budget —
+        the batcher then fails the batch and trips the breaker."""
+        while True:
+            budget = max(self.config.watchdog_s, 1e-3)
+            time.sleep(max(0.01, min(0.25, budget / 8)))
+            with self._watch_lock:
+                inf = self._inflight
+                if (inf is not None and not inf["abandoned"]
+                        and not inf["settled"].is_set()
+                        and time.perf_counter() - inf["t0"] > budget):
+                    inf["abandoned"] = True
+                    inf["settled"].set()
+            if self._stop and self._inflight is None \
+                    and not self._thread.is_alive():
+                return
 
     # -------------------------------------------------------------- stats
 
@@ -446,12 +980,27 @@ class Engine:
             "dispatches": self.stats["dispatches"],
             "failed": self.stats["failed"],
             "rejected_deadline": self.stats["rejected_deadline"],
+            "rejected_overload": self.stats["rejected_overload"],
+            "rejected_circuit": self.stats["rejected_circuit"],
+            "watchdog_trips": self.stats["watchdog_trips"],
+            "dispatch_retries": self.stats["dispatch_retries"],
+            "shed_events": self.stats["shed_events"],
+            "shed_recoveries": self.stats["shed_recoveries"],
+            "prep_deferred": self.stats["prep_deferred"],
+            "late_resolutions": self.stats["late_resolutions"],
+            "shutdown_resolved": self.stats["shutdown_resolved"],
+            "degraded_dispatches": self.stats["degraded_dispatches"],
+            "outstanding": len(self._outstanding),
             "prep_cache_hits": self.stats["prep_cache_hits"],
             "prep_memo_hits": self.stats["prep_memo_hits"],
             "first_result_s": self.stats["first_result_s"],
             "bucket_compiles": self.stats["bucket_compiles"],
             "warmup": self.stats["warmup"],
+            "breakers": self._breakers.snapshot(),
+            "breaker_transitions": self._breakers.transition_count(),
         }
+        if self._chaos is not None:
+            out["chaos"] = self._chaos.snapshot()
         if len(lat):
             out["latency_p50_s"] = float(np.percentile(lat, 50))
             out["latency_p95_s"] = float(np.percentile(lat, 95))

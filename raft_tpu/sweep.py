@@ -47,6 +47,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from raft_tpu.geometry import HydroNodes
 from raft_tpu.health import FailedPoint
 from raft_tpu.model import Model, make_case_dynamics
+from raft_tpu.resilience import SolveRetryPolicy
 from raft_tpu.utils.profiling import logger
 
 
@@ -317,11 +318,13 @@ def run_sweep(
     out_dir : str | None
         Checkpoint directory. Chunk k's results live in ``chunk_{k:04d}.npz``
         and are loaded instead of recomputed on restart.
-    retry_nonconverged : bool
+    retry_nonconverged : bool | resilience.SolveRetryPolicy
         Give non-converged (but finite) lanes one bounded retry re-solve
-        with doubled nIter and stronger under-relaxation (relax 0.4
-        instead of the reference's 0.8); the retry result is adopted only
-        where it converges, so first-pass-healthy lanes stay bit-identical.
+        under the unified escalation policy (raft_tpu/resilience.py:
+        default doubled nIter, relax 0.4 instead of the reference's 0.8);
+        the retry result is adopted only where it converges, so
+        first-pass-healthy lanes stay bit-identical.  Pass a
+        ``SolveRetryPolicy`` to customize the schedule.
     overlap : bool
         Software-pipeline the chunk loop: chunk k's device solve is
         dispatched asynchronously and stays in flight while the host
@@ -347,6 +350,7 @@ def run_sweep(
     if mesh is None:
         mesh = make_sweep_mesh()
     n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+    retry_policy = SolveRetryPolicy.from_flag(retry_nonconverged)
     if out_dir:
         os.makedirs(out_dir, exist_ok=True)
 
@@ -388,8 +392,9 @@ def run_sweep(
         retry_mask = valid[:, None] & ~sol["converged"] \
             & ~sol["nonfinite"]
         sol["retried"] = np.zeros_like(retry_mask)
-        if retry_nonconverged and retry_mask.any():
-            pipe2 = _sweep_pipeline(m0, sharding, 2 * m0.nIter, 0.4)
+        if retry_policy.enabled and retry_mask.any():
+            nIter2, relax2 = retry_policy.escalate(m0.nIter)
+            pipe2 = _sweep_pipeline(m0, sharding, nIter2, relax2)
             sol2 = _fetch_solve(*pipe2(*dev_in))
             use = retry_mask & sol2["converged"]
             for key in ("Xi_r", "Xi_i"):
@@ -401,8 +406,9 @@ def run_sweep(
             sol["retried"] = retry_mask
             logger.warning(
                 "sweep chunk %d: %d non-converged lane(s) retried with "
-                "doubled nIter / relax=0.4; %d recovered",
-                k, int(retry_mask.sum()), int(use.sum()),
+                "nIter=%d / relax=%.2g; %d recovered",
+                k, int(retry_mask.sum()), nIter2, relax2,
+                int(use.sum()),
             )
 
         # mask quarantined rows before anything downstream sees them

@@ -56,6 +56,7 @@ from raft_tpu.mooring import (
     parse_mooring,
     warn_bridle_residual,
 )
+from raft_tpu.resilience import SolveRetryPolicy
 from raft_tpu.statics import compute_statics
 from raft_tpu.sweep import pad_and_stack_nodes
 from raft_tpu.utils.placement import put_cpu
@@ -611,9 +612,11 @@ def _chunked_aero_dynamics(model0, cases, wind, aero_on, pitch_mean,
     # stay bit-identical)
     retry_mask = ~sol["converged"] & ~sol["nonfinite"]
     sol["retried"] = np.zeros_like(retry_mask)
-    if retry_nonconverged and retry_mask.any():
+    retry_policy = SolveRetryPolicy.from_flag(retry_nonconverged)
+    if retry_policy.enabled and retry_mask.any():
+        nIter2, relax2 = retry_policy.escalate(model0.nIter)
         pipe2 = _dynamics_pipeline(
-            model0, return_xi, nIter=2 * model0.nIter, relax=0.4)
+            model0, return_xi, nIter=nIter2, relax=relax2)
         redo = []
         for ci, dev_args, _, _ in inflight:
             if retry_mask[:, ci].any():
@@ -639,9 +642,9 @@ def _chunked_aero_dynamics(model0, cases, wind, aero_on, pitch_mean,
                         sol[key][:, ci])
         sol["retried"] = retry_mask
         logger.warning(
-            "%s: %d non-converged lane(s) retried with doubled nIter / "
-            "relax=0.4; %d recovered",
-            label, int(retry_mask.sum()), n_rec,
+            "%s: %d non-converged lane(s) retried with nIter=%d / "
+            "relax=%.2g; %d recovered",
+            label, int(retry_mask.sum()), nIter2, relax2, n_rec,
         )
 
     # overlap accounting: the union-vs-sum savings PLUS its per-backend

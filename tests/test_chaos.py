@@ -1,0 +1,345 @@
+"""The chaos matrix: deterministic fault injection (raft_tpu/chaos.py,
+``RAFT_TPU_CHAOS``) driven through the serving fault envelope
+(raft_tpu/serve/engine.py, raft_tpu/resilience.py).
+
+The acceptance contracts under test (ISSUE 5):
+
+ - under EVERY injected fault class, co-batched healthy requests are
+   bit-identical to a fault-free run (``np.array_equal``);
+ - the circuit breaker opens on a watchdog trip, fast-fails while open,
+   half-opens after the cooldown, and closes on a successful probe;
+ - load shedding engages at the high-water mark and recovers below the
+   low-water mark;
+ - no handle blocks past its own timeout, and shutdown (including a
+   SIGTERM'd ``python -m raft_tpu serve``) resolves 100% of outstanding
+   handles with terminal statuses.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu.chaos import ChaosInjector, get_injector, parse_spec
+from raft_tpu.designs import deep_spar
+from raft_tpu.serve import TERMINAL_STATUSES, Engine, EngineConfig
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NW = (0.05, 0.5)    # tiny frequency grid keeps compiles cheap
+
+
+def _spar(rho_fill=1800.0):
+    d = deep_spar(n_cases=2, nw_settings=NW)
+    d["platform"]["members"][0]["rho_fill"] = [float(rho_fill), 0.0, 0.0]
+    return d
+
+
+def _engine(cache_dir, **kw):
+    kw.setdefault("precision", "float64")
+    kw.setdefault("window_ms", 50.0)
+    kw.setdefault("cache_dir", str(cache_dir))
+    return Engine(EngineConfig(**kw))
+
+
+@pytest.fixture(scope="module")
+def cache_dir(tmp_path_factory):
+    """One shared serve cache for the module: prep artifacts warm once,
+    so each chaos engine construction costs milliseconds, not a Model
+    rebuild."""
+    return str(tmp_path_factory.mktemp("chaos_cache"))
+
+
+@pytest.fixture(scope="module")
+def baseline(cache_dir):
+    """Fault-free reference bits for the healthy spar request."""
+    os.environ.pop("RAFT_TPU_CHAOS", None)
+    with _engine(cache_dir, window_ms=1.0) as eng:
+        res = eng.evaluate(_spar(), timeout=600)
+    assert res.status == "ok"
+    return res
+
+
+# ------------------------------------------------------------- spec/seed
+
+def test_chaos_spec_grammar():
+    rules, seed = parse_spec(
+        "prep_raise@2;dispatch_stall=2.5*1;backend_error%50:42")
+    assert seed == 42
+    by_name = {r.name: r for r in rules}
+    assert by_name["prep_raise"].rids == frozenset({2})
+    assert by_name["dispatch_stall"].value == 2.5
+    assert by_name["dispatch_stall"].times == 1
+    assert by_name["backend_error"].pct == 50.0
+    # defaults
+    assert by_name["prep_raise"].times is None
+    assert by_name["prep_raise"].pct == 100.0
+
+    for bad in ("prep_raise",            # no seed
+                "prep_raise:x",          # non-integer seed
+                "unknown_fault:1",       # unknown fault name
+                "prep_raise@a:1",        # non-integer rid
+                ":3"):                   # no faults
+        with pytest.raises(ValueError):
+            parse_spec(bad)
+
+
+def test_chaos_decisions_are_deterministic():
+    """The pct decision is a pure function of (seed, name, rid,
+    occurrence) — two injectors with the same spec agree fire-for-fire,
+    and a different seed gives a different schedule."""
+    spec = "backend_error%40:5"
+    a = ChaosInjector.from_spec(spec)
+    b = ChaosInjector.from_spec(spec)
+    fires_a = [bool(a.should("backend_error", rid)) for rid in range(50)]
+    fires_b = [bool(b.should("backend_error", rid)) for rid in range(50)]
+    assert fires_a == fires_b
+    assert any(fires_a) and not all(fires_a)
+    c = ChaosInjector.from_spec("backend_error%40:6")
+    fires_c = [bool(c.should("backend_error", rid)) for rid in range(50)]
+    assert fires_c != fires_a
+
+
+def test_injector_env_gate(monkeypatch):
+    monkeypatch.delenv("RAFT_TPU_CHAOS", raising=False)
+    assert get_injector() is None
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "prep_raise@1:3")
+    inj = get_injector()
+    assert inj is not None and inj.seed == 3
+    assert get_injector() is inj          # cached while env unchanged
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "prep_raise@1:4")
+    assert get_injector().seed == 4       # re-parsed on change
+
+
+# ------------------------------------------------- fault classes, batched
+
+def test_prep_raise_fails_victim_alone(cache_dir, baseline, monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "prep_raise@2:7")
+    with _engine(cache_dir) as eng:
+        h1 = eng.submit(_spar())             # rid 1: healthy
+        h2 = eng.submit(_spar(1500.0))       # rid 2: victim
+        r1, r2 = h1.result(120), h2.result(120)
+        snap = eng.snapshot()
+    assert r2.status == "failed" and "chaos-injected prep_raise" in r2.error
+    assert r1.status == "ok"
+    assert np.array_equal(r1.Xi, baseline.Xi)
+    assert snap["chaos"]["fires"] == {"prep_raise": 1}
+
+
+def test_prep_slow_does_not_block_batchmates(cache_dir, baseline,
+                                             monkeypatch):
+    """A cold/stalled prep defers only ITSELF past the prep grace; its
+    batch-mates dispatch without it (the ROADMAP head-of-line item)."""
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "prep_slow=1.5@2:11")
+    with _engine(cache_dir, window_ms=20.0, prep_wait_s=0.2) as eng:
+        h1 = eng.submit(_spar())             # rid 1: healthy
+        h2 = eng.submit(_spar(1500.0))       # rid 2: stalled 1.5 s
+        r1 = h1.result(60)
+        assert not h2.done()                 # mate served, victim pending
+        r2 = h2.result(60)
+        snap = eng.snapshot()
+    assert r1.status == "ok" and np.array_equal(r1.Xi, baseline.Xi)
+    assert r2.status == "ok"                 # late, but served correctly
+    assert snap["prep_deferred"] >= 1
+    assert r1.latency_s < r2.latency_s
+
+
+def test_nan_lane_quarantined_batchmates_bit_identical(cache_dir, baseline,
+                                                       monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "nan_lane@2:5")
+    with _engine(cache_dir) as eng:
+        h1 = eng.submit(_spar())
+        h2 = eng.submit(_spar(1500.0))
+        r1, r2 = h1.result(120), h2.result(120)
+    # victim: served, NaN lanes frozen in-graph and flagged
+    assert r2.status == "ok"
+    assert r2.solve_report["nonfinite"].all()
+    assert np.isfinite(r2.Xi).all()
+    # healthy batch-mate: clean and bit-identical to the fault-free run
+    assert r1.status == "ok"
+    assert not r1.solve_report["nonfinite"].any()
+    assert np.array_equal(r1.Xi, baseline.Xi)
+
+
+def test_nan_lane_injection_leaves_cached_prep_pristine(cache_dir,
+                                                        baseline,
+                                                        monkeypatch):
+    """The poison is applied to a COPY at pack time: the same engine
+    serving the same design WITHOUT the fault afterwards returns clean
+    bits (the memoized prep was never mutated)."""
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "nan_lane@1*1:5")
+    with _engine(cache_dir, window_ms=5.0) as eng:
+        bad = eng.evaluate(_spar(), timeout=120)     # rid 1: poisoned
+        good = eng.evaluate(_spar(), timeout=120)    # rid 2: clean again
+    assert bad.solve_report["nonfinite"].all()
+    assert not good.solve_report["nonfinite"].any()
+    assert np.array_equal(good.Xi, baseline.Xi)
+
+
+def test_dispatch_stall_watchdog_breaker_cycle(cache_dir, baseline,
+                                               monkeypatch):
+    """The full breaker story: stall -> watchdog_timeout within ~budget,
+    breaker open -> rejected_circuit fast-fail, cooldown -> half-open
+    probe -> closed, service restored bit-identically."""
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "dispatch_stall=1.5*1:9")
+    with _engine(cache_dir, window_ms=10.0, watchdog_s=0.3,
+                 breaker_cooldown_s=0.5, dispatch_retries=0) as eng:
+        t0 = time.perf_counter()
+        r1 = eng.evaluate(_spar(), timeout=30)
+        t_fail = time.perf_counter() - t0
+        assert r1.status == "watchdog_timeout"
+        assert t_fail < 1.4            # failed by the watchdog, not the
+        #                                1.5 s stall finishing
+        # breaker open: fast-fail, no queueing behind the corpse
+        r2 = eng.evaluate(_spar(), timeout=30)
+        assert r2.status == "rejected_circuit"
+        # cooldown -> half-open probe (stall budget *1 already spent)
+        time.sleep(0.6)
+        r3 = eng.evaluate(_spar(), timeout=60)
+        assert r3.status == "ok"
+        assert np.array_equal(r3.Xi, baseline.Xi)
+        snap = eng.snapshot()
+    assert snap["watchdog_trips"] == 1
+    assert snap["rejected_circuit"] == 1
+    (bname, bsnap), = [(k, v) for k, v in snap["breakers"].items()
+                       if v["transitions"]]
+    seq = [(tr["from"], tr["to"]) for tr in bsnap["transitions"]]
+    assert seq == [("closed", "open"), ("open", "half_open"),
+                   ("half_open", "closed")]
+    assert bsnap["state"] == "closed"
+
+
+def test_transient_backend_error_retried_bit_identical(cache_dir, baseline,
+                                                       monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "backend_error*1:3")
+    with _engine(cache_dir, window_ms=10.0) as eng:
+        r = eng.evaluate(_spar(), timeout=120)
+        snap = eng.snapshot()
+    assert r.status == "ok"
+    assert snap["dispatch_retries"] == 1
+    # the retry re-dispatched the SAME packed operands: bits unchanged
+    assert np.array_equal(r.Xi, baseline.Xi)
+
+
+def test_corrupt_cache_entry_refused_and_rebuilt(cache_dir, baseline,
+                                                 tmp_path, monkeypatch,
+                                                 caplog):
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "corrupt_cache:1")
+    with _engine(tmp_path, window_ms=1.0) as eng:
+        r1 = eng.evaluate(_spar(), timeout=600)
+    assert r1.status == "ok"                # corruption hits the DISK copy
+    monkeypatch.delenv("RAFT_TPU_CHAOS")
+    with caplog.at_level("WARNING", logger="raft_tpu"):
+        with _engine(tmp_path, window_ms=1.0) as eng:
+            r2 = eng.evaluate(_spar(), timeout=600)
+            snap = eng.snapshot()
+    assert r2.status == "ok"
+    assert snap["prep_cache_hits"] == 0     # refused, not trusted
+    assert any("deleting unreadable entry" in m for m in caplog.messages)
+    assert np.array_equal(r2.Xi, baseline.Xi)
+
+
+# -------------------------------------------------- shedding and shutdown
+
+def test_shedding_engages_and_recovers(cache_dir, monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "prep_slow=0.6:13")
+    with _engine(cache_dir, window_ms=10.0, max_queue=2, low_water=1,
+                 prep_workers=1) as eng:
+        handles = [eng.submit(_spar(1800.0 + i)) for i in range(5)]
+        shed = [h for h in handles if h.done()
+                and h.result(0).status == "rejected_overload"]
+        kept = [h for h in handles if h not in shed]
+        assert len(shed) >= 1               # high-water engaged
+        assert len(kept) >= 2
+        for h in kept:
+            assert h.result(120).status == "ok"
+        # queue drained below low-water: new submits are accepted again
+        late = eng.submit(_spar(1900.0))
+        res = late.result(120)
+        snap = eng.snapshot()
+    assert res.status == "ok"
+    assert snap["shed_events"] >= 1
+    assert snap["shed_recoveries"] >= 1
+    assert snap["rejected_overload"] == len(shed)
+
+
+def test_shutdown_under_chaos_resolves_every_handle(cache_dir,
+                                                    monkeypatch):
+    """shutdown(drain=False) with stalled preps in flight: every handle
+    reaches a terminal status promptly; nothing blocks forever."""
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "prep_slow=2.0:17")
+    eng = _engine(cache_dir, window_ms=50.0, prep_workers=1)
+    handles = [eng.submit(_spar(2000.0 + i)) for i in range(3)]
+    eng.shutdown(wait=True, drain=False, timeout=10.0)
+    statuses = [h.result(5).status for h in handles]
+    assert all(s in TERMINAL_STATUSES for s in statuses)
+    assert statuses.count("shutdown") >= 2
+    snap = eng.snapshot()
+    assert snap["outstanding"] == 0
+    with pytest.raises(RuntimeError):
+        eng.submit(_spar())
+
+
+def test_sigterm_server_resolves_all_outstanding_handles(tmp_path):
+    """The CLI contract: a SIGTERM'd ``python -m raft_tpu serve`` emits a
+    terminal-status result line for 100% of submitted requests plus a
+    final shutdown event — under chaos (one stalled prep) and with
+    requests still outstanding."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    env["RAFT_TPU_CACHE_DIR"] = str(tmp_path)
+    env["RAFT_TPU_CHAOS"] = "prep_slow=120@2:19"   # rid 2 stalls "forever"
+    env["RAFT_TPU_SERVE_PREP_WAIT_S"] = "1.0"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "raft_tpu", "serve", "--no-warmup",
+         "--window-ms", "20"],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True, env=env, cwd=ROOT)
+    lines = []
+    reader = threading.Thread(
+        target=lambda: lines.extend(proc.stdout), daemon=True)
+    reader.start()
+
+    def wait_for(pred, timeout, what):
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout:
+            if any(pred(ln) for ln in list(lines)):
+                return
+            if proc.poll() is not None:
+                break
+            time.sleep(0.25)
+        proc.kill()
+        raise AssertionError(
+            f"serve process: no {what} within {timeout}s; lines={lines} "
+            f"stderr={proc.stderr.read()[-2000:]}")
+
+    try:
+        wait_for(lambda ln: '"event": "ready"' in ln, 240, "ready event")
+        for rho in (1800.0, 1500.0, 1600.0):     # rid 2 is the stalled one
+            proc.stdin.write(json.dumps({"design": _spar(rho)}) + "\n")
+        proc.stdin.flush()
+        # let rid 1/3 reach the engine (their results are NOT emitted yet:
+        # the JSONL loop drains in submission order behind stalled rid 2)
+        wait_for(lambda ln: True, 1, "liveness")
+        time.sleep(2.0)
+        proc.send_signal(signal.SIGTERM)
+        proc.wait(timeout=240)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    docs = [json.loads(ln) for ln in lines]
+    results = {d["rid"]: d for d in docs if d.get("event") == "result"}
+    assert set(results) == {1, 2, 3}, docs
+    assert all(d["status"] in TERMINAL_STATUSES
+               for d in results.values()), results
+    assert results[2]["status"] == "shutdown"    # the stalled one
+    shut = [d for d in docs if d.get("event") == "shutdown"]
+    assert len(shut) == 1 and shut[0]["signal"] == signal.SIGTERM
+    assert shut[0]["outstanding"] == 0

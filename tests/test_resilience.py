@@ -1,0 +1,135 @@
+"""Unified resilience policies (raft_tpu/resilience.py): deterministic
+backoff, bounded retry, the circuit-breaker automaton, and the shared
+sweep escalation schedule.  Pure host-side control flow — no JAX, no
+clock dependence (breakers take an injected clock)."""
+
+import pytest
+
+from raft_tpu.resilience import (
+    BackoffPolicy,
+    BreakerBoard,
+    CircuitBreaker,
+    RetryPolicy,
+    SolveRetryPolicy,
+    TransientError,
+    WatchdogTimeout,
+)
+
+
+def test_backoff_is_exponential_capped_and_deterministic():
+    b = BackoffPolicy(base_s=0.1, mult=2.0, max_s=0.5, jitter=0.0, seed=1)
+    assert b.delay(1) == pytest.approx(0.1)
+    assert b.delay(2) == pytest.approx(0.2)
+    assert b.delay(3) == pytest.approx(0.4)
+    assert b.delay(4) == pytest.approx(0.5)      # capped
+    assert b.delay(9) == pytest.approx(0.5)
+    j = BackoffPolicy(base_s=0.1, jitter=0.5, seed=7)
+    # jitter shrinks, never grows, and replays identically
+    assert 0.05 <= j.delay(1, key="k") <= 0.1
+    assert j.delay(1, key="k") == BackoffPolicy(
+        base_s=0.1, jitter=0.5, seed=7).delay(1, key="k")
+    # different keys/seeds decorrelate
+    assert j.delay(1, key="k") != j.delay(1, key="other")
+
+
+def test_retry_policy_bounded_and_selective():
+    slept = []
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise TransientError("hiccup")
+        return "ok"
+
+    pol = RetryPolicy(max_attempts=3,
+                      backoff=BackoffPolicy(base_s=0.01, jitter=0.0))
+    assert pol.run(flaky, sleep=slept.append) == "ok"
+    assert len(calls) == 3 and len(slept) == 2
+
+    # exhausts: the last failure propagates
+    calls.clear()
+    with pytest.raises(TransientError):
+        RetryPolicy(max_attempts=2).run(
+            lambda: (_ for _ in ()).throw(TransientError("always")),
+            sleep=lambda s: None)
+
+    # non-retryable errors propagate immediately
+    calls.clear()
+
+    def fatal():
+        calls.append(1)
+        raise ValueError("not transient")
+
+    with pytest.raises(ValueError):
+        pol.run(fatal, sleep=lambda s: None)
+    assert len(calls) == 1
+
+    # WatchdogTimeout is deliberately NOT retryable by default: a stuck
+    # executable must trip the breaker, not be retried into
+    assert not isinstance(WatchdogTimeout("x"), TransientError)
+
+
+def test_breaker_opens_half_opens_closes():
+    t = [0.0]
+    br = CircuitBreaker(failure_threshold=2, cooldown_s=5.0,
+                        clock=lambda: t[0], name="test")
+    assert br.state == "closed" and br.allow()
+    br.record_failure()
+    assert br.state == "closed"          # below threshold
+    br.record_failure()
+    assert br.state == "open" and not br.allow()
+    t[0] = 4.9
+    assert not br.allow()                # cooldown not elapsed
+    t[0] = 5.0
+    assert br.allow()                    # this caller is the probe
+    assert br.state == "half_open"
+    assert not br.allow()                # only one probe admitted
+    br.record_success()
+    assert br.state == "closed" and br.allow()
+    # a failing probe re-opens and restarts the cooldown
+    br.trip("watchdog")
+    t[0] = 11.0
+    assert br.allow() and br.state == "half_open"
+    br.record_failure()
+    assert br.state == "open" and not br.allow()
+    states = [(a, b) for _, a, b, _ in br.transitions]
+    assert states == [
+        ("closed", "open"), ("open", "half_open"), ("half_open", "closed"),
+        ("closed", "open"), ("open", "half_open"), ("half_open", "open"),
+    ]
+
+
+def test_breaker_trip_opens_regardless_of_count():
+    t = [0.0]
+    br = CircuitBreaker(failure_threshold=100, cooldown_s=1.0,
+                        clock=lambda: t[0])
+    br.trip("watchdog_timeout")
+    assert br.state == "open" and not br.allow()
+
+
+def test_breaker_board_keys_and_snapshot():
+    board = BreakerBoard(failure_threshold=1, cooldown_s=9.0)
+    a = board.get(("tpu", "bucket_a"))
+    assert board.get(("tpu", "bucket_a")) is a
+    b = board.get(("cpu", "bucket_a"))
+    assert b is not a
+    a.record_failure()
+    snap = board.snapshot()
+    assert snap["('tpu', 'bucket_a')"]["state"] == "open"
+    assert snap["('cpu', 'bucket_a')"]["state"] == "closed"
+    assert board.transition_count() == 1
+
+
+def test_solve_retry_policy_matches_legacy_constants():
+    """The sweep drivers' escalation must stay exactly the historical
+    (2 x nIter, relax 0.4) so retried lanes keep their bit behavior."""
+    pol = SolveRetryPolicy.from_flag(True)
+    assert pol.enabled
+    assert pol.escalate(15) == (30, 0.4)
+    off = SolveRetryPolicy.from_flag(False)
+    assert not off.enabled
+    # passing a policy through the legacy flag argument round-trips
+    custom = SolveRetryPolicy(max_retries=1, iter_mult=3.0, relax=0.5)
+    assert SolveRetryPolicy.from_flag(custom) is custom
+    assert custom.escalate(10) == (30, 0.5)

@@ -8,12 +8,15 @@ fixed-shape executable both paths share), and one poisoned request —
 host-side raiser or in-graph NaN — never contaminates its batch-mates.
 """
 
+import threading
+import time
+
 import numpy as np
 import pytest
 
 from raft_tpu.designs import deep_spar, demo_semi
 from raft_tpu.model import Model
-from raft_tpu.serve import Engine, EngineConfig
+from raft_tpu.serve import TERMINAL_STATUSES, Engine, EngineConfig
 from raft_tpu.serve.buckets import (
     BucketSpec,
     choose_bucket,
@@ -175,3 +178,105 @@ def test_prep_memo_serves_repeat_designs(tmp_path):
         snap = eng.snapshot()
     assert snap["prep_memo_hits"] >= 1
     assert snap["dispatches"] == 2
+
+
+# ------------------------------------------------------- fault envelope
+
+def test_every_handle_reaches_exactly_one_terminal_status(tmp_path):
+    """Regression for the shutdown(wait=False) / result(timeout) audit:
+    handles left queued at a non-draining shutdown still resolve (with
+    ``status="shutdown"``), resolution is exactly-once (a second writer
+    is a counted no-op), and a result(timeout) expiry does not detach
+    the handle from that guarantee."""
+    from raft_tpu.serve.engine import RequestResult
+
+    d = _spar()
+    eng = _engine(tmp_path, window_ms=5000.0)   # window parks the queue
+    h1 = eng.submit(d)
+    h2 = eng.submit(_spar(1500.0))
+    # a result() expiry raises but leaves the handle pending
+    with pytest.raises(TimeoutError):
+        h1.result(timeout=0.01)
+    assert not h1.done()
+    eng.shutdown(wait=False, drain=False)
+    r1 = h1.result(timeout=30)
+    r2 = h2.result(timeout=30)
+    assert r1.status in TERMINAL_STATUSES
+    assert r2.status in TERMINAL_STATUSES
+    assert {r1.status, r2.status} == {"shutdown"}
+    # exactly-once: the first resolution won; later writers are no-ops
+    assert not h1._set(RequestResult(rid=h1.rid, status="ok"))
+    assert h1.result(0).status == "shutdown"
+    eng.shutdown(wait=True)
+    assert eng.snapshot()["outstanding"] == 0
+
+
+def test_submit_time_deadline_admission(tmp_path):
+    """Hopeless deadlines are rejected AT SUBMIT — deadline_s <= 0, or a
+    predicted queue wait (in-flight dispatch remainder) already past the
+    deadline — so they never occupy a queue slot."""
+    d = _spar()
+    with _engine(tmp_path, window_ms=50.0) as eng:
+        eng.evaluate(d, timeout=600)             # warm prep + executable
+        for bad in (0.0, -3.0):
+            h = eng.submit(d, deadline_s=bad)
+            assert h.done()                      # resolved synchronously
+            res = h.result(0)
+            assert res.status == "rejected_deadline"
+            assert "hopeless at submit" in res.error
+        # predicted-wait rejection: fake a dispatch 1 s into an EMA of
+        # 60 s — a 0.5 s deadline cannot be met, a 600 s one can
+        eng._ema_dispatch_s = 60.0
+        with eng._watch_lock:
+            eng._inflight = {"t0": time.perf_counter()}
+        try:
+            h = eng.submit(d, deadline_s=0.5)
+            assert h.done()
+            assert h.result(0).status == "rejected_deadline"
+            ok = eng.submit(d, deadline_s=600.0)
+            assert not ok.done()
+        finally:
+            with eng._watch_lock:
+                eng._inflight = None
+        assert ok.result(120).status == "ok"
+        snap = eng.snapshot()
+    assert snap["rejected_deadline"] == 3
+
+
+def test_concurrent_submits_race_one_engine(tmp_path):
+    """8 threads racing submit() on one engine: no lost or duplicated
+    handles, consistent stats, every request served."""
+    d = _spar()
+    n_threads, per_thread = 8, 4
+    with _engine(tmp_path, window_ms=20.0) as eng:
+        eng.evaluate(d, timeout=600)             # warm
+        handles, errors = [], []
+        lock = threading.Lock()
+
+        def hammer():
+            try:
+                mine = [eng.submit(d) for _ in range(per_thread)]
+                with lock:
+                    handles.extend(mine)
+            except Exception as e:  # noqa: BLE001 — surfaced via errors
+                with lock:
+                    errors.append(e)
+
+        threads = [threading.Thread(target=hammer)
+                   for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not errors
+        results = [h.result(300) for h in handles]
+        snap = eng.snapshot()
+    total = n_threads * per_thread
+    assert len(handles) == total
+    rids = {h.rid for h in handles}
+    assert len(rids) == total                    # no rid collisions
+    assert all(r.status == "ok" for r in results)
+    assert all(r.rid == h.rid for r, h in zip(results, handles))
+    assert snap["requests"] == total + 1         # + the warm request
+    assert snap["outstanding"] == 0
+    assert sum(eng.stats["batch_requests"]) == total + 1  # none lost

@@ -135,6 +135,44 @@ def test_stale_manifest_flags_refused(tmp_path):
     assert report["n_rejected"] == 0
 
 
+def test_corrupt_manifest_refused_with_logged_reason(tmp_path, caplog):
+    """A half-written/corrupt warm-up manifest (or schema-invalid
+    entries inside a valid one) must degrade warmup() to a cold start
+    with a logged reason — never crash the server."""
+    from raft_tpu.serve.cache import MANIFEST_NAME, WarmupManifest, warmup
+
+    path = os.path.join(str(tmp_path), "serve", MANIFEST_NAME)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+
+    # half-written JSON (a crash mid-write without the atomic rename)
+    with open(path, "w") as fh:
+        fh.write('{"entries": [{"spec": {"nw": 10, "n_no')
+    with caplog.at_level("WARNING", logger="raft_tpu"):
+        report = warmup(cache_dir=str(tmp_path))
+    assert report["n_warmed"] == 0
+    assert any("corrupt/half-written" in m for m in caplog.messages)
+
+    # valid JSON, wrong document shape
+    caplog.clear()
+    with open(path, "w") as fh:
+        json.dump(["not", "a", "manifest"], fh)
+    with caplog.at_level("WARNING", logger="raft_tpu"):
+        report = warmup(cache_dir=str(tmp_path))
+    assert report["n_warmed"] == 0
+    assert any("unexpected document shape" in m for m in caplog.messages)
+
+    # valid JSON, schema-invalid entry: skipped with a reason, and the
+    # manifest object itself refuses it on load
+    caplog.clear()
+    with open(path, "w") as fh:
+        json.dump({"entries": [{"spec": "not-a-dict"}]}, fh)
+    with caplog.at_level("WARNING", logger="raft_tpu"):
+        assert WarmupManifest(cache_dir=str(tmp_path)).load() == []
+        report = warmup(cache_dir=str(tmp_path))
+    assert report["n_warmed"] == 0
+    assert any("entry 0 refused" in m for m in caplog.messages)
+
+
 def test_prep_cache_refuses_and_deletes_corrupt_entries(tmp_path):
     import numpy as np
 
