@@ -47,6 +47,14 @@ Fault classes and their hook points:
                     garbage — the load path must refuse + delete it
 ==================  ======================================================
 
+Per-rid targeting caveat: the engine deduplicates prep per design key,
+so ``prep_raise``/``prep_slow`` intercept the rid that OWNS the prep
+(the first request to submit that design) — a request coalescing onto
+an in-flight prep is not intercepted, and if the shared prep raises the
+follower retries once with a fresh prep under its own rid rather than
+inheriting the owner's failure.  To target a specific rid, give it a
+design key of its own (the chaos matrix does).
+
 The injector NEVER activates without the env var; ``get_injector()``
 re-parses only when the env string changes, so one process-wide instance
 accounts all fires (``snapshot()`` feeds the engine stats).

@@ -268,7 +268,8 @@ class _Prepped:
 class _Entry:
     """One queued request: its handle plus the async prep future."""
 
-    __slots__ = ("req", "pend", "fut", "windowed", "grace_until")
+    __slots__ = ("req", "pend", "fut", "windowed", "grace_until",
+                 "prep_attempts")
 
     def __init__(self, req, pend, fut):
         self.req = req
@@ -276,6 +277,7 @@ class _Entry:
         self.fut = fut
         self.windowed = False      # has been through one batching window
         self.grace_until = None    # prep-straggler deadline, set at flush
+        self.prep_attempts = 1     # preps this entry has ridden on
 
 
 class Engine:
@@ -334,7 +336,8 @@ class Engine:
             "rejected_circuit": 0, "watchdog_timeout": 0,
             "watchdog_trips": 0, "dispatch_retries": 0,
             "shed_events": 0, "shed_recoveries": 0,
-            "prep_deferred": 0, "late_resolutions": 0,
+            "prep_deferred": 0, "prep_retries": 0,
+            "late_resolutions": 0,
             "shutdown_resolved": 0, "degraded_dispatches": 0,
             "latency_s": [], "occupancy": [],
             "batch_requests": [], "prep_cache_hits": 0,
@@ -464,7 +467,8 @@ class Engine:
             with self._lock:
                 self._outstanding.pop(pend.rid, None)
             return True
-        self.stats["late_resolutions"] += 1
+        with self._lock:
+            self.stats["late_resolutions"] += 1
         return False
 
     def _finalize_outstanding(self):
@@ -499,12 +503,20 @@ class Engine:
     def _submit_prep_locked(self, req):
         """Schedule host-side prep on the worker pool (deduplicated per
         design key); completion wakes the batcher.  Called under
-        self._lock."""
+        self._lock.
+
+        The future is tagged with the rid of the request that OWNS it
+        (initiated the prep); requests coalescing onto an in-flight
+        future are followers.  Chaos prep faults therefore intercept the
+        owner's rid only — a follower whose shared prep raised gets one
+        fresh prep of its own (``_serve_batch``) instead of inheriting
+        the owner's failure."""
         key = design_prep_key(req.design, req.cases, self.config.precision)
         fut = self._prep_futs.get(key)
         if fut is not None and not fut.done():
             return fut
         fut = self._prep_pool.submit(self._prepare, req)
+        fut.raft_owner_rid = req.rid
         self._prep_futs[key] = fut
         if len(self._prep_futs) > 4 * self._prep_memo_cap:
             self._prep_futs = {k: f for k, f in self._prep_futs.items()
@@ -520,7 +532,9 @@ class Engine:
     def _prepare(self, req):
         """Host-side prep with the three-level cache (in-process memo ->
         on-disk prep cache -> full Model build).  Chaos hooks: prep_raise
-        / prep_slow fire here, per request id."""
+        / prep_slow fire here, keyed on the rid of the request that owns
+        the (deduplicated) prep — coalesced followers are not
+        intercepted."""
         from raft_tpu.model import Model
 
         if self._chaos is not None:
@@ -619,6 +633,12 @@ class Engine:
         except Exception:  # pragma: no cover — last-ditch guard
             logger.exception("serve batcher crashed")
         finally:
+            # with the batcher gone, admission must close BEFORE the
+            # finalizer sweeps _outstanding: a submit() landing after the
+            # sweep would register a handle nobody will ever resolve
+            with self._lock:
+                self._stop = True
+                self._wake.notify_all()
             self._finalize_outstanding()
 
     def _stop_requested(self):
@@ -652,13 +672,17 @@ class Engine:
         after the grace (they dispatch when their prep completes, without
         holding anyone else up)."""
         grace = max(self.config.prep_wait_s, 0.0)
-        now = time.perf_counter()
         with self._lock:
-            for e in self._queue:
-                if e.grace_until is None:
-                    e.grace_until = now + grace
             while True:
                 now = time.perf_counter()
+                # _wake.wait() below releases the lock, so submit() can
+                # append fresh entries mid-flush with grace_until still
+                # None — start their grace the first time this flush
+                # sees them (comparing against None would TypeError and
+                # kill the batcher)
+                for e in self._queue:
+                    if e.grace_until is None:
+                        e.grace_until = now + grace
                 pending = [e for e in self._queue
                            if not e.fut.done() and now < e.grace_until]
                 if not pending or self._stop:
@@ -720,6 +744,26 @@ class Engine:
             try:
                 prepped = entry.fut.result(timeout=0)
             except Exception as e:  # noqa: BLE001 — quarantine prep faults
+                owner = getattr(entry.fut, "raft_owner_rid", req.rid)
+                if owner != req.rid and entry.prep_attempts < 2:
+                    # a FOLLOWER coalesced onto someone else's prep that
+                    # raised; the failure may be the owner's alone (e.g.
+                    # a chaos fault targeting the owner's rid) — give
+                    # the follower one fresh prep under its own rid
+                    with self._lock:
+                        if not self._stop:
+                            self.stats["prep_retries"] += 1
+                            entry.prep_attempts += 1
+                            entry.fut = self._submit_prep_locked(req)
+                            entry.grace_until = None
+                            self._queue.append(entry)
+                            self._wake.notify()
+                            logger.warning(
+                                "serve request %d: shared prep (owner "
+                                "rid %d) raised %s; retrying with a "
+                                "fresh prep", req.rid, owner,
+                                type(e).__name__)
+                            continue
                 self.stats["failed"] += 1
                 logger.warning(
                     "serve request %d quarantined: prep raised (%s: %s)",
@@ -987,6 +1031,7 @@ class Engine:
             "shed_events": self.stats["shed_events"],
             "shed_recoveries": self.stats["shed_recoveries"],
             "prep_deferred": self.stats["prep_deferred"],
+            "prep_retries": self.stats["prep_retries"],
             "late_resolutions": self.stats["late_resolutions"],
             "shutdown_resolved": self.stats["shutdown_resolved"],
             "degraded_dispatches": self.stats["degraded_dispatches"],

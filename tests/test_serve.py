@@ -280,3 +280,98 @@ def test_concurrent_submits_race_one_engine(tmp_path):
     assert snap["requests"] == total + 1         # + the warm request
     assert snap["outstanding"] == 0
     assert sum(eng.stats["batch_requests"]) == total + 1  # none lost
+
+
+def test_collect_batch_tolerates_entry_appended_mid_grace_wait(tmp_path):
+    """Regression: the grace-wait loop in _collect_batch releases the
+    lock, so submit() can append an entry whose grace_until is still
+    None; comparing ``now < None`` used to TypeError and kill the
+    batcher.  Such entries must instead get a grace of their own."""
+    from concurrent.futures import Future
+
+    from raft_tpu.serve.engine import Request, _Entry, _Pending
+
+    eng = _engine(tmp_path, prep_wait_s=0.2)
+    # retire the batcher thread so the test thread owns _collect_batch
+    with eng._lock:
+        eng._stop = True
+        eng._wake.notify_all()
+    eng._thread.join(10)
+    assert not eng._thread.is_alive()
+    eng._stop = False
+
+    def _entry(rid):
+        e = _Entry(Request(design={}, rid=rid,
+                           t_submit=time.perf_counter()),
+                   _Pending(rid), Future())     # prep never finishes
+        e.windowed = True
+        return e
+
+    straggler, latecomer = _entry(1), _entry(2)
+    eng._queue = [straggler]
+
+    def append_mid_wait():
+        time.sleep(0.1)                         # land inside the wait
+        with eng._lock:
+            eng._queue.append(latecomer)        # grace_until is None
+            eng._wake.notify_all()
+
+    t = threading.Thread(target=append_mid_wait)
+    t.start()
+    batch = eng._collect_batch()                # must not raise
+    t.join(10)
+    assert batch == []
+    assert straggler.grace_until is not None
+    assert latecomer.grace_until is not None
+    assert eng._queue == [straggler, latecomer]  # both deferred
+    eng.shutdown(wait=False, drain=False)
+
+
+def test_batcher_crash_closes_admission_and_finalizes(tmp_path, monkeypatch):
+    """Regression: if the batcher thread dies through its last-ditch
+    guard, the engine must stop admitting — submit() raises instead of
+    registering handles nobody will resolve — and every handle already
+    outstanding still reaches a terminal status."""
+    eng = _engine(tmp_path, window_ms=1.0)
+    monkeypatch.setattr(eng, "_prepare", lambda req: None)
+
+    def boom():
+        raise RuntimeError("injected batcher crash")
+
+    monkeypatch.setattr(eng, "_collect_batch", boom)
+    h = eng.submit(_spar())
+    res = h.result(timeout=30)
+    assert res.status == "shutdown"
+    eng._thread.join(10)
+    assert not eng._thread.is_alive()
+    with pytest.raises(RuntimeError, match="shut down"):
+        eng.submit(_spar())
+    assert eng.snapshot()["outstanding"] == 0
+
+
+def test_coalesced_follower_retries_failed_shared_prep(tmp_path, monkeypatch):
+    """Prep futures are deduplicated per design key; when a shared prep
+    raises, only the OWNING request inherits the failure — a coalesced
+    follower is retried once with a fresh prep under its own rid."""
+    d = _spar()
+    with _engine(tmp_path, window_ms=20.0) as eng:
+        orig_prepare = eng._prepare
+        calls = []
+
+        def flaky(req):
+            calls.append(req.rid)
+            if len(calls) == 1:
+                time.sleep(0.2)        # keep the future in flight so
+                raise KeyError("boom")  # the second submit coalesces
+            return orig_prepare(req)
+
+        monkeypatch.setattr(eng, "_prepare", flaky)
+        h1 = eng.submit(d)             # rid 1: prep owner
+        h2 = eng.submit(d)             # rid 2: same key -> follower
+        r1, r2 = h1.result(600), h2.result(600)
+        snap = eng.snapshot()
+    assert r1.status == "failed" and "KeyError" in r1.error
+    assert r2.status == "ok"
+    assert snap["failed"] == 1
+    assert snap["prep_retries"] == 1
+    assert calls == [1, 2]             # fresh prep ran under rid 2
