@@ -83,12 +83,47 @@ _COMPACT_KEYS = (
     "serve_cold_first_s", "serve_warm_first_s",
     "serve_rejected_overload", "serve_watchdog_trips",
     "serve_breaker_transitions",
+    "kernel_backend_mode", "kernel_gj6_speedup",
+    "kernel_gj6_max_abs_diff", "kernel_gjstage_speedup",
+    "kernel_gjstage_max_abs_diff",
+    "sweep_cold_start_s", "sweep_warm_start_s", "sweep_warm_vs_cold",
     "rao_error", "sweep_error", "sweep243_error", "bem_error",
     "bem_sharded_error", "grad_error", "serve_error",
-    "chaos_smoke_error",
+    "chaos_smoke_error", "kernel_error", "sweep_warm_error",
     "perf_docs_error", "sweep_scaling_error", "sweep1024_error",
     "sweep4096_error",
 )
+
+
+def _looks_like_exception(value):
+    """Whether a value reads as a Python exception message: a dotted
+    CamelCase head ending in Error/Exception/Timeout/Interrupt before the
+    first colon, or an embedded traceback."""
+    if not isinstance(value, str):
+        return False
+    if "Traceback (most recent call last)" in value:
+        return True
+    head, sep, _ = value.partition(":")
+    head = head.strip()
+    return bool(
+        sep
+        and head.replace(".", "").replace("_", "").isidentifier()
+        and head.endswith(("Error", "Exception", "Timeout", "Interrupt"))
+    )
+
+
+def _sanitize_schema(out):
+    """Bench-output schema rule: exception strings may only live under
+    ``*_error`` keys.  Any metric whose value looks like an exception
+    message is moved to ``<key>_error`` before it reaches disk — a
+    section bug can mark itself failed, but it can never persist an
+    exception string where downstream readers (PERF.md generation, the
+    driver line, regression diffs) expect a number (the r04
+    ``bem_error`` shape of failure, generalized away)."""
+    for key in [k for k in out if not k.endswith("_error")]:
+        if _looks_like_exception(out[key]):
+            out[f"{key}_error"] = out.pop(key)
+    return out
 
 
 def _write_full(out, path=None):
@@ -96,6 +131,7 @@ def _write_full(out, path=None):
     after EVERY section so an external `timeout` kill loses at most the
     section in flight, never the file (VERDICT r5 top_next)."""
     path = path or BENCH_FULL
+    _sanitize_schema(out)
     tmp = path + ".tmp"
     with open(tmp, "w") as fh:
         json.dump(out, fh, indent=1)
@@ -249,9 +285,27 @@ def main(argv=None):
     if args.smoke:
         sections = [("smoke", bench_smoke),
                     ("serve_smoke", bench_serve_smoke),
-                    ("chaos_smoke", bench_chaos_smoke)]
+                    ("chaos_smoke", bench_chaos_smoke),
+                    ("kernel", lambda: bench_kernels(
+                        gj6_batch=128, stage_n=128, stage_block=64,
+                        stage_m=4))]
     else:
+        import jax
+
         import bench_sweep
+
+        # the 1024/4096-design scaling knee is a TPU-scale figure: on a
+        # CPU round its cold compiles+executions are single XLA calls of
+        # tens of minutes that even the SIGALRM watchdog cannot cut
+        # (delivery waits for the C call) — the exact shape of the r05
+        # rc=124 loss.  Record a structured skip instead of hanging.
+        cpu_round = jax.default_backend() == "cpu"
+        run_scaling = (
+            (lambda: {"sweep_scaling_error":
+                      "skipped: 1024/4096-design scaling is a TPU-scale "
+                      "figure (CPU round)"})
+            if cpu_round
+            else (lambda: bench_sweep.run_scaling(verbose=False)))
 
         sections = [
             # headline first: whatever the budget kills later, the
@@ -275,8 +329,7 @@ def main(argv=None):
             ("rao", bench_rao, 1.0),
             ("sweep", lambda: bench_sweep.run(baseline_limit=16,
                                               verbose=False), 10.0),
-            ("sweep_scaling", lambda: bench_sweep.run_scaling(
-                verbose=False), 1.5),
+            ("sweep_scaling", run_scaling, 1.5),
             ("sweep243", lambda: bench_sweep.run_geometry(
                 baseline_limit=8, verbose=False), 4.0),
             ("bem", bench_bem, 3.0),
@@ -284,6 +337,8 @@ def main(argv=None):
             ("bem_stream", bench_bem_stream, 1.5),
             ("grad", bench_gradients, 1.0),
             ("serve", bench_serve, 2.0),
+            ("kernel", bench_kernels, 1.0),
+            ("sweep_warm", bench_sweep_warm, 2.0),
         ]
 
     out = {}
@@ -368,20 +423,26 @@ def bench_rao():
     pipe = model.case_pipeline_fn()
     dev = dev_args
 
+    # carry dtype follows the pipeline output (f32 on TPU, f64 on a CPU
+    # x64 run) — a hard-coded f32 carry trips the scan dtype check on
+    # the CPU round
+    c_dtype = out[0].dtype
+
     def repeat(c0):
         def body(c, _):
-            o = pipe(dev[0] + c * jax.numpy.float32(1e-30), *dev[1:])
-            return o[0][0, 0, 0], None
+            o = pipe(dev[0] + c * jax.numpy.asarray(1e-30, c_dtype),
+                     *dev[1:])
+            return o[0][0, 0, 0].astype(c_dtype), None
         c, _ = jax.lax.scan(body, c0, None, length=K)
         return c
 
     rfn = jax.jit(repeat)
-    o = rfn(jax.numpy.float32(0.0))
+    o = rfn(jax.numpy.asarray(0.0, c_dtype))
     jax.block_until_ready(o)
     ts = []
     for _ in range(3):
         t0 = time.perf_counter()
-        o = rfn(jax.numpy.float32(0.0))
+        o = rfn(jax.numpy.asarray(0.0, c_dtype))
         jax.block_until_ready(o)
         ts.append(time.perf_counter() - t0)
     t_per_solve = min(ts) / K
@@ -843,6 +904,185 @@ def bench_chaos_smoke():
     }
 
 
+# ----------------------------------------------------------------- kernels
+
+def bench_kernels(gj6_batch=1536, stage_n=512, stage_block=128,
+                  stage_m=8):
+    """A/B microbench of the hand-written Pallas solve kernels against
+    the XLA reference paths they replace, on IDENTICAL operands: the
+    batched 12x12 Gauss-Jordan solve (the real-block 6x6 dynamics core)
+    and one banded staged-GJ elimination stage (the BEM solver core).
+    Records best-of-3 jitted wall times for both paths plus the max
+    |delta| between their results.  Off-TPU the kernels run in Pallas
+    interpret mode (op-by-op emulation), so speedup < 1 is expected and
+    honest there — ``kernel_backend_mode`` records which figure this
+    is."""
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.bem_solver import _gj_stage
+    from raft_tpu.dynamics import gauss_solve
+    from raft_tpu.pallas_kernels import (
+        HAVE_PALLAS, gauss_solve_pallas, gj_stage_pallas)
+
+    if not HAVE_PALLAS:
+        return {"kernel_backend_mode": "unavailable"}
+    mode = ("mosaic" if jax.default_backend() == "tpu" else "interpret")
+    rng = np.random.default_rng(7)
+
+    def ab(ref_fn, ker_fn, args):
+        args = tuple(jnp.asarray(a) for a in args)
+        ref = jax.jit(ref_fn)
+        ker = jax.jit(ker_fn)
+        r0 = jax.block_until_ready(ref(*args))      # compile outside the
+        k0 = jax.block_until_ready(ker(*args))      # timed region
+
+        def best(fn):
+            return min(
+                _timed(lambda: jax.block_until_ready(fn(*args)))
+                for _ in range(3))
+
+        diff = max(
+            float(jnp.max(jnp.abs(a - b)))
+            for a, b in zip(jax.tree.leaves(r0), jax.tree.leaves(k0)))
+        return best(ref), best(ker), diff
+
+    n = 12
+    A = rng.normal(size=(gj6_batch, n, n)) + n * np.eye(n)
+    b = rng.normal(size=(gj6_batch, n, 1))
+    t_x6, t_p6, d6 = ab(gauss_solve, gauss_solve_pallas, (A, b))
+
+    As = rng.normal(size=(stage_n, stage_n)) + stage_n * np.eye(stage_n)
+    bs = rng.normal(size=(stage_n, stage_m))
+    nblk = stage_n // stage_block
+    t_xs, t_ps, ds = ab(
+        lambda A_, b_: _gj_stage(A_, b_, 0, nblk, block=stage_block),
+        lambda A_, b_: gj_stage_pallas(A_, b_, 0, nblk,
+                                       block=stage_block),
+        (As, bs))
+    return {
+        "kernel_backend_mode": mode,
+        "kernel_gj6_batch": int(gj6_batch),
+        "kernel_gj6_xla_s": round(t_x6, 5),
+        "kernel_gj6_pallas_s": round(t_p6, 5),
+        "kernel_gj6_speedup": round(t_x6 / max(t_p6, 1e-9), 3),
+        "kernel_gj6_max_abs_diff": d6,
+        "kernel_gjstage_n": int(stage_n),
+        "kernel_gjstage_xla_s": round(t_xs, 5),
+        "kernel_gjstage_pallas_s": round(t_ps, 5),
+        "kernel_gjstage_speedup": round(t_xs / max(t_ps, 1e-9), 3),
+        "kernel_gjstage_max_abs_diff": ds,
+    }
+
+
+def _timed(fn):
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+# -------------------------------------------------------------- sweep warm
+
+# Runs in a FRESH interpreter (the warm-start claim is about a new
+# process, not a hot one): the cold phase runs a small bucket-routed
+# design sweep against an empty cache dir — recording the buckets it
+# touches in the serve warm-up manifest and persisting their executables
+# — and the warm phase replays that manifest via serve ``warmup()``
+# before running the SAME sweep.  sweep_warm_start_s = warm-up wall +
+# sweep wall is the fresh-process time-to-first-sweep-result with a
+# warmed cache (ISSUE 7 acceptance metric).
+_SWEEP_WARM_SCRIPT = """
+import sys, os, json, time
+sys.path.insert(0, os.environ["RAFT_TPU_BENCH_ROOT"])
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import raft_tpu
+from raft_tpu.designs import deep_spar
+from raft_tpu.serve.cache import warmup
+
+phase = sys.argv[1]
+t_start = time.perf_counter()
+rep = {"n_warmed": 0, "persistent_cache_hits": 0}
+if phase == "warm":
+    rep = warmup(cache_dir=os.environ["RAFT_TPU_CACHE_DIR"])
+t_warmup = time.perf_counter() - t_start
+
+from raft_tpu.sweep_fused import run_design_sweep
+
+designs = []
+for i in range(2):
+    d = deep_spar(n_cases=3, nw_settings=(0.025, 0.6))
+    d["platform"]["members"][0]["rho_fill"] = [1700.0 + 40.0 * i,
+                                               0.0, 0.0]
+    designs.append(d)
+t0 = time.perf_counter()
+res = run_design_sweep(designs, group=2, verbose=False,
+                       retry_nonconverged=False, via_buckets=True)
+t_sweep = time.perf_counter() - t0
+assert np.isfinite(res["std"]).all()
+print("RESULT " + json.dumps({
+    "sweep_s": t_sweep,
+    "warmup_s": t_warmup,
+    "warmed": int(rep.get("n_warmed", 0) or 0),
+    "cache_hits": int(rep.get("persistent_cache_hits", 0) or 0),
+}))
+"""
+
+
+def _sweep_warm_phase(phase, cache_dir):
+    import subprocess
+    import tempfile
+
+    with tempfile.NamedTemporaryFile(
+            "w", suffix=".py", delete=False) as fh:
+        fh.write(_SWEEP_WARM_SCRIPT)
+        script = fh.name
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    env["RAFT_TPU_CACHE_DIR"] = cache_dir
+    env["RAFT_TPU_BENCH_ROOT"] = _ROOT
+    try:
+        proc = subprocess.run(
+            [sys.executable, script, phase], capture_output=True,
+            text=True, timeout=560, env=env)
+        line = [ln for ln in proc.stdout.splitlines()
+                if ln.startswith("RESULT ")]
+        if proc.returncode != 0 or not line:
+            raise RuntimeError(
+                f"sweep_warm {phase} phase failed: {proc.stderr[-800:]}")
+        return json.loads(line[-1][len("RESULT "):])
+    finally:
+        os.unlink(script)
+
+
+def bench_sweep_warm():
+    """Sweep warm start through the serve bucket manifest, across fresh
+    CPU interpreters: cold phase seeds the manifest + persistent cache
+    from an empty dir, warm phase replays it then sweeps.  The recorded
+    ``sweep_warm_start_s`` (warm-up + sweep wall in the fresh process)
+    is the ISSUE 7 acceptance figure against the historical 389 s
+    cold-trace sweep start."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as cache_dir:
+        cold = _sweep_warm_phase("cold", cache_dir)
+        warm = _sweep_warm_phase("warm", cache_dir)
+    t_warm = warm["warmup_s"] + warm["sweep_s"]
+    return {
+        "sweep_cold_start_s": round(cold["sweep_s"], 3),
+        "sweep_warm_start_s": round(t_warm, 3),
+        "sweep_warmup_s": round(warm["warmup_s"], 3),
+        "sweep_warm_sweep_s": round(warm["sweep_s"], 3),
+        "sweep_warm_buckets": warm["warmed"],
+        "sweep_warm_cache_hits": warm["cache_hits"],
+        "sweep_warm_vs_cold": round(
+            cold["sweep_s"] / max(t_warm, 1e-9), 2),
+    }
+
+
 # --------------------------------------------------------------- perf docs
 
 def compact_results(out):
@@ -993,6 +1233,27 @@ def perf_md_text(d):
             f"{_fmt(d.get('serve_warm_first_vs_steady', 0.0))}× its "
             "steady-state latency)",
         )
+    if "kernel_gj6_speedup" in d:
+        row(
+            "hand-written Pallas solve kernels, A/B vs XLA on identical "
+            f"operands ({d.get('kernel_backend_mode', '?')} mode)",
+            f"batched 12×12 GJ solve {_fmt(d['kernel_gj6_speedup'])}× "
+            f"(max |Δ| {d.get('kernel_gj6_max_abs_diff', 0.0):.1e}), "
+            "blocked GJ stage "
+            f"{_fmt(d.get('kernel_gjstage_speedup', 0.0))}× "
+            f"(max |Δ| {d.get('kernel_gjstage_max_abs_diff', 0.0):.1e})",
+        )
+    if "sweep_warm_start_s" in d:
+        row(
+            "**sweep warm start through the serve bucket manifest "
+            "(fresh process)**",
+            f"**cold {_fmt(d.get('sweep_cold_start_s'))} s → warm "
+            f"{_fmt(d['sweep_warm_start_s'])} s "
+            f"({_fmt(d.get('sweep_warm_vs_cold', 0.0), 1)}×)**; "
+            f"{d.get('sweep_warm_buckets', 0)} bucket(s) replayed, "
+            f"{d.get('sweep_warm_cache_hits', 0)} persistent-cache "
+            "hit(s)",
+        )
 
     lines = [
         "# PERF — measured numbers (generated)",
@@ -1022,12 +1283,14 @@ def readme_headline_text(d):
     """The README's generated performance sentence."""
     sweep = d.get("sweep_vs_baseline")
     pipe = d.get("vs_baseline_pipelined")
+    where = ("on one TPU chip" if d.get("backend") == "tpu"
+             else f"on the {d.get('backend', 'host')} backend")
     parts = []
     if sweep:
         parts.append(
             f"the fused 256-design × 12-case VolturnUS-S sweep with the "
             f"full aero-servo physics in both paths measures "
-            f"**{sweep:.0f}×** a serial NumPy baseline on one TPU chip"
+            f"**{sweep:.0f}×** a serial NumPy baseline {where}"
         )
     if pipe:
         parts.append(

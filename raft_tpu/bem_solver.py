@@ -251,9 +251,19 @@ def _gj_stage(A, b, kb0, nblk, block=512):
     ``kb0``) of the blocked Gauss-Jordan on the in-progress system
     ``(A, b)``.  ``kb0``/``nblk`` may be traced scalars, so ONE compiled
     executable serves every stage of a staged (multi-dispatch)
-    elimination — the streamed path's solve-stage banding."""
+    elimination — the streamed path's solve-stage banding.
+
+    With ``RAFT_TPU_PALLAS`` set (default off) the stage routes through
+    the hand-written Pallas kernels (raft_tpu/pallas_kernels.py:
+    in-VMEM pivot-tile inversion + tiled matmul-subtract updates);
+    otherwise this generic XLA body runs bit-for-bit unchanged."""
     import jax
     import jax.numpy as jnp
+
+    from raft_tpu.pallas_kernels import gj_stage_pallas, pallas_enabled
+
+    if pallas_enabled():
+        return gj_stage_pallas(A, b, kb0, nblk, block=block)
 
     n = A.shape[0]
     m = b.shape[1]

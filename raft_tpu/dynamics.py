@@ -44,6 +44,7 @@ from raft_tpu.health import (
     TIER_TIKHONOV,
 )
 from raft_tpu.hydro import linearized_drag
+from raft_tpu.precision import mixed_precision_enabled, mp_round
 
 
 def _gj_step(i, M, idx):
@@ -140,12 +141,26 @@ def solve_complex_6x6(Zr, Zi, Fr, Fi, refine=1):
     refine : iterative-refinement steps (cheap; recovers ~2 digits in f32).
     """
     A, b = _block_system(Zr, Zi, Fr, Fi)
-    x = gauss_solve(A, b)
+    solve = _solve_dispatch()
+    x = solve(A, b)
     for _ in range(refine):
         r = b - A @ x
-        x = x + gauss_solve(A, r)
+        x = x + solve(A, r)
     x = x[..., 0]
     return x[..., :6], x[..., 6:]
+
+
+def _solve_dispatch():
+    """The batched dense solve for the RAO hot loop: the hand-written
+    Pallas elimination kernel when ``RAFT_TPU_PALLAS`` requests it
+    (interpret mode off-TPU, so CPU tier-1 runs the kernel body), the
+    generic XLA :func:`gauss_solve` otherwise.  Only this hot-loop
+    entry dispatches — the recovery ladder
+    (:func:`solve_complex_6x6_ladder`) always uses the baseline path,
+    so tier selection never changes arithmetic under recovery."""
+    from raft_tpu.pallas_kernels import gauss_solve_pallas, pallas_enabled
+
+    return gauss_solve_pallas if pallas_enabled() else gauss_solve
 
 
 def solve_complex_6x6_ladder(Zr, Zi, Fr, Fi, refine=1, resid_tol=None,
@@ -234,12 +249,19 @@ def solve_complex_6x6_ladder(Zr, Zi, Fr, Fi, refine=1, resid_tol=None,
     return x[..., :6], x[..., 6:], residual, cond, tier
 
 
-def assemble_impedance(w, M, B, C):
+def assemble_impedance(w, M, B, C, mp=False):
     """Z(w) = -w^2 M + i w B + C as (real, imag) parts.
 
     w : [nw]; M, B : [nw, 6, 6]; C : [6, 6] or [nw, 6, 6]
+    mp : mixed-precision operand rounding (bf16 matrix operands, full-
+        precision arithmetic — see raft_tpu/precision.py); ``False`` is
+        the exact baseline expression.
     """
     w2 = (w * w)[:, None, None]
+    if mp:
+        Zr = -w2 * mp_round(M) + mp_round(C)
+        Zi = w[:, None, None] * mp_round(B)
+        return Zr, Zi
     Zr = -w2 * M + C
     Zi = w[:, None, None] * B
     return Zr, Zi
@@ -295,10 +317,18 @@ def solve_dynamics(
     XiLast = jnp.full((6, nw), XiStart, dtype=cdtype)
     Xi0 = jnp.zeros((6, nw), dtype=cdtype)
 
-    def assemble(XiL):
-        B_drag, F_drag = linearized_drag(nodes, XiL, u, w, dw, rho)
+    # mixed precision (RAFT_TPU_MIXED_PRECISION, default off — read at
+    # trace time): bf16-operand assembly inside the fixed point; the
+    # final re-solve below shadows it with a full-precision assembly and
+    # degraded lanes fall back to it (raft_tpu/precision.py)
+    mp = mixed_precision_enabled()
+
+    def assemble(XiL, full_precision=False):
+        use_mp = mp and not full_precision
+        B_drag, F_drag = linearized_drag(nodes, XiL, u, w, dw, rho,
+                                         mp=use_mp)
         B_tot = B_lin + B_drag[None, :, :]
-        Zr, Zi = assemble_impedance(w, M_lin, B_tot, C_lin)
+        Zr, Zi = assemble_impedance(w, M_lin, B_tot, C_lin, mp=use_mp)
         F = F_drag + (F_lin_r + 1j * F_lin_i).astype(cdtype)  # [nw, 6]
         return Zr, Zi, F
 
@@ -357,6 +387,24 @@ def solve_dynamics(
     xr_c, xi_c, resid, cond_est, tier = solve_complex_6x6_ladder(
         Zr, Zi, jnp.real(F), jnp.imag(F), refine=refine
     )
+    if mp:
+        # automatic fall-back-to-full-precision: any frequency lane the
+        # ladder escalated past baseline, or whose condition estimate
+        # exceeds the f32 ladder threshold, takes the answer from a
+        # full-precision shadow assembly+ladder at the same
+        # linearization point (one extra assembly — the fixed point
+        # already amortized the mixed-precision speedup)
+        Zr_f, Zi_f, F_f = assemble(XiPoint, full_precision=True)
+        xr_f, xi_f, resid_f, cond_f, tier_f = solve_complex_6x6_ladder(
+            Zr_f, Zi_f, jnp.real(F_f), jnp.imag(F_f), refine=refine
+        )
+        eps32 = float(np.finfo(np.float32).eps)
+        degraded = (tier != TIER_BASELINE) | (cond_est > 0.02 / eps32)
+        xr_c = jnp.where(degraded[..., None], xr_f, xr_c)
+        xi_c = jnp.where(degraded[..., None], xi_f, xi_c)
+        resid = jnp.where(degraded, resid_f, resid)
+        cond_est = jnp.where(degraded, cond_f, cond_est)
+        tier = jnp.where(degraded, tier_f, tier)
     Xi_cand = (xr_c + 1j * xi_c).T                             # [6, nw]
     cand_ok = jnp.all(jnp.isfinite(Xi_cand))
     # if even the ladder's last tier is non-finite (e.g. NaN node inputs),

@@ -16,6 +16,7 @@ CPU); complex arrays never cross the jit boundary.
 
 import jax.numpy as jnp
 
+from raft_tpu.precision import mp_masked_sum, mp_matmul
 from raft_tpu.utils.frames import translate_matrix_3to6
 from raft_tpu.waves import jonswap
 
@@ -37,9 +38,14 @@ def make_wave_spectrum(w, spectrum, height, period, dtype=None):
     )
 
 
-def _sum_matrix_3to6(Amat, r, mask):
-    """sum_n translate_matrix_3to6(Amat[n], r[n]) over masked nodes -> [6,6]."""
+def _sum_matrix_3to6(Amat, r, mask, mp=False):
+    """sum_n translate_matrix_3to6(Amat[n], r[n]) over masked nodes -> [6,6].
+
+    mp=True: bf16 operands / f32 accumulation (raft_tpu/precision.py);
+    the default is the exact baseline reduction."""
     A6 = translate_matrix_3to6(Amat, r)          # [N, 6, 6]
+    if mp:
+        return mp_masked_sum(A6, mask[:, None, None], axis=0)
     return jnp.sum(jnp.where(mask[:, None, None], A6, 0.0), axis=0)
 
 
@@ -70,29 +76,37 @@ def added_mass_morison(nodes, rho):
     return _sum_matrix_3to6(side + end, nodes.r, nodes.strip_mask)
 
 
-def excitation_froude_krylov(nodes, u, ud, pDyn, rho):
+def excitation_froude_krylov(nodes, u, ud, pDyn, rho, mp=False):
     """Wave inertial (Froude–Krylov + dynamic pressure) excitation
     F_hydro_iner[nw, 6] (reference raft/raft_fowt.py:548-591).
 
     u, ud : [N, 3, nw] wave kinematics at nodes; pDyn : [N, nw].
+    mp : bf16-operand / f32-accumulate inertia contraction
+        (raft_tpu/precision.py); default is the exact baseline einsum.
     """
     Imat = rho * nodes.v_side[:, None, None] * (
         (1.0 + nodes.Ca_p1)[:, None, None] * nodes.p1Mat
         + (1.0 + nodes.Ca_p2)[:, None, None] * nodes.p2Mat
     )
     ImatE = rho * nodes.v_end[:, None, None] * nodes.Ca_End[:, None, None] * nodes.qMat
-    f3 = jnp.einsum("nij,njw->niw", (Imat + ImatE).astype(ud.dtype), ud)
+    if mp:
+        f3 = mp_matmul("nij,njw->niw", Imat + ImatE, ud)
+    else:
+        f3 = jnp.einsum("nij,njw->niw", (Imat + ImatE).astype(ud.dtype), ud)
     # dynamic pressure on end/taper areas, along the member axis
     f3 = f3 + pDyn[:, None, :] * (nodes.a_end[:, None] * nodes.q)[..., None]
     return _sum_force_3to6(f3, nodes.r, nodes.strip_mask)
 
 
-def linearized_drag(nodes, Xi, u, w, dw, rho):
+def linearized_drag(nodes, Xi, u, w, dw, rho, mp=False):
     """Amplitude-dependent stochastic drag linearization
     (reference raft/raft_fowt.py:595-703, HOT LOOP #2).
 
     Xi : [6, nw] complex platform motion amplitudes
     u  : [N, 3, nw] wave velocity at nodes
+    mp : bf16-operand / f32-accumulate contractions for the 3->6 matrix
+        sum and the drag-excitation einsum (raft_tpu/precision.py);
+        default is the exact baseline arithmetic.
     Returns (B_drag[6,6] real, F_drag[nw,6] complex).
 
     Reference quirks reproduced:
@@ -149,7 +163,10 @@ def linearized_drag(nodes, Xi, u, w, dw, rho):
         + Bp1[:, None, None] * nodes.p1Mat
         + Bp2[:, None, None] * nodes.p2Mat
     )                                                   # [N, 3, 3]
-    B_drag = _sum_matrix_3to6(Bmat, nodes.r, nodes.submerged)
-    f3 = jnp.einsum("nij,njw->niw", Bmat.astype(u.dtype), u)
+    B_drag = _sum_matrix_3to6(Bmat, nodes.r, nodes.submerged, mp=mp)
+    if mp:
+        f3 = mp_matmul("nij,njw->niw", Bmat, u)
+    else:
+        f3 = jnp.einsum("nij,njw->niw", Bmat.astype(u.dtype), u)
     F_drag = _sum_force_3to6(f3, nodes.r, nodes.submerged)
     return B_drag, F_drag

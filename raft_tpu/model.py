@@ -34,6 +34,7 @@ from raft_tpu.hydro import (
     make_wave_spectrum,
 )
 from raft_tpu.dynamics import solve_dynamics
+from raft_tpu.precision import mixed_precision_enabled
 from raft_tpu.health import (
     apply_debug_nans,
     log_report,
@@ -136,7 +137,9 @@ def make_case_dynamics(w, k, depth, rho, g, XiStart, nIter, dtype, cdtype,
                 zeta.astype(cdtype), beta, w, k, depth, nodes.r,
                 rho=rho, g=g, dtype=cdtype,
             )
-            F_iner = excitation_froude_krylov(nodes, u, ud, pD, rho)  # [nw,6]
+            F_iner = excitation_froude_krylov(
+                nodes, u, ud, pD, rho, mp=mixed_precision_enabled()
+            )  # [nw,6]
             Fr = jnp.real(F_iner) + F_add_r
             Fi = jnp.imag(F_iner) + F_add_i
             xr, xi, report = solve_dynamics(

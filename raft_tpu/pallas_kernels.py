@@ -1,0 +1,289 @@
+"""Hand-written Pallas TPU kernels for the solve core.
+
+Two hot spots get hand-pipelined kernels (the recipe of the
+high-resolution-imaging-on-TPUs line of work, arXiv:1912.08063: keep the
+working set in VMEM, feed the MXU from explicit tiles, avoid the
+gather/scatter lowerings XLA picks for generic linear algebra):
+
+ - :func:`gauss_solve_pallas` — the batched augmented Gauss-Jordan
+   behind the 12x12 real-block complex 6x6 solve
+   (:func:`raft_tpu.dynamics.gauss_solve`).  One kernel invocation runs
+   the full n-step elimination on a [tile, n, n+1] batch block resident
+   in VMEM, so the per-step argmax/swap/eliminate round trips to HBM
+   that the XLA lowering pays (n dispatch boundaries per solve) collapse
+   into a single fused loop.  Pivot selection, row swap, and pivot-row
+   extraction are mask/one-hot reductions (no gathers — 1-D gathers are
+   the slowest path on the TPU vector unit and ``jnp.take_along_axis``
+   is unsupported in Pallas TPU lowering).
+ - :func:`gj_stage_pallas` — the blocked banded Gauss-Jordan stage of
+   the BEM solve (:func:`raft_tpu.bem_solver._gj_stage`).  The full
+   [2N, 2N] operator exceeds VMEM for every mesh the blocked path
+   exists for, so the stage stays a JAX-level ``fori_loop`` over pivot
+   blocks and the three dense pieces inside each step become kernels:
+   in-VMEM pivot-tile inversion (:func:`tile_inv_pallas` — a whole
+   [block, 2*block] augmented elimination per call; ``jnp.linalg.inv``
+   has no Pallas equivalent), and VMEM-tiled matmul / matmul-subtract
+   updates (:func:`mm_pallas` / :func:`mm_sub_pallas`) for the row
+   scaling and the rank-``block`` elimination update.
+
+Dispatch contract (the safety half of the ISSUE):
+
+ - everything here sits behind ``RAFT_TPU_PALLAS`` (default OFF).  With
+   the flag unset, the callers' existing XLA paths run untouched —
+   bit-for-bit, including the health ladder's tiers, which NEVER route
+   through these kernels regardless of the flag (tier selection must
+   not change arithmetic under recovery);
+ - off-TPU the kernels run in interpret mode (``interpret=True``), so
+   the CPU tier-1 suite executes the exact kernel bodies and
+   parity-tests them against the XLA reference implementations
+   (tests/test_kernels.py; enforced for every future kernel module by
+   tests/test_pallas_parity_registered.py).
+
+Numerics: :func:`gauss_solve_pallas` mirrors ``_gj_step``'s partial
+pivoting step for step, so it agrees with the reference to roundoff
+(one-hot masked reductions replace gathers; adding exact zeros changes
+no values, but reduction order inside XLA vs the kernel may differ by
+ulps).  :func:`tile_inv_pallas` is a Gauss-Jordan inverse with partial
+pivoting — a *different* (and more pivot-robust) algorithm than the
+LAPACK/XLA LU inverse it replaces, so stage parity is tolerance-level,
+not bitwise; the acceptance gate is the solver-level relative-residual
+check in the parity tests.
+"""
+
+import os
+from functools import lru_cache
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+    HAVE_PALLAS = True
+except ImportError:  # pragma: no cover - pallas ships with jax>=0.4
+    pl = None
+    HAVE_PALLAS = False
+
+_TRUTHY = ("1", "true", "on", "yes")
+
+
+def pallas_enabled():
+    """Whether ``RAFT_TPU_PALLAS`` routes the solve core through the
+    hand-written kernels.  Default off: the generic XLA paths are the
+    production fallback and stay bit-for-bit unchanged."""
+    return HAVE_PALLAS and os.environ.get(
+        "RAFT_TPU_PALLAS", ""
+    ).strip().lower() in _TRUTHY
+
+
+def _interpret():
+    """Interpret mode off-TPU: the kernels execute as reference Python/
+    XLA on CPU so tier-1 parity tests run the real kernel bodies."""
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------- batched GJ
+
+def _gj_elim_body(M, i):
+    """One masked Gauss-Jordan elimination step on the augmented batch
+    ``M [TB, n, m]`` — the kernel-side mirror of
+    :func:`raft_tpu.dynamics._gj_step`, with every gather replaced by a
+    one-hot masked reduction (TPU vector units hate gathers; summing a
+    single-nonzero mask product is exact)."""
+    TB, n, m = M.shape
+    ridx = jax.lax.broadcasted_iota(jnp.int32, (TB, n), 1)
+    cmask = jax.lax.broadcasted_iota(jnp.int32, (TB, n, m), 2) == i
+    col = jnp.sum(jnp.where(cmask, M, 0.0), axis=-1)        # M[:, :, i]
+    colmag = jnp.where(ridx < i, -jnp.inf, jnp.abs(col))
+    p = jnp.argmax(colmag, axis=-1)                          # pivot row
+    is_p = ridx == p[:, None]
+    is_i = ridx == i
+    rp = jnp.sum(jnp.where(is_p[:, :, None], M, 0.0), axis=1)  # [TB, m]
+    ri = jnp.sum(jnp.where(is_i[:, :, None], M, 0.0), axis=1)
+    M = jnp.where(is_i[:, :, None], rp[:, None, :],
+                  jnp.where(is_p[:, :, None], ri[:, None, :], M))
+    pmask = jax.lax.broadcasted_iota(jnp.int32, (TB, m), 1) == i
+    piv = jnp.sum(jnp.where(pmask, rp, 0.0), axis=-1)        # rp[i]
+    row = rp / piv[:, None]
+    fac = jnp.sum(jnp.where(cmask, M, 0.0), axis=-1)         # col i, swapped
+    return jnp.where(is_i[:, :, None], row[:, None, :],
+                     M - fac[:, :, None] * row[:, None, :])
+
+
+def _gj_solve_kernel(m_ref, out_ref):
+    M = m_ref[...]
+    n = M.shape[1]
+    out_ref[...] = jax.lax.fori_loop(
+        0, n, lambda i, M: _gj_elim_body(M, i), M
+    )
+
+
+@lru_cache(maxsize=32)
+def _gj_solve_call(nblocks, tb, n, m, dtype_name, interpret):
+    dtype = np.dtype(dtype_name)
+    fn = pl.pallas_call(
+        _gj_solve_kernel,
+        grid=(nblocks,),
+        in_specs=[pl.BlockSpec((tb, n, m), lambda i: (i, 0, 0))],
+        out_specs=pl.BlockSpec((tb, n, m), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((nblocks * tb, n, m), dtype),
+        interpret=interpret,
+    )
+    return jax.jit(fn)
+
+
+def gauss_solve_pallas(A, b, batch_tile=512):
+    """Drop-in for :func:`raft_tpu.dynamics.gauss_solve` through the
+    Pallas batched elimination kernel.
+
+    A : [..., n, n]; b : [..., n, nrhs] -> x : [..., n, nrhs].  Leading
+    batch axes are flattened into VMEM-resident tiles of ``batch_tile``
+    systems; the tail tile is padded with identity systems (solved and
+    discarded — always-finite work, zero effect on real lanes).
+    """
+    n = A.shape[-1]
+    nrhs = b.shape[-1]
+    m = n + nrhs
+    batch_shape = A.shape[:-2]
+    B = int(np.prod(batch_shape, dtype=np.int64)) if batch_shape else 1
+    M = jnp.concatenate([A, b], axis=-1).reshape((B, n, m))
+    tb = min(B, int(batch_tile))
+    pad = (-B) % tb
+    if pad:
+        fill = jnp.concatenate(
+            [jnp.eye(n, dtype=M.dtype), jnp.zeros((n, nrhs), M.dtype)],
+            axis=-1,
+        )
+        M = jnp.concatenate(
+            [M, jnp.broadcast_to(fill, (pad, n, m))], axis=0
+        )
+    out = _gj_solve_call(
+        (B + pad) // tb, tb, n, m, M.dtype.name, _interpret()
+    )(M)
+    x = out[:B, :, n:]
+    return x.reshape(batch_shape + (n, nrhs))
+
+
+# ------------------------------------------------------------ blocked stage
+
+def _tile_inv_kernel(a_ref, out_ref):
+    """In-VMEM Gauss-Jordan inversion of one pivot tile: the [n, 2n]
+    augmented elimination runs entirely on-chip (n=512 f32: 2 MB)."""
+    A = a_ref[...]
+    n = A.shape[-1]
+    ri = jax.lax.broadcasted_iota(jnp.int32, (n, n), 0)
+    ci = jax.lax.broadcasted_iota(jnp.int32, (n, n), 1)
+    eye = (ri == ci).astype(A.dtype)
+    M = jnp.concatenate([A, eye], axis=-1)[None]       # [1, n, 2n]
+    M = jax.lax.fori_loop(0, n, lambda i, M: _gj_elim_body(M, i), M)
+    out_ref[...] = M[0, :, n:]
+
+
+@lru_cache(maxsize=32)
+def _tile_inv_call(n, dtype_name, interpret):
+    dtype = np.dtype(dtype_name)
+    fn = pl.pallas_call(
+        _tile_inv_kernel,
+        out_shape=jax.ShapeDtypeStruct((n, n), dtype),
+        interpret=interpret,
+    )
+    return jax.jit(fn)
+
+
+def tile_inv_pallas(A):
+    """Invert a square tile in VMEM (replaces ``jnp.linalg.inv`` on the
+    pivot blocks of the blocked Gauss-Jordan)."""
+    n = A.shape[-1]
+    return _tile_inv_call(n, A.dtype.name, _interpret())(A)
+
+
+def _mm_kernel(l_ref, r_ref, o_ref):
+    o_ref[...] = jnp.dot(l_ref[...], r_ref[...],
+                         preferred_element_type=o_ref.dtype)
+
+
+def _mm_sub_kernel(x_ref, l_ref, r_ref, o_ref):
+    o_ref[...] = x_ref[...] - jnp.dot(l_ref[...], r_ref[...],
+                                      preferred_element_type=o_ref.dtype)
+
+
+def _tile(dim, cap=256):
+    """Largest power-of-two tile <= cap that divides ``dim`` (whole dim
+    if none does — small right-hand-side column counts stay one tile)."""
+    for t in (256, 128, 64, 32, 16, 8):
+        if t <= cap and dim % t == 0:
+            return t
+    return dim
+
+
+@lru_cache(maxsize=64)
+def _mm_call(nr, K, nc, tm, tn, dtype_name, interpret, sub):
+    dtype = np.dtype(dtype_name)
+    ospec = pl.BlockSpec((tm, tn), lambda i, j: (i, j))
+    lspec = pl.BlockSpec((tm, K), lambda i, j: (i, 0))
+    rspec = pl.BlockSpec((K, tn), lambda i, j: (0, j))
+    kernel = _mm_sub_kernel if sub else _mm_kernel
+    in_specs = [ospec, lspec, rspec] if sub else [lspec, rspec]
+    fn = pl.pallas_call(
+        kernel,
+        grid=(nr // tm, nc // tn),
+        in_specs=in_specs,
+        out_specs=ospec,
+        out_shape=jax.ShapeDtypeStruct((nr, nc), dtype),
+        interpret=interpret,
+    )
+    return jax.jit(fn)
+
+
+def mm_pallas(L, R):
+    """``L @ R`` with VMEM-tiled operand blocks (full-K tiles: the
+    blocked stage's K is the pivot block size, <= 512)."""
+    nr, K = L.shape
+    nc = R.shape[-1]
+    tm, tn = _tile(nr), _tile(nc)
+    return _mm_call(nr, K, nc, tm, tn, L.dtype.name, _interpret(),
+                    False)(L, R)
+
+
+def mm_sub_pallas(X, L, R):
+    """``X - L @ R`` fused in one pass over X's tiles (the elimination
+    update — saves materializing the [n, n] product in HBM)."""
+    nr, K = L.shape
+    nc = R.shape[-1]
+    tm, tn = _tile(nr), _tile(nc)
+    return _mm_call(nr, K, nc, tm, tn, X.dtype.name, _interpret(),
+                    True)(X, L, R)
+
+
+def gj_stage_pallas(A, b, kb0, nblk, block=512):
+    """Pallas-composed mirror of :func:`raft_tpu.bem_solver._gj_stage`:
+    same JAX-level ``fori_loop`` over pivot blocks (``kb0``/``nblk`` stay
+    traced so one executable serves every streamed stage), with the
+    pivot-tile inverse and the dense updates in kernels.  Same
+    no-inter-block-pivoting contract as the XLA path."""
+    n = A.shape[0]
+    m = b.shape[1]
+    assert n % block == 0, (n, block)
+    rowidx = jnp.arange(n)
+
+    def step(kb, carry):
+        A, b = carry
+        k0 = kb * block
+        D = jax.lax.dynamic_slice(A, (k0, 0), (block, n))
+        Db = jax.lax.dynamic_slice(b, (k0, 0), (block, m))
+        Dinv = tile_inv_pallas(
+            jax.lax.dynamic_slice(A, (k0, k0), (block, block))
+        )
+        Arow = mm_pallas(Dinv, D)                           # [block, n]
+        brow = mm_pallas(Dinv, Db)                          # [block, m]
+        C = jax.lax.dynamic_slice(A, (0, k0), (n, block))   # [n, block]
+        mask = ((rowidx >= k0) & (rowidx < k0 + block))[:, None]
+        C = jnp.where(mask, 0.0, C)
+        A = mm_sub_pallas(A, C, Arow)
+        b = mm_sub_pallas(b, C, brow)
+        A = jax.lax.dynamic_update_slice(A, Arow, (k0, 0))
+        b = jax.lax.dynamic_update_slice(b, brow, (k0, 0))
+        return A, b
+
+    return jax.lax.fori_loop(kb0, kb0 + nblk, step, (A, b))

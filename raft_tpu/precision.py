@@ -1,0 +1,75 @@
+"""Gated mixed-precision policy: bf16 operands, f32 accumulation.
+
+The strip-theory assembly (hydro einsums over the node axis) and the
+impedance assembly are the arithmetic bulk of every fixed-point
+iteration, and none of it needs full working precision to drive a fixed
+point whose stopping test is 1% — the accuracy of the RETURNED
+amplitudes comes from the final conditioned re-solve.  With
+``RAFT_TPU_MIXED_PRECISION=1`` the assembly operands are rounded to
+bfloat16 and the contractions accumulate in float32 (the classic MXU
+recipe: bf16 multiplicands, f32 accumulator), which on TPU doubles the
+MXU issue rate and halves assembly HBM traffic.
+
+The flag defaults OFF, and off means *off*: every call site branches to
+the exact pre-existing expression, so the default path stays
+bit-for-bit identical (tier-1 asserts this by construction — the whole
+suite runs with the flag unset).
+
+Safety net (see :func:`raft_tpu.dynamics.solve_dynamics`): the final
+re-solve always computes a full-precision assembly alongside the
+mixed-precision one, and any frequency lane whose mixed-precision
+solve left the health ladder's baseline tier — or whose condition
+estimate exceeds the f32 ladder threshold — takes the full-precision
+answer.  Degraded lanes therefore fall back to f32 (the full working
+dtype) automatically; healthy lanes keep the fast-path result, gated
+by the ``rao_linf_err <= 1e-4`` acceptance test in bench.
+"""
+
+import os
+
+import jax.numpy as jnp
+
+_TRUTHY = ("1", "true", "on", "yes")
+
+
+def mixed_precision_enabled():
+    """Whether ``RAFT_TPU_MIXED_PRECISION`` requests the bf16/f32 path.
+
+    Read at trace time: jitted callers bake the answer into the
+    executable, so flipping the flag mid-process needs a fresh trace
+    (the same contract as every other RAFT_TPU_* flag).
+    """
+    return os.environ.get(
+        "RAFT_TPU_MIXED_PRECISION", ""
+    ).strip().lower() in _TRUTHY
+
+
+def mp_round(x):
+    """Round a real array's values through bfloat16 (operand rounding of
+    the bf16-multiplicand / f32-accumulator recipe) while keeping the
+    caller's dtype, so downstream promotion rules are unchanged."""
+    return x.astype(jnp.bfloat16).astype(x.dtype)
+
+
+def mp_matmul(einsum_str, A, X):
+    """``jnp.einsum`` contraction with bf16 operands and f32
+    accumulation.  ``A`` real, ``X`` real or complex (complex operands
+    are contracted as separate real/imaginary bf16 passes — bf16 has no
+    complex dtype)."""
+    Ab = A.astype(jnp.bfloat16)
+    if jnp.iscomplexobj(X):
+        xr = jnp.einsum(einsum_str, Ab, jnp.real(X).astype(jnp.bfloat16),
+                        preferred_element_type=jnp.float32)
+        xi = jnp.einsum(einsum_str, Ab, jnp.imag(X).astype(jnp.bfloat16),
+                        preferred_element_type=jnp.float32)
+        return (xr + 1j * xi).astype(X.dtype)
+    out = jnp.einsum(einsum_str, Ab, X.astype(jnp.bfloat16),
+                     preferred_element_type=jnp.float32)
+    return out.astype(X.dtype)
+
+
+def mp_masked_sum(A, mask, axis=0):
+    """Masked reduction with bf16 operands and f32 accumulation, result
+    cast back to the operand dtype (the strip-theory 3->6 matrix sums)."""
+    Ab = jnp.where(mask, A, 0.0).astype(jnp.bfloat16)
+    return jnp.sum(Ab, axis=axis, dtype=jnp.float32).astype(A.dtype)

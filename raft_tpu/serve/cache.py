@@ -177,6 +177,7 @@ def persist_all_compiles():
 _CODE_VERSION_MODULES = (
     "raft_tpu.dynamics", "raft_tpu.hydro", "raft_tpu.waves",
     "raft_tpu.geometry", "raft_tpu.model", "raft_tpu.serve.buckets",
+    "raft_tpu.pallas_kernels", "raft_tpu.precision",
 )
 
 
@@ -196,18 +197,27 @@ def code_version():
 
 def current_flags():
     """The executable-compatibility key of the running process."""
+    from raft_tpu.pallas_kernels import pallas_enabled
+    from raft_tpu.precision import mixed_precision_enabled
+
     return {
         "backend": jax.default_backend(),
         "x64": bool(jax.config.jax_enable_x64),
         "jax": jax.__version__,
         "code_version": code_version(),
+        # numerics-changing dispatch flags bake into traced executables,
+        # so a manifest recorded under one setting must not warm (or be
+        # trusted by) a process running another
+        "pallas": bool(pallas_enabled()),
+        "mixed_precision": bool(mixed_precision_enabled()),
     }
 
 
 def flags_mismatch(entry_flags, flags=None):
     """Human-readable reason an entry's flags refuse reuse, or None."""
     flags = flags or current_flags()
-    for key in ("backend", "x64", "code_version", "jax"):
+    for key in ("backend", "x64", "code_version", "jax",
+                "pallas", "mixed_precision"):
         if entry_flags.get(key) != flags.get(key):
             return (f"{key}={entry_flags.get(key)!r} recorded but "
                     f"{flags.get(key)!r} running")

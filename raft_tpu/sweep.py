@@ -48,6 +48,7 @@ from raft_tpu.geometry import HydroNodes
 from raft_tpu.health import FailedPoint
 from raft_tpu.model import Model, make_case_dynamics
 from raft_tpu.resilience import SolveRetryPolicy
+from raft_tpu.sweep_buckets import grouped_sweep_pipeline, sweep_buckets_enabled
 from raft_tpu.utils.profiling import logger
 
 
@@ -298,6 +299,7 @@ def run_sweep(
     verbose=True,
     retry_nonconverged=True,
     overlap=True,
+    via_buckets=None,
 ):
     """Run the analysis over all design ``points`` with the design axis
     sharded across ``mesh`` and per-chunk checkpointing under ``out_dir``.
@@ -350,6 +352,17 @@ def run_sweep(
     if mesh is None:
         mesh = make_sweep_mesh()
     n_dev = int(np.prod([mesh.shape[a] for a in mesh.axis_names]))
+    # sweep-through-buckets (RAFT_TPU_SWEEP_BUCKETS / via_buckets=True):
+    # the chunk dynamics dispatch runs on the serving layer's canonical
+    # bucket executables (raft_tpu/sweep_buckets.py) instead of the
+    # sweep-shaped vmapped pipeline; single-process only (the bucket
+    # slab dispatch has no multi-host collective ordering)
+    use_buckets = sweep_buckets_enabled(via_buckets) \
+        and jax.process_count() == 1
+    if sweep_buckets_enabled(via_buckets) and not use_buckets:
+        logger.warning(
+            "run_sweep: via_buckets requested but multi-process run — "
+            "falling back to the fused per-shape pipeline")
     retry_policy = SolveRetryPolicy.from_flag(retry_nonconverged)
     if out_dir:
         os.makedirs(out_dir, exist_ok=True)
@@ -544,7 +557,13 @@ def run_sweep(
         )
 
         m0 = preps[fill][0]
-        pipeline = _sweep_pipeline(m0, sharding, m0.nIter, 0.8)
+        if use_buckets:
+            # retry dispatches below keep the legacy pipeline: the
+            # escalated (nIter, relax) is not a canonical serving
+            # configuration (see raft_tpu/sweep_buckets.py)
+            pipeline = grouped_sweep_pipeline(m0)
+        else:
+            pipeline = _sweep_pipeline(m0, sharding, m0.nIter, 0.8)
         dev_in = jax.device_put((nodes_b,) + args_b, sharding)
         raw = pipeline(*dev_in)        # ASYNC dispatch: fetch in _finalize
         ctx = dict(k=k, k0=k0, ck_path=ck_path, chunk_pts=chunk_pts,

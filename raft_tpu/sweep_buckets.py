@@ -1,0 +1,247 @@
+"""Sweep-through-buckets: sweep dynamics dispatch on the serving layer's
+canonical fixed-shape bucket executables.
+
+The sweep drivers historically traced their own [group, draft, ballast,
+case]-shaped pipelines — program shapes the serving subsystem never
+compiles, so a fresh process pays the full trace+compile wall
+(BENCH_FULL.json: 389 s) even with a fully warmed serve cache on disk.
+This module re-routes the sweep's per-(design, case) dynamics lanes
+through the SAME canonical slot executables the serving engine runs
+(raft_tpu/serve/buckets.py): lanes are flattened, bucketized with
+``choose_bucket`` (same node quantum and slot ladder as serving), and
+dispatched slab-by-slab through ``slot_pipeline``.  Every bucket a sweep
+touches is recorded in the serve warm-up manifest, so
+``raft_tpu.serve.cache.warmup()`` in a fresh process pre-compiles (or
+persistent-cache-loads) exactly the executables the next sweep needs —
+the fixed-shape program-reuse discipline of TPU CFD frameworks
+(arXiv:2108.11076) applied to the design sweep.
+
+Routing is opt-in: ``RAFT_TPU_SWEEP_BUCKETS=1`` (or the drivers'
+``via_buckets=True``).  Off (the default), the drivers' fused pipelines
+run bit-for-bit unchanged.
+
+Bit-identity contract (inherited from the bucket layer, see
+buckets.py's module docstring): within one bucket executable a lane's
+result depends only on that lane's inputs, so a design's bucket-routed
+sweep results are ``np.array_equal`` to the same design swept in any
+other batch composition of the same bucket — and to the serve engine's
+answer for the same case inputs.  Results vs the legacy fused pipeline
+agree to solver tolerance (different executables re-associate
+reductions by ulps; the fixed point's 1% stop can amplify that to
+~1e-4), which is why the routing is a dispatch choice, not a silent
+default.
+
+The bounded non-convergence retry intentionally stays on the legacy
+pipeline: retries re-solve with a different (nIter, relax) physics that
+is NOT a canonical serving configuration, and polluting the manifest
+with retry-only executables would defeat the warm-start story.
+"""
+
+import os
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.serve.buckets import (
+    SlotPhysics,
+    choose_bucket,
+    slot_pipeline,
+)
+from raft_tpu.utils.profiling import logger
+
+_TRUTHY = ("1", "true", "on", "yes")
+
+
+def sweep_buckets_enabled(explicit=None):
+    """Whether sweep dynamics routes through serve buckets: the driver's
+    explicit ``via_buckets`` argument wins; ``None`` defers to the
+    ``RAFT_TPU_SWEEP_BUCKETS`` env flag (default off)."""
+    if explicit is not None:
+        return bool(explicit)
+    return os.environ.get(
+        "RAFT_TPU_SWEEP_BUCKETS", ""
+    ).strip().lower() in _TRUTHY
+
+
+def _record_bucket(physics, spec):
+    """Record a dispatched bucket in the serve warm-up manifest (and
+    drop the persistent-cache size/time thresholds so its executable
+    lands on disk) — this is what makes the NEXT process's sweep start
+    warm.  Manifest trouble degrades to a log line, never a failed
+    sweep."""
+    try:
+        from raft_tpu.serve.cache import WarmupManifest, persist_all_compiles
+
+        persist_all_compiles()
+        WarmupManifest().record(physics, spec)
+    except OSError as e:
+        logger.warning(
+            "sweep bucket manifest record failed (%s); the sweep still "
+            "runs, the next process just starts cold", e)
+
+
+def _pad_node_axis(nodes_stacked, n_nodes):
+    """Zero-pad every leaf's node axis (axis 1 of [nd, N, ...]) to the
+    bucket's quantized node count — the same inert-padding contract as
+    serve.buckets.pad_nodes."""
+    N = nodes_stacked.r.shape[1]
+    if N == n_nodes:
+        return nodes_stacked
+    if N > n_nodes:
+        raise ValueError(
+            f"stacked designs have {N} strip nodes > bucket "
+            f"n_nodes={n_nodes}")
+
+    def pad(a):
+        widths = [(0, 0)] * a.ndim
+        widths[1] = (0, n_nodes - N)
+        return jnp.pad(a, widths)
+
+    return jax.tree.map(pad, nodes_stacked)
+
+
+def dispatch_lanes(physics, spec, n_lanes, slab_args, checkable=False,
+                   record=True):
+    """Run ``n_lanes`` flattened (design x case) lanes through the
+    canonical slot executable of ``spec``, ``spec.n_slots`` lanes per
+    dispatch (all dispatches issued async, results concatenated on
+    device).
+
+    slab_args(idx) -> (nodes_slab, args_slab): the [n_slots] operand
+    gather for the given lane indices (``idx`` is tail-padded with lane
+    0 — replicated-first-lane padding, same contract as
+    serve.buckets.pack_slots; padded results are trimmed here).
+
+    Returns ``(xr [n_lanes, 6, nw], xi, report)`` device arrays.
+    """
+    fn = slot_pipeline(physics, checkable)
+    if record:
+        _record_bucket(physics, spec)
+    outs = []
+    for s0 in range(0, n_lanes, spec.n_slots):
+        idx = np.arange(s0, min(s0 + spec.n_slots, n_lanes))
+        if len(idx) < spec.n_slots:
+            idx = np.concatenate(
+                [idx, np.zeros(spec.n_slots - len(idx), idx.dtype)])
+        nodes_slab, args_slab = slab_args(idx)
+        outs.append(fn(nodes_slab, *args_slab))       # async dispatch
+    if len(outs) == 1:
+        xr, xi, rep = outs[0]
+        take = lambda a: a[:n_lanes]  # noqa: E731
+    else:
+        xr = jnp.concatenate([o[0] for o in outs])
+        xi = jnp.concatenate([o[1] for o in outs])
+        rep = jax.tree.map(
+            lambda *leaves: jnp.concatenate(leaves),
+            *[o[2] for o in outs])
+        take = lambda a: a[:n_lanes]  # noqa: E731
+    return take(xr), take(xi), jax.tree.map(take, rep)
+
+
+def fused_bucket_pipeline(model0, return_xi):
+    """Bucket-routed drop-in for ``sweep_fused._dynamics_pipeline``'s
+    executable: same call signature ``(nodes_g, zeta, beta, C_g, M0_g,
+    a_g, b_g)`` (leading group axes [G, gd(, nB)]), same output tuple
+    ``(std, report[, xr, xi])`` — shaped flat [nd_flat * nc, ...] along
+    the leading axis, which ``_unpack_dyn``'s reshape consumes
+    identically (lane order is design-major, case-minor, exactly the
+    row-major order of the grouped axes).
+
+    The rank-1 hub added-mass/damping profiles are materialized per
+    slab (``M_lin = M0 + a(w) * P_hub``, elementwise identical to the
+    fused pipeline's in-graph expression) because the canonical slot
+    executable takes full [nw, 6, 6] matrices per lane — that is the
+    price of sharing ONE program with the serving engine instead of
+    compiling a sweep-shaped program family.
+    """
+    from raft_tpu.utils.frames import translate_matrix_3to6
+
+    physics = SlotPhysics.from_model(model0)
+    dtype = np.dtype(physics.dtype_name).type
+    w = np.frombuffer(physics.w_bytes, np.float64, count=physics.nw)
+    dw = dtype(w[1] - w[0])
+    nw = physics.nw
+    E00 = np.zeros((1, 3, 3))
+    E00[0, 0, 0] = 1.0
+    P_hub = jnp.asarray(
+        np.asarray(
+            translate_matrix_3to6(E00, np.array([0.0, 0.0,
+                                                 float(model0.hHub)]))
+        )[0],
+        dtype,
+    )
+
+    def pipeline(nodes_g, zeta, beta, C_g, M0_g, a_g, b_g):
+        lead = C_g.shape[:-3]          # (G, gd, nB) or (G, gd)
+        ncc = C_g.shape[-3]
+        n_designs = int(np.prod(lead[:2], dtype=np.int64))  # nodes axis
+        n_rows = int(np.prod(lead, dtype=np.int64))         # C/a/b rows
+        L = n_rows * ncc
+        nB = n_rows // n_designs
+        nodes_flat = jax.tree.map(
+            lambda a: a.reshape((n_designs,) + a.shape[2:]), nodes_g)
+        spec = choose_bucket(nw, nodes_flat.r.shape[1], ncc)
+        nodes_flat = _pad_node_axis(nodes_flat, spec.n_nodes)
+        C_flat = C_g.reshape((n_rows, ncc, 6, 6))
+        M0_flat = M0_g.reshape((n_rows, 6, 6))
+        a_flat = a_g.reshape((n_rows, ncc, nw))
+        b_flat = b_g.reshape((n_rows, ncc, nw))
+
+        def slab_args(idx):
+            ri = jnp.asarray(idx // ncc)                 # design-row idx
+            ci = jnp.asarray(idx % ncc)                  # case idx
+            di = ri // nB                                # node-bundle idx
+            nodes_s = jax.tree.map(
+                lambda a: jnp.take(a, di, axis=0), nodes_flat)
+            M0_s = jnp.take(M0_flat, ri, axis=0)         # [S, 6, 6]
+            a_s = a_flat[ri, ci]                         # [S, nw]
+            b_s = b_flat[ri, ci]
+            M_lin = M0_s[:, None] + a_s[:, :, None, None] * P_hub
+            B_lin = b_s[:, :, None, None] * P_hub
+            Fz = jnp.zeros((len(idx), nw, 6), dtype)
+            args = (jnp.take(zeta, ci, axis=0),
+                    jnp.take(beta, ci, axis=0),
+                    C_flat[ri, ci], M_lin, B_lin, Fz, Fz)
+            return nodes_s, args
+
+        xr, xi, rep = dispatch_lanes(physics, spec, L, slab_args)
+        std = jnp.sqrt(jnp.sum(xr * xr + xi * xi, axis=-1) * dw)
+        if return_xi:
+            return std, rep, xr, xi
+        return std, rep
+
+    return pipeline
+
+
+def grouped_sweep_pipeline(model0, checkable=False):
+    """Bucket-routed drop-in for ``sweep._sweep_pipeline``'s [design,
+    case] executable: call signature ``(nodes_b, zeta, beta, C, M, B,
+    Fr, Fi)`` with leading [nd] (nodes) / [nd, nc] (args) axes, output
+    ``(xr [nd, nc, 6, nw], xi, report)`` exactly like the vmapped
+    pipeline — but through the serving buckets, one slab of canonical
+    lanes at a time."""
+    physics = SlotPhysics.from_model(model0)
+
+    def pipeline(nodes_b, *args_b):
+        nd, nc = args_b[0].shape[:2]
+        L = nd * nc
+        spec = choose_bucket(physics.nw, nodes_b.r.shape[1], nc)
+        nodes_p = _pad_node_axis(nodes_b, spec.n_nodes)
+        flat = tuple(
+            jnp.reshape(a, (L,) + tuple(a.shape[2:])) for a in args_b)
+
+        def slab_args(idx):
+            di = jnp.asarray(idx // nc)
+            li = jnp.asarray(idx)
+            nodes_s = jax.tree.map(
+                lambda a: jnp.take(a, di, axis=0), nodes_p)
+            return nodes_s, tuple(jnp.take(a, li, axis=0) for a in flat)
+
+        xr, xi, rep = dispatch_lanes(physics, spec, L, slab_args,
+                                     checkable=checkable)
+        shape = lambda a: a.reshape((nd, nc) + a.shape[1:])  # noqa: E731
+        return shape(xr), shape(xi), jax.tree.map(shape, rep)
+
+    return pipeline

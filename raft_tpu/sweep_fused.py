@@ -57,6 +57,7 @@ from raft_tpu.mooring import (
     warn_bridle_residual,
 )
 from raft_tpu.resilience import SolveRetryPolicy
+from raft_tpu.sweep_buckets import sweep_buckets_enabled
 from raft_tpu.statics import compute_statics
 from raft_tpu.sweep import pad_and_stack_nodes
 from raft_tpu.utils.placement import put_cpu
@@ -534,7 +535,7 @@ def _overlap_case_chunks(wind, aero_on, overlap, nd_aero):
 def _chunked_aero_dynamics(model0, cases, wind, aero_on, pitch_mean,
                            make_dev_args, nd_aero, nd_flat, return_xi,
                            retry_nonconverged, label, tracer,
-                           overlap="auto"):
+                           overlap="auto", via_buckets=False):
     """The aero-second -> dynamics hand-off, split along the wind-case
     axis into double-buffered chunks: the jitted dynamics dispatch for
     chunk k is ASYNCHRONOUS (the old path blocked on one fused dispatch),
@@ -561,7 +562,18 @@ def _chunked_aero_dynamics(model0, cases, wind, aero_on, pitch_mean,
     a_hub = np.zeros((nd_aero, nc, nw))
     b_hub = np.zeros((nd_aero, nc, nw))
     F_aero2 = np.zeros((nd_aero, nc, 6))
-    pipeline = _dynamics_pipeline(model0, return_xi)
+    if via_buckets:
+        # canonical serving-bucket executables instead of the fused
+        # sweep-shaped pipeline (raft_tpu/sweep_buckets.py): same lane
+        # arithmetic contract, shared compiled programs with the serve
+        # layer, every bucket recorded in the warm-up manifest.  The
+        # bounded retry below intentionally stays on the legacy
+        # pipeline (non-canonical nIter/relax overrides).
+        from raft_tpu.sweep_buckets import fused_bucket_pipeline
+
+        pipeline = fused_bucket_pipeline(model0, return_xi)
+    else:
+        pipeline = _dynamics_pipeline(model0, return_xi)
     backend = jax.default_backend()
 
     t_engine0 = time.perf_counter()
@@ -700,6 +712,7 @@ def run_draft_ballast_sweep(
     retry_nonconverged=True,
     overlap="auto",
     tracer=None,
+    via_buckets=None,
 ):
     """Run the fused draft x ballast sweep.
 
@@ -900,6 +913,7 @@ def run_draft_ballast_sweep(
             model0, cases, wind, aero_on, r6[:, :, 4], make_dev_args,
             nd, nd, return_xi, retry_nonconverged,
             f"fused sweep {nD}x{nB}", tracer, overlap=overlap,
+            via_buckets=sweep_buckets_enabled(via_buckets),
         )  # dynamics_first_s includes compile on first call
     std = sol["std"]
     iters = sol["iters"]
@@ -1195,6 +1209,7 @@ def run_design_sweep(
     retry_nonconverged=True,
     overlap="auto",
     tracer=None,
+    via_buckets=None,
 ):
     """Fused sweep over an arbitrary list of design dicts — the general
     form of the reference's 5-parameter geometry study
@@ -1389,6 +1404,7 @@ def run_design_sweep(
             model0, cases, wind, aero_on, r6[:, :, 4], make_dev_args,
             nd, nd_pad, return_xi, retry_nonconverged,
             f"design sweep x{nd}", tracer, overlap=overlap,
+            via_buckets=sweep_buckets_enabled(via_buckets),
         )
     std = sol["std"][:nd]
     iters = sol["iters"][:nd]

@@ -1,0 +1,96 @@
+"""Bench-output schema: exception strings may only persist under
+``*_error`` keys.
+
+The r04 driver round recorded ``bem_error: "ValueError: too many values
+to unpack"`` — survivable, because the key said *error*.  The failure
+mode this schema rule removes is the same string landing under a METRIC
+key (a section returning a caught-exception string as a value), where
+PERF.md generation and regression diffs would consume it as a number.
+``bench._sanitize_schema`` moves any exception-looking value to
+``<key>_error`` on every flush, and this file pins that behavior plus
+the cleanliness of the committed artifact.
+"""
+
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import bench  # noqa: E402
+
+
+def test_looks_like_exception():
+    yes = [
+        "ValueError: too many values to unpack (expected 2)",
+        "TypeError: unsupported operand",
+        "jaxlib.xla_extension.XlaRuntimeError: INTERNAL: boom",
+        "TimeoutError: deadline",
+        "KeyboardInterrupt: ",
+        "x\nTraceback (most recent call last):\n  boom",
+    ]
+    no = [
+        "smoke: 132-panel BEM solve (2 freq)",
+        "ratio: 2.5x faster",
+        "skipped: wall-clock budget exhausted",
+        3.14,
+        {"nested": "ValueError: ignored (not a string value)"},
+        ["ValueError: in a list"],
+        "Error",                 # no colon -> not a message
+        "has: colon but ordinary head",
+    ]
+    for v in yes:
+        assert bench._looks_like_exception(v), v
+    for v in no:
+        assert not bench._looks_like_exception(v), v
+
+
+def test_sanitize_moves_exception_strings_to_error_keys():
+    out = {
+        "rao_linf_err": 1e-5,
+        "bem_device_vs_cpu": "ValueError: too many values to unpack",
+        "bem_error": "ValueError: recorded where it belongs",
+        "metric": "smoke: 132-panel BEM solve (2 freq)",
+    }
+    bench._sanitize_schema(out)
+    assert "bem_device_vs_cpu" not in out
+    assert out["bem_device_vs_cpu_error"].startswith("ValueError")
+    # untouched: numbers, ordinary strings, and existing *_error keys
+    assert out["rao_linf_err"] == 1e-5
+    assert out["metric"].startswith("smoke:")
+    assert out["bem_error"] == "ValueError: recorded where it belongs"
+
+
+def test_write_full_applies_sanitizer(tmp_path):
+    path = str(tmp_path / "out.json")
+    bench._write_full(
+        {"good": 1.0, "bad_metric": "RuntimeError: section leaked"},
+        path)
+    with open(path) as fh:
+        data = json.load(fh)
+    assert data == {"good": 1.0,
+                    "bad_metric_error": "RuntimeError: section leaked"}
+
+
+def test_committed_bench_artifacts_respect_schema():
+    """Every committed bench artifact (BENCH_FULL.json and the recorded
+    BENCH_r*.json tails) carries exception strings only under *_error
+    keys."""
+    import glob
+
+    paths = [os.path.join(ROOT, "BENCH_FULL.json")]
+    paths += sorted(glob.glob(os.path.join(ROOT, "BENCH_r*.json")))
+    checked = 0
+    for path in paths:
+        if not os.path.exists(path):
+            continue
+        with open(path) as fh:
+            data = json.load(fh)
+        offenders = {
+            k: v for k, v in data.items()
+            if not k.endswith("_error") and bench._looks_like_exception(v)
+        }
+        assert not offenders, f"{os.path.basename(path)}: {offenders}"
+        checked += 1
+    assert checked, "no bench artifacts found to check"

@@ -276,3 +276,267 @@ def test_rms_psd():
     dw = 0.05
     assert np.isclose(get_rms(xi, dw), np.sqrt(np.sum(np.abs(xi) ** 2) * dw))
     assert np.allclose(get_psd(xi), np.abs(xi) ** 2)
+
+# ---------------- Pallas kernels (interpret mode on CPU) ----------------
+# The hand-written TPU kernels (raft_tpu/pallas_kernels.py) must agree
+# with the XLA reference paths they replace; on the CPU tier-1 runner
+# they execute through the Pallas interpreter, which runs the SAME
+# kernel body the Mosaic compiler lowers on TPU.
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.bem_solver import _gj_stage
+from raft_tpu.dynamics import gauss_solve, solve_complex_6x6, solve_dynamics
+from raft_tpu.geometry import HydroNodes
+from raft_tpu.pallas_kernels import (
+    HAVE_PALLAS,
+    gauss_solve_pallas,
+    gj_stage_pallas,
+    mm_pallas,
+    mm_sub_pallas,
+    pallas_enabled,
+    tile_inv_pallas,
+)
+from raft_tpu.precision import mixed_precision_enabled
+from raft_tpu.sweep_buckets import sweep_buckets_enabled
+
+needs_pallas = pytest.mark.skipif(
+    not HAVE_PALLAS, reason="jax.experimental.pallas unavailable")
+
+
+def test_speed_flags_default_off(monkeypatch):
+    """All three raw-speed paths are opt-in: with a clean environment the
+    dispatch flags read False, so the baseline XLA paths run."""
+    monkeypatch.delenv("RAFT_TPU_PALLAS", raising=False)
+    monkeypatch.delenv("RAFT_TPU_MIXED_PRECISION", raising=False)
+    monkeypatch.delenv("RAFT_TPU_SWEEP_BUCKETS", raising=False)
+    assert pallas_enabled() is False
+    assert mixed_precision_enabled() is False
+    assert sweep_buckets_enabled() is False
+    # explicit driver argument wins over the (unset) env flag
+    assert sweep_buckets_enabled(True) is True
+    monkeypatch.setenv("RAFT_TPU_PALLAS", "1")
+    monkeypatch.setenv("RAFT_TPU_MIXED_PRECISION", "on")
+    monkeypatch.setenv("RAFT_TPU_SWEEP_BUCKETS", "true")
+    assert pallas_enabled() is True
+    assert mixed_precision_enabled() is True
+    assert sweep_buckets_enabled() is True
+
+
+@needs_pallas
+def test_pallas_gauss_solve_parity():
+    """The batched one-hot Gauss-Jordan kernel reproduces the XLA
+    ``gauss_solve`` bit-for-bit: both run the identical masked-reduction
+    elimination, and adding exact zeros preserves every rounding step."""
+    n = 12
+    A = rng.normal(size=(37, n, n)) + n * np.eye(n)
+    b = rng.normal(size=(37, n, 1))
+    x_ref = np.asarray(gauss_solve(jnp.asarray(A), jnp.asarray(b)))
+    # batch_tile=16 exercises both the tiling and the tail padding
+    x_pl = np.asarray(gauss_solve_pallas(
+        jnp.asarray(A), jnp.asarray(b), batch_tile=16))
+    assert np.array_equal(x_pl, x_ref)
+    assert np.allclose(np.einsum("bij,bjk->bik", A, x_pl), b, atol=1e-9)
+
+
+@needs_pallas
+def test_pallas_gauss_solve_vmap_parity():
+    """vmapped dispatch (the ladder/serve layers vmap over cases) keeps
+    kernel-vs-reference bit parity."""
+    A = rng.normal(size=(3, 5, 12, 12)) + 12 * np.eye(12)
+    b = rng.normal(size=(3, 5, 12, 1))
+    x_ref = np.asarray(jax.vmap(gauss_solve)(jnp.asarray(A), jnp.asarray(b)))
+    x_pl = np.asarray(
+        jax.vmap(gauss_solve_pallas)(jnp.asarray(A), jnp.asarray(b)))
+    assert np.array_equal(x_pl, x_ref)
+
+
+@needs_pallas
+def test_pallas_tile_inv_and_mm_parity():
+    """The in-VMEM pivot-tile inversion and the tiled matmul /
+    matmul-subtract kernels agree with their XLA counterparts at
+    roundoff."""
+    n = 8
+    A = rng.normal(size=(n, n)) + n * np.eye(n)
+    inv_ref = np.linalg.inv(A)
+    inv_pl = np.asarray(tile_inv_pallas(jnp.asarray(A)))
+    assert np.allclose(inv_pl, inv_ref, atol=1e-10)
+
+    L = rng.normal(size=(16, 8))
+    R = rng.normal(size=(8, 24))
+    X = rng.normal(size=(16, 24))
+    assert np.allclose(np.asarray(mm_pallas(jnp.asarray(L), jnp.asarray(R))),
+                       L @ R, atol=1e-12)
+    assert np.allclose(
+        np.asarray(mm_sub_pallas(jnp.asarray(X), jnp.asarray(L),
+                                 jnp.asarray(R))),
+        X - L @ R, atol=1e-12)
+
+
+@needs_pallas
+def test_pallas_gj_stage_parity():
+    """The staged banded Gauss-Jordan through the Pallas tile kernels
+    matches the XLA ``_gj_stage`` stage-for-stage at roundoff, and the
+    completed elimination solves the system."""
+    n, block, m = 16, 4, 3
+    A = rng.normal(size=(n, n)) + n * np.eye(n)
+    b = rng.normal(size=(n, m))
+    A_ref, b_ref = _gj_stage(jnp.asarray(A), jnp.asarray(b), 0, n // block,
+                             block=block)
+    A_pl, b_pl = gj_stage_pallas(jnp.asarray(A), jnp.asarray(b), 0,
+                                 n // block, block=block)
+    scale = np.abs(np.asarray(b_ref)).max()
+    assert np.allclose(np.asarray(b_pl), np.asarray(b_ref),
+                       atol=1e-12 * max(scale, 1.0))
+    assert np.allclose(np.asarray(A_pl), np.asarray(A_ref), atol=1e-11)
+    # the full elimination (all stages) yields the solution in b
+    assert np.allclose(np.asarray(b_pl), np.linalg.solve(A, b), atol=1e-9)
+    # staged dispatch: two partial stages compose to the full elimination
+    A_h, b_h = gj_stage_pallas(jnp.asarray(A), jnp.asarray(b), 0, 2,
+                               block=block)
+    A_2, b_2 = gj_stage_pallas(A_h, b_h, 2, 2, block=block)
+    assert np.allclose(np.asarray(b_2), np.asarray(b_pl), atol=1e-10)
+
+
+@needs_pallas
+def test_pallas_solve_dispatch_bit_parity(monkeypatch):
+    """RAFT_TPU_PALLAS routes ``solve_complex_6x6`` through the kernel;
+    the answer is bit-identical to the flag-off XLA path, so flipping
+    the dispatch can never change physics."""
+    nw = 7
+    Zr = rng.normal(size=(nw, 6, 6)) + 6 * np.eye(6)
+    Zi = 0.1 * rng.normal(size=(nw, 6, 6))
+    Fr = rng.normal(size=(nw, 6))
+    Fi = rng.normal(size=(nw, 6))
+    args = tuple(jnp.asarray(a) for a in (Zr, Zi, Fr, Fi))
+    monkeypatch.delenv("RAFT_TPU_PALLAS", raising=False)
+    xr0, xi0 = solve_complex_6x6(*args)
+    monkeypatch.setenv("RAFT_TPU_PALLAS", "1")
+    xr1, xi1 = solve_complex_6x6(*args)
+    assert np.array_equal(np.asarray(xr1), np.asarray(xr0))
+    assert np.array_equal(np.asarray(xi1), np.asarray(xi0))
+
+
+# ---------------- gated mixed precision ----------------
+
+def _synthetic_dynamics_case():
+    """Minimal drag-free solve_dynamics operand set with one exactly
+    singular frequency lane: M = I and the singular stiffness entry
+    (w^2 = 0.25) are bf16-representable, so the bf16-rounded assembly
+    keeps the lane singular and the ladder escalates under both
+    precision modes; the remaining stiffness diagonal is irrational, so
+    bf16 operand rounding visibly changes the healthy lanes."""
+    N, nw = 2, 8
+    w = jnp.arange(1, nw + 1) * 0.25       # w[1]^2 = 0.25, bf16-exact
+    ksing = 1
+    z1 = np.zeros(N)
+    o1 = np.ones(N)
+    eye3 = np.broadcast_to(np.eye(3), (N, 3, 3)).copy()
+    nodes = HydroNodes(
+        r=np.zeros((N, 3)), q=np.tile([0.0, 0.0, 1.0], (N, 1)), qMat=eye3,
+        p1Mat=eye3, p2Mat=eye3, v_side=o1, v_end=z1, a_end=z1,
+        a_q=o1, a_p1=o1, a_p2=o1, a_end_abs=z1,
+        Ca_p1=o1, Ca_p2=o1, Ca_End=z1,
+        Cd_q=z1, Cd_p1=z1, Cd_p2=z1, Cd_End=z1,   # no drag: assembly is
+        submerged=o1.astype(bool),                # XiL-independent, so the
+        strip_mask=o1.astype(bool))               # f32 shadow is exact
+    nodes = type(nodes)(**{
+        f: jnp.asarray(getattr(nodes, f))
+        for f in nodes.__dataclass_fields__})
+    u = jnp.zeros((N, 3, nw), jnp.complex128)
+    M = jnp.broadcast_to(jnp.eye(6), (nw, 6, 6))
+    B = jnp.zeros((nw, 6, 6))
+    C = jnp.diag(jnp.asarray([0.25] + [np.pi * i for i in range(1, 6)]))
+    F_r = jnp.ones((nw, 6))
+    F_i = jnp.zeros((nw, 6))
+
+    def run():
+        return solve_dynamics(nodes, u, w, 0.25, 1025.0, M, B, C, F_r, F_i,
+                              XiStart=0.1, nIter=15)
+
+    return run, ksing, nw
+
+
+def test_mixed_precision_defaults_off(monkeypatch):
+    """With RAFT_TPU_MIXED_PRECISION unset the solve is the exact
+    baseline (deterministic, bit-stable across calls); setting the flag
+    changes the arithmetic, proving the gate actually routes."""
+    run, _, _ = _synthetic_dynamics_case()
+    monkeypatch.delenv("RAFT_TPU_MIXED_PRECISION", raising=False)
+    xr0, xi0, _ = run()
+    xr0b, _, _ = run()
+    assert np.array_equal(np.asarray(xr0), np.asarray(xr0b))
+    monkeypatch.setenv("RAFT_TPU_MIXED_PRECISION", "1")
+    xr1, xi1, _ = run()
+    assert not np.array_equal(np.asarray(xr0), np.asarray(xr1))
+    assert np.isfinite(np.asarray(xr1)).all()
+
+
+def test_mixed_precision_degraded_lane_falls_back(monkeypatch):
+    """Frequency lanes the recovery ladder escalates (or whose condition
+    estimate blows past the f32 threshold) take their answer from the
+    full-precision shadow assembly: on the singular lane the
+    mixed-precision result is bit-equal to the flag-off baseline, while
+    healthy lanes show the bf16 operand rounding."""
+    run, ksing, nw = _synthetic_dynamics_case()
+    monkeypatch.delenv("RAFT_TPU_MIXED_PRECISION", raising=False)
+    xr0, xi0, rep0 = run()
+    monkeypatch.setenv("RAFT_TPU_MIXED_PRECISION", "1")
+    xr1, xi1, rep1 = run()
+    xr0, xi0, xr1, xi1 = (np.asarray(a) for a in (xr0, xi0, xr1, xi1))
+    # the ladder escalated under both modes (the lane really is degraded)
+    assert int(rep0.recovery_tier) > 0
+    assert int(rep1.recovery_tier) > 0
+    # degraded lane: full-precision fallback, bit-equal to baseline
+    assert np.array_equal(xr1[:, ksing], xr0[:, ksing])
+    assert np.array_equal(xi1[:, ksing], xi0[:, ksing])
+    # at least one healthy lane reflects the bf16-operand assembly
+    healthy = [k for k in range(nw) if k != ksing]
+    assert any(not np.array_equal(xr1[:, k], xr0[:, k]) for k in healthy)
+
+
+# ---------------- sweep-through-buckets ----------------
+
+@pytest.mark.slow
+def test_sweep_through_buckets_batch_equality():
+    """Bucket-routed sweeps inherit the serve layer's batch-composition
+    invariance: a design swept alone is ``np.array_equal`` to the same
+    design swept in a batch (same bucket -> same executable -> same
+    lanes), and the bucket route agrees with the legacy fused pipeline
+    at solver tolerance."""
+    import copy
+
+    from raft_tpu.designs import demo_semi
+    from raft_tpu.sweep_fused import run_design_sweep
+
+    base = demo_semi()
+    base["settings"] = {
+        "min_freq": 0.05, "max_freq": 0.4, "XiStart": 0.1, "nIter": 10,
+    }
+    base["turbine"]["aeroServoMod"] = 0
+    keys = base["cases"]["keys"]
+    row = dict(zip(keys, base["cases"]["data"][0]))
+    row.update(wind_speed=0.0, wave_spectrum="JONSWAP",
+               wave_height=3.0, wave_period=8.0)
+    base["cases"]["data"] = [[row[k] for k in keys]]
+    d2 = copy.deepcopy(base)
+    for mem in d2["platform"]["members"]:
+        rf = mem.get("rho_fill")
+        if rf is not None:
+            mem["rho_fill"] = (
+                [float(x) * 1.2 for x in rf]
+                if isinstance(rf, (list, tuple)) else float(rf) * 1.2)
+
+    res_pair = run_design_sweep([base, d2], group=2, return_xi=True,
+                                verbose=False, via_buckets=True)
+    res_solo = run_design_sweep([base], group=1, return_xi=True,
+                                verbose=False, via_buckets=True)
+    assert res_pair["converged"].all() and res_solo["converged"].all()
+    assert np.array_equal(res_solo["Xi"][0], res_pair["Xi"][0])
+    assert np.array_equal(res_solo["std"][0], res_pair["std"][0])
+
+    res_leg = run_design_sweep([base, d2], group=2, return_xi=True,
+                               verbose=False)
+    np.testing.assert_allclose(res_leg["Xi"], res_pair["Xi"],
+                               rtol=1e-6, atol=1e-10)
