@@ -78,6 +78,9 @@ _COMPACT_KEYS = (
     "bem_stream_A_within_5pct", "bem_stream_error",
     "bem_shard_devices", "bem_shard_speedup", "bem_shard_s",
     "grad_metrics", "grad_fd_rel_err",
+    "serve_multichip_devices", "serve_multichip_speedup_max",
+    "serve_multichip_bit_identical",
+    "multichip_smoke_ratio", "multichip_smoke_bits",
     "serve_p50_s", "serve_p95_s", "serve_occupancy_mean",
     "serve_dispatches", "serve_requests", "serve_cold_vs_warm",
     "serve_cold_first_s", "serve_warm_first_s",
@@ -91,7 +94,7 @@ _COMPACT_KEYS = (
     "bem_sharded_error", "grad_error", "serve_error",
     "chaos_smoke_error", "kernel_error", "sweep_warm_error",
     "perf_docs_error", "sweep_scaling_error", "sweep1024_error",
-    "sweep4096_error",
+    "sweep4096_error", "serve_multichip_error", "multichip_smoke_error",
 )
 
 
@@ -136,6 +139,61 @@ def _write_full(out, path=None):
     with open(tmp, "w") as fh:
         json.dump(out, fh, indent=1)
     os.replace(tmp, path)
+
+
+# Known-benign XLA:CPU AOT loader noise in the multichip harness tails:
+# the persistent compilation cache replays an AOT result compiled with
+# host features the current machine lacks, and XLA logs a wall of
+# machine-feature warnings per executable (MULTICHIP_r05's tail was
+# thousands of chars of them, burying the harness's own OK lines).  Any
+# tail line containing one of these markers is dropped by
+# sanitize_multichip; the real signal lines start "dryrun_multichip OK:".
+_MULTICHIP_NOISE_MARKERS = (
+    "cpu_aot_loader",
+    "Loading XLA:CPU AOT result",
+    "could lead to execution errors such as SIGILL",
+)
+
+_MULTICHIP_TAIL_CAP = 2000
+
+
+def sanitize_multichip(doc, tail_cap=_MULTICHIP_TAIL_CAP):
+    """Schema rules for the driver's MULTICHIP_*.json artifacts, applied
+    in place (idempotent):
+
+    - drops captured-``tail`` lines matching the known-benign XLA:CPU AOT
+      loader noise markers, counting them in ``tail_noise_filtered``
+    - extracts the harness's structured signal lines
+      (``dryrun_multichip OK: ...``) into a ``sections`` list
+    - coerces ``n_devices`` to an int and caps the tail at ``tail_cap``
+      chars (keeping the end, where the harness prints its verdicts)
+    - applies the bench-wide ``*_error`` rule (:func:`_sanitize_schema`)
+    """
+    tail = doc.get("tail")
+    if isinstance(tail, str):
+        kept, dropped = [], 0
+        for ln in tail.splitlines():
+            if any(m in ln for m in _MULTICHIP_NOISE_MARKERS):
+                dropped += 1
+            else:
+                kept.append(ln)
+        doc["sections"] = [
+            ln.strip()[len("dryrun_multichip OK:"):].strip()
+            for ln in kept
+            if ln.strip().startswith("dryrun_multichip OK:")]
+        clean = "\n".join(kept).strip("\n")
+        if len(clean) > tail_cap:
+            clean = clean[-tail_cap:]
+        doc["tail"] = clean
+        if dropped:
+            doc["tail_noise_filtered"] = (
+                dropped + int(doc.get("tail_noise_filtered", 0)))
+    if "n_devices" in doc:
+        try:
+            doc["n_devices"] = int(doc["n_devices"])
+        except (TypeError, ValueError):
+            pass
+    return _sanitize_schema(doc)
 
 
 class _SectionTimeout(Exception):
@@ -269,11 +327,34 @@ def main(argv=None):
     ap.add_argument("--write-perf", action="store_true",
                     help="regenerate PERF.md + README headline from the "
                          "recorded BENCH_FULL.json and exit")
+    ap.add_argument("--sanitize-multichip", nargs="*", metavar="PATH",
+                    default=None,
+                    help="rewrite MULTICHIP_*.json driver artifacts "
+                         "through the multichip schema sanitizer (drop "
+                         "benign XLA:CPU AOT loader noise, cap the tail, "
+                         "extract structured sections) and exit; default "
+                         "paths: every MULTICHIP_*.json in the repo root")
     args = ap.parse_args(argv)
 
     if args.write_perf:
         with open(BENCH_FULL) as fh:
             update_perf_docs(json.load(fh))
+        return
+
+    if args.sanitize_multichip is not None:
+        import glob
+
+        paths = args.sanitize_multichip or sorted(
+            glob.glob(os.path.join(_ROOT, "MULTICHIP_*.json")))
+        for p in paths:
+            with open(p) as fh:
+                doc = json.load(fh)
+            sanitize_multichip(doc)
+            tmp = p + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(doc, fh, indent=1)
+            os.replace(tmp, p)
+            print(f"sanitized {p}")
         return
 
     full_path = args.out or (
@@ -286,6 +367,7 @@ def main(argv=None):
         sections = [("smoke", bench_smoke),
                     ("serve_smoke", bench_serve_smoke),
                     ("chaos_smoke", bench_chaos_smoke),
+                    ("multichip_smoke", bench_multichip_smoke),
                     ("kernel", lambda: bench_kernels(
                         gj6_batch=128, stage_n=128, stage_block=64,
                         stage_m=4))]
@@ -337,6 +419,7 @@ def main(argv=None):
             ("bem_stream", bench_bem_stream, 1.5),
             ("grad", bench_gradients, 1.0),
             ("serve", bench_serve, 2.0),
+            ("serve_multichip", bench_serve_multichip, 1.0),
             ("kernel", bench_kernels, 1.0),
             ("sweep_warm", bench_sweep_warm, 2.0),
         ]
@@ -901,6 +984,185 @@ def bench_chaos_smoke():
         "chaos_smoke_victim_status": r2.status,
         "chaos_smoke_mate_bit_identical": True,
         "chaos_smoke_s": round(time.perf_counter() - t0, 3),
+    }
+
+
+# -------------------------------------------------------------- multichip
+
+def bench_serve_multichip(n_cases=4):
+    """Multi-chip megabatch weak scaling: ONE (request x case) lane
+    megabatch dispatched through the lane-sharded fixed-block bucket
+    executables (serve.buckets) at every mesh width 1..n_local_devices,
+    recording lanes/s per width and the bit-identity of every width's
+    results against the 1-device lane mesh — the ISSUE 8 acceptance
+    figure.  Structured skip on single-device processes (CPU tier-1
+    rounds without RAFT_TPU_HOST_DEVICES), so default behavior is
+    unchanged."""
+    import jax
+
+    from raft_tpu.designs import deep_spar
+    from raft_tpu.model import Model
+    from raft_tpu.serve.buckets import (
+        SlotPhysics, choose_bucket, dispatch_slots, lane_block,
+        pack_slots)
+
+    devs = list(jax.local_devices())
+    if len(devs) < 2:
+        return {"serve_multichip_error":
+                "skipped: single-device process (multi-chip backend or "
+                "RAFT_TPU_HOST_DEVICES>=2 required)"}
+    widths = [w for w in (1, 2, 4, 8, 16) if w <= len(devs)]
+    block = lane_block()
+
+    d = deep_spar(n_cases=n_cases, nw_settings=(0.025, 0.6))
+    m = Model(d, precision="float64")
+    m.analyze_unloaded()
+    args, _ = m.prepare_case_inputs(verbose=False)
+    physics = SlotPhysics.from_model(m)
+    nodes = m.nodes.astype(m.dtype)
+    spec = choose_bucket(m.nw, nodes.r.shape[0], n_cases)
+    # megabatch sized to fill two whole super-blocks at the WIDEST mesh
+    # (the same lane count dispatched at every width — weak scaling over
+    # a fixed problem laid across more chips)
+    G_max = widths[-1] * block
+    reps = max(1, (2 * G_max) // n_cases)
+    lanes = reps * n_cases
+    capacity = -(-lanes // G_max) * G_max
+    nodes_s, args_s, _ = pack_slots([(nodes, args)] * reps, spec,
+                                    capacity=capacity)
+
+    results, wall = {}, {}
+    for Dn in widths:
+        dv = tuple(devs[:Dn])
+        res = dispatch_slots(physics, spec, nodes_s, args_s,
+                             devices=dv, block=block)   # compile + bits
+        results[Dn] = (np.asarray(res[0]), np.asarray(res[1]))
+        wall[Dn] = min(
+            _timed(lambda: dispatch_slots(
+                physics, spec, nodes_s, args_s, devices=dv, block=block))
+            for _ in range(3))
+    bits = all(
+        np.array_equal(results[Dn][0], results[widths[0]][0])
+        and np.array_equal(results[Dn][1], results[widths[0]][1])
+        for Dn in widths[1:])
+    if not bits:
+        raise RuntimeError(
+            "sharded megabatch results differ from the 1-device lane "
+            "mesh (fixed-block bit-identity contract broken)")
+    return {
+        "serve_multichip_devices": widths[-1],
+        "serve_multichip_widths": widths,
+        "serve_multichip_lanes": int(capacity),
+        "serve_multichip_block": int(block),
+        "serve_multichip_bucket": spec.as_dict(),
+        "serve_multichip_wall_s": {
+            str(Dn): round(wall[Dn], 4) for Dn in widths},
+        "serve_multichip_lanes_per_s": {
+            str(Dn): round(capacity / max(wall[Dn], 1e-9), 2)
+            for Dn in widths},
+        "serve_multichip_speedup_max": round(
+            wall[widths[0]] / max(wall[widths[-1]], 1e-9), 2),
+        "serve_multichip_bit_identical": True,
+        "serve_multichip_host_cpus": os.cpu_count(),
+    }
+
+
+# Runs in a FRESH interpreter: the sharding contract needs >=2 devices,
+# and the parent smoke process deliberately runs single-device (fastest).
+# RAFT_TPU_HOST_DEVICES=2 splits the XLA:CPU host platform in the child
+# (raft_tpu/__init__.py wires the flag), giving every tier-1-adjacent
+# run a real 2-device ('lane',) mesh to assert sharded==solo bits on.
+_MULTICHIP_SMOKE_SCRIPT = """
+import sys, os, json, time
+sys.path.insert(0, os.environ["RAFT_TPU_BENCH_ROOT"])
+import jax
+import numpy as np
+import raft_tpu
+from raft_tpu.designs import deep_spar
+from raft_tpu.model import Model
+from raft_tpu.serve.buckets import (
+    SlotPhysics, choose_bucket, dispatch_slots, pack_slots)
+
+assert jax.device_count() == 2, jax.devices()
+d = deep_spar(n_cases=2, nw_settings=(0.05, 0.5))
+m = Model(d, precision="float64")
+m.analyze_unloaded()
+args, _ = m.prepare_case_inputs(verbose=False)
+physics = SlotPhysics.from_model(m)
+nodes = m.nodes.astype(m.dtype)
+spec = choose_bucket(m.nw, nodes.r.shape[0], args[0].shape[0])
+nodes_s, args_s, _ = pack_slots([(nodes, args)], spec)
+devs = list(jax.devices())
+BLOCK = 4
+
+def run(n_dev):
+    dv = tuple(devs[:n_dev])
+    res = dispatch_slots(physics, spec, nodes_s, args_s,
+                         devices=dv, block=BLOCK)       # compile + bits
+    out = (np.asarray(res[0]), np.asarray(res[1]))
+    times = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        dispatch_slots(physics, spec, nodes_s, args_s,
+                       devices=dv, block=BLOCK)
+        times.append(time.perf_counter() - t0)
+    return out, min(times)
+
+(solo, t_solo), (shard, t_shard) = run(1), run(2)
+bits = (np.array_equal(solo[0], shard[0])
+        and np.array_equal(solo[1], shard[1]))
+assert bits, "sharded megabatch bits differ from 1-device lane mesh"
+print("RESULT " + json.dumps({
+    "bits_equal": bits, "solo_s": t_solo, "sharded_s": t_shard,
+    "ratio": t_solo / max(t_shard, 1e-9),
+    "lanes": int(spec.n_slots), "host_cpus": os.cpu_count(),
+}))
+"""
+
+
+def bench_multichip_smoke():
+    """Tier-1-safe multichip smoke: a fresh CPU interpreter split into 2
+    XLA host devices dispatches one bucket megabatch on a 1-device and a
+    2-device ('lane',) mesh and hard-asserts the results are
+    bit-identical — the sharding contract exercised on every
+    tier-1-adjacent run, not only on TPU rounds.  The throughput ratio
+    is recorded honestly: a genuine >=1.7x needs >=2 physical cores
+    (``multichip_smoke_host_cpus``); on a 1-core host the two virtual
+    devices share a core and the ratio hovers near 1."""
+    import subprocess
+    import tempfile
+
+    t0 = time.perf_counter()
+    with tempfile.NamedTemporaryFile(
+            "w", suffix=".py", delete=False) as fh:
+        fh.write(_MULTICHIP_SMOKE_SCRIPT)
+        script = fh.name
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    env["RAFT_TPU_HOST_DEVICES"] = "2"
+    env["RAFT_TPU_BENCH_ROOT"] = _ROOT
+    try:
+        proc = subprocess.run(
+            [sys.executable, script], capture_output=True,
+            text=True, timeout=300, env=env)
+        line = [ln for ln in proc.stdout.splitlines()
+                if ln.startswith("RESULT ")]
+        if proc.returncode != 0 or not line:
+            raise RuntimeError(
+                f"multichip smoke failed: {proc.stderr[-800:]}")
+        rep = json.loads(line[-1][len("RESULT "):])
+    finally:
+        os.unlink(script)
+    assert rep["bits_equal"] is True
+    return {
+        "multichip_smoke_bits": True,
+        "multichip_smoke_ratio": round(rep["ratio"], 2),
+        "multichip_smoke_solo_s": round(rep["solo_s"], 4),
+        "multichip_smoke_sharded_s": round(rep["sharded_s"], 4),
+        "multichip_smoke_host_cpus": rep["host_cpus"],
+        "multichip_smoke_s": round(time.perf_counter() - t0, 3),
     }
 
 

@@ -41,6 +41,9 @@ from raft_tpu.serve.buckets import (  # noqa: F401
     BucketSpec,
     SlotPhysics,
     choose_bucket,
+    lane_block,
+    serve_lane_devices,
+    sharded_slot_pipeline,
     slot_pipeline,
     slotted_case_dispatch,
 )

@@ -28,9 +28,22 @@ which is what makes "served == direct" an equality, not a tolerance.
 re-associates reductions by ~1 ulp, and the fixed point's 1% stopping
 test can amplify that to ~1e-4 — measured; hence canonical shapes, not
 per-request shapes.)
+
+**Multi-chip megabatches** (PR 8): the flattened lane axis optionally
+shards over a 1-D ``('lane',)`` device mesh.  Bit-identity across mesh
+widths needs the per-device partitioned program to keep ONE shape, so
+the sharded dispatch quantizes the megabatch into super-blocks of
+``n_devices * lane_block()`` lanes (inert first-lane-replicated padding,
+trimmed after) and every device always runs the same ``[lane_block()]``
+program — the recipe aero.py's host-sharded rotor batch proved makes a
+request served solo, coalesced, or sharded across 1/2/4/8 devices
+``np.array_equal``-identical.  Resolution is ``serve_lane_devices()``:
+on CPU the default stays the legacy single-device dispatch, so tier-1
+behavior is unchanged unless ``RAFT_TPU_SERVE_DEVICES`` opts in.
 """
 
 import dataclasses
+import os
 from functools import lru_cache
 from typing import NamedTuple
 
@@ -119,26 +132,130 @@ class SlotPhysics(NamedTuple):
 
 
 @lru_cache(maxsize=32)
+def _one_case_cached(physics, checkable=False):
+    """The per-lane case-dynamics function one physics configuration
+    bakes its scalars/frequency grid into (shared by the plain and the
+    sharded pipeline caches below)."""
+    w = np.frombuffer(physics.w_bytes, np.float64, count=physics.nw)
+    k = np.frombuffer(physics.k_bytes, np.float64, count=physics.nw)
+    dtype = np.dtype(physics.dtype_name).type
+    cdtype = np.dtype(physics.cdtype_name).type
+    return make_case_dynamics(
+        w, k, physics.depth, physics.rho, physics.g, physics.XiStart,
+        physics.nIter, dtype, cdtype, checkable=checkable,
+    )
+
+
+@lru_cache(maxsize=32)
 def _slot_pipeline_cached(physics, checkable=False):
     """The canonical slot executable family for one physics
     configuration: ``jit(vmap(one_case))`` with nodes batched per lane.
     Shapes are bound at call/lower time, so one cached jit serves every
     bucket of this physics; XLA's jit cache (and the persistent on-disk
     compilation cache) key the per-shape executables."""
-    w = np.frombuffer(physics.w_bytes, np.float64, count=physics.nw)
-    k = np.frombuffer(physics.k_bytes, np.float64, count=physics.nw)
-    dtype = np.dtype(physics.dtype_name).type
-    cdtype = np.dtype(physics.cdtype_name).type
-    one_case = make_case_dynamics(
-        w, k, physics.depth, physics.rho, physics.g, physics.XiStart,
-        physics.nIter, dtype, cdtype, checkable=checkable,
-    )
-    return jax.jit(jax.vmap(one_case))
+    return jax.jit(jax.vmap(_one_case_cached(physics, checkable)))
 
 
 def slot_pipeline(physics, checkable=False):
     """Public accessor for the cached slot executable family."""
     return _slot_pipeline_cached(physics, bool(checkable))
+
+
+# ------------------------------------------------------- multi-chip lanes
+
+DEFAULT_LANE_BLOCK = 8
+
+
+def lane_block():
+    """Per-device lane-block size of the sharded megabatch path
+    (``RAFT_TPU_SERVE_LANE_BLOCK``, default 8 — the smallest slot-ladder
+    rung, so even an uncoalesced minimum bucket fills whole blocks).
+    The block is part of the executable key (cache.topology_flags):
+    changing it changes program shapes, hence bits."""
+    try:
+        b = int(os.environ.get("RAFT_TPU_SERVE_LANE_BLOCK",
+                               DEFAULT_LANE_BLOCK))
+    except ValueError:
+        b = DEFAULT_LANE_BLOCK
+    return max(1, b)
+
+
+def serve_lane_devices(backend=None, n_devices=None):
+    """The devices the served megabatch's lane axis shards over, or None
+    for the legacy single-device dispatch.
+
+    Resolution: an explicit ``n_devices`` wins (tests/bench pass it to
+    pin a mesh width — ``1`` means a 1-device ``('lane',)`` mesh running
+    the same fixed-block program, the bit-identity baseline, NOT the
+    legacy dispatch); otherwise ``RAFT_TPU_SERVE_DEVICES``
+    (``all``/``0`` = every local device of the backend, ``N`` = the
+    first N, ``off``/``legacy`` = the legacy single-device path); unset
+    defaults to every device on accelerator backends and to the legacy
+    path on CPU — the automatic single-device fallback that keeps CPU
+    tier-1 behavior unchanged by default.
+    """
+    if n_devices is None:
+        raw = os.environ.get("RAFT_TPU_SERVE_DEVICES", "").strip().lower()
+        if not raw:
+            platform = backend or jax.default_backend()
+            if platform == "cpu":
+                return None
+            n_devices = 0
+        elif raw == "all":
+            n_devices = 0
+        elif raw in ("off", "legacy", "none"):
+            return None
+        else:
+            try:
+                n_devices = int(raw)
+            except ValueError:
+                from raft_tpu.utils.profiling import logger
+
+                logger.warning(
+                    "RAFT_TPU_SERVE_DEVICES=%r not an int, 'all', or "
+                    "'off'; falling back to single-device dispatch", raw)
+                return None
+    n_devices = int(n_devices)
+    try:
+        devs = list(jax.devices(backend)) if backend \
+            else list(jax.local_devices())
+    except RuntimeError:
+        return None
+    if n_devices > 0:
+        devs = devs[:n_devices]
+    return tuple(devs)
+
+
+@lru_cache(maxsize=32)
+def _sharded_slot_pipeline_cached(physics, devices, checkable=False):
+    """``jit(shard_map(vmap(one_case)))`` over the 1-D ``('lane',)`` mesh
+    of ``devices`` — every operand and output partitioned along the lane
+    axis, zero communication (lanes are data-independent).  Each device
+    runs a ``[lanes / n_devices]``-shaped partition; callers keep that
+    partition at ``lane_block()`` lanes for EVERY mesh width, which is
+    what makes results bit-identical across widths (same recipe as
+    aero._sharded_batch_fns).  Returns ``(fn, lane_sharding)``."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(devices), ("lane",))
+    spec = P("lane")
+    # check_rep=False: jax 0.4 has no replication rule for while_loop
+    # (the drag-linearization fixed point); sound here because every
+    # operand and output is fully lane-partitioned — nothing is
+    # replicated, and lanes never communicate
+    fn = shard_map(
+        jax.vmap(_one_case_cached(physics, checkable)), mesh=mesh,
+        in_specs=(spec,) * 8, out_specs=spec, check_rep=False,
+    )
+    return jax.jit(fn), NamedSharding(mesh, spec)
+
+
+def sharded_slot_pipeline(physics, devices, checkable=False):
+    """Public accessor for the cached sharded slot executable family of
+    one (physics, device tuple)."""
+    return _sharded_slot_pipeline_cached(
+        physics, tuple(devices), bool(checkable))
 
 
 # ------------------------------------------------------------------ shapes
@@ -197,24 +314,28 @@ def _stack_nodes(nodes_list):
     })
 
 
-def pack_slots(entries, spec):
+def pack_slots(entries, spec, capacity=None):
     """Pack prepared requests into one bucket megabatch.
 
     entries : list of ``(nodes, args)`` per request — ``nodes`` a
         HydroNodes bundle already cast to the working dtype, ``args`` the
         7-tuple from ``Model.prepare_case_inputs`` with leading [nc].
-    Returns ``(nodes_slots, args_slots, slot_ranges)``: the [n_slots]
+    capacity : lane count to pad to (default ``spec.n_slots``; the
+        sharded engine passes the megabatch quantized to whole
+        ``n_devices * lane_block()`` per-device blocks).
+    Returns ``(nodes_slots, args_slots, slot_ranges)``: the [capacity]
     stacked operands and per-request ``(start, stop)`` lane ranges.
 
     Padding lanes replicate the first real lane — always-finite work that
     converges with the batch (vmap freezing keeps real lanes exact
     regardless), and whose results are dropped at unpack.
     """
+    capacity = int(capacity) if capacity else spec.n_slots
     total = sum(e[1][0].shape[0] for e in entries)
-    if total > spec.n_slots:
+    if total > capacity:
         raise ValueError(
             f"pack_slots: {total} case lanes exceed bucket capacity "
-            f"{spec.n_slots}")
+            f"{capacity}")
     nodes_slots, args_cols = [], [[] for _ in range(7)]
     slot_ranges, cursor = [], 0
     for nodes, args in entries:
@@ -227,7 +348,7 @@ def pack_slots(entries, spec):
         cursor += nc
     for j in range(7):
         args_cols[j] = np.concatenate(args_cols[j], axis=0)
-    pad = spec.n_slots - cursor
+    pad = capacity - cursor
     if pad:
         nodes_slots.extend([nodes_slots[0]] * pad)
         for j in range(7):
@@ -237,11 +358,27 @@ def pack_slots(entries, spec):
 
 
 def dispatch_slots(physics, spec, nodes_slots, args_slots, sharding=None,
-                   checkable=False):
+                   checkable=False, devices=None, block=None):
     """Run one bucket megabatch through the canonical executable.
-    Returns the raw [n_slots] device outputs (callers unpack by slot
+    Returns the raw [lanes] device outputs (callers unpack by slot
     range).  ``sharding`` optionally commits the operands to a backend
-    (the Model(device=...) path)."""
+    (the Model(device=...) path).
+
+    ``devices`` selects the multi-chip megabatch path: lanes are laid
+    across the 1-D ``('lane',)`` mesh of those devices in super-blocks of
+    ``len(devices) * block`` lanes (``block`` defaults to
+    ``lane_block()``), one async dispatch each, so every device always
+    runs the same fixed ``[block]``-shaped partitioned program — results
+    are bit-identical across mesh widths 1/2/4/8 at equal ``block``
+    (PR 3's recipe on the serving lane axis).  Internal padding lanes
+    replicate lane 0 (always finite) and are trimmed before return;
+    ``sharding`` is ignored on this path (the lane NamedSharding places
+    the operands).  ``devices=None`` is the legacy single-device
+    dispatch, bit-for-bit unchanged."""
+    if devices:
+        return _dispatch_slots_sharded(
+            physics, spec, nodes_slots, args_slots, tuple(devices),
+            block=block, checkable=checkable)
     fn = slot_pipeline(physics, checkable)
     if sharding is not None:
         put = lambda a: jax.device_put(np.asarray(a), sharding)  # noqa: E731
@@ -250,6 +387,48 @@ def dispatch_slots(physics, spec, nodes_slots, args_slots, sharding=None,
     nodes_dev = jax.tree.map(put, nodes_slots)
     dev_args = tuple(put(a) for a in args_slots)
     out = fn(nodes_dev, *dev_args)
+    jax.block_until_ready(out[0])
+    return out
+
+
+def _pad_lanes(a, lanes):
+    """Pad a leading lane axis to ``lanes`` by replicating lane 0 (always
+    a real, finite lane under the pack_slots contract)."""
+    L0 = a.shape[0]
+    if L0 == lanes:
+        return a
+    xp = jnp if isinstance(a, jax.Array) else np
+    return xp.concatenate(
+        [a, xp.repeat(a[:1], lanes - L0, axis=0)], axis=0)
+
+
+def _dispatch_slots_sharded(physics, spec, nodes_slots, args_slots,
+                            devices, block=None, checkable=False):
+    """The fixed-block sharded megabatch dispatch (see dispatch_slots)."""
+    fn, lane_sharding = sharded_slot_pipeline(physics, devices, checkable)
+    B = int(block) if block else lane_block()
+    G = len(devices) * B                    # lanes per super-block
+    L0 = args_slots[0].shape[0]
+    Lq = _ceil_to(L0, G)
+    nodes_p = jax.tree.map(lambda a: _pad_lanes(a, Lq), nodes_slots)
+    args_p = tuple(_pad_lanes(a, Lq) for a in args_slots)
+    put = lambda a: jax.device_put(a, lane_sharding)  # noqa: E731
+    outs = []
+    for s0 in range(0, Lq, G):
+        sl = slice(s0, s0 + G)
+        nodes_sb = jax.tree.map(lambda a: put(a[sl]), nodes_p)
+        args_sb = tuple(put(a[sl]) for a in args_p)
+        outs.append(fn(nodes_sb, *args_sb))           # async dispatch
+    if len(outs) == 1:
+        xr, xi, rep = outs[0]
+    else:
+        xr = jnp.concatenate([o[0] for o in outs])
+        xi = jnp.concatenate([o[1] for o in outs])
+        rep = jax.tree.map(
+            lambda *leaves: jnp.concatenate(leaves),
+            *[o[2] for o in outs])
+    take = lambda a: a[:L0]  # noqa: E731
+    out = (take(xr), take(xi), jax.tree.map(take, rep))
     jax.block_until_ready(out[0])
     return out
 
@@ -274,19 +453,27 @@ def slotted_case_dispatch(model, spec, args):
     physics = SlotPhysics.from_model(model)
     nodes = model.nodes.astype(model.dtype)
     nodes_slots, args_slots, ranges = pack_slots([(nodes, args)], spec)
+    # default topology resolution, same as the engine's: on a
+    # multi-device backend the direct path shards exactly like the
+    # served megabatch, so "served == direct" stays an equality there too
     xr, xi, report = dispatch_slots(
         physics, spec, nodes_slots, args_slots,
         sharding=model._sharding, checkable=apply_debug_nans(),
+        devices=serve_lane_devices(model.device),
     )
     a, b = ranges[0]
     take = lambda arr: np.asarray(arr)[a:b]  # noqa: E731
     return take(xr), take(xi), jax.tree.map(take, report)
 
 
-def bucket_avals(physics, spec):
+def bucket_avals(physics, spec, lanes=None):
     """ShapeDtypeStruct avals of one bucket's operands — what AOT warm-up
-    lowers against (no real data needed)."""
+    lowers against (no real data needed).  ``lanes`` overrides the lane
+    count (the sharded path lowers against one ``n_devices * block``
+    super-block instead of ``n_slots``)."""
     L, N, nw = spec.n_slots, spec.n_nodes, spec.nw
+    if lanes:
+        L = int(lanes)
     dtype = np.dtype(physics.dtype_name)
     s = jax.ShapeDtypeStruct
     nfields = {}
@@ -309,12 +496,22 @@ def bucket_avals(physics, spec):
     return nodes, args
 
 
-def compile_bucket(physics, spec, checkable=False):
+def compile_bucket(physics, spec, checkable=False, devices=None,
+                   block=None):
     """AOT-compile one bucket's executable (``jit(...).lower().compile()``)
     against its avals.  With the persistent compilation cache configured
     (raft_tpu/__init__.py), the compiled artifact lands on disk and a
     fresh process re-running this call retrieves it instead of
-    recompiling — the warm-restart mechanism of the serve cache layer."""
+    recompiling — the warm-restart mechanism of the serve cache layer.
+    ``devices`` compiles the sharded program family instead, lowered
+    against one ``n_devices * block`` super-block (the only shape the
+    sharded dispatch ever runs)."""
+    if devices:
+        devices = tuple(devices)
+        fn, _ = sharded_slot_pipeline(physics, devices, checkable)
+        G = len(devices) * (int(block) if block else lane_block())
+        nodes, args = bucket_avals(physics, spec, lanes=G)
+        return fn.lower(nodes, *args).compile()
     fn = slot_pipeline(physics, checkable)
     nodes, args = bucket_avals(physics, spec)
     return fn.lower(nodes, *args).compile()

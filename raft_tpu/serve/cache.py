@@ -49,7 +49,6 @@ from raft_tpu.serve.buckets import (
     SlotPhysics,
     bucket_avals,
     compile_bucket,
-    slot_pipeline,
 )
 from raft_tpu.utils.profiling import logger
 
@@ -195,12 +194,28 @@ def code_version():
     return h.hexdigest()[:12]
 
 
+def topology_flags(devices=None, block=None):
+    """Device-topology component of the executable key for one lane-mesh
+    resolution (``devices=None`` = the legacy single-device dispatch).
+    The sharded megabatch program family is shaped by (mesh axis, width,
+    per-device lane block) — a single-device executable family must be
+    refused in a multi-device process and vice versa, and a different
+    block is a different program shape, hence different bits."""
+    from raft_tpu.serve.buckets import lane_block
+
+    if not devices:
+        return {"n_devices": 1, "mesh": None, "lane_block": None}
+    return {"n_devices": len(devices), "mesh": "lane",
+            "lane_block": int(block) if block else lane_block()}
+
+
 def current_flags():
     """The executable-compatibility key of the running process."""
     from raft_tpu.pallas_kernels import pallas_enabled
     from raft_tpu.precision import mixed_precision_enabled
+    from raft_tpu.serve.buckets import serve_lane_devices
 
-    return {
+    flags = {
         "backend": jax.default_backend(),
         "x64": bool(jax.config.jax_enable_x64),
         "jax": jax.__version__,
@@ -211,13 +226,26 @@ def current_flags():
         "pallas": bool(pallas_enabled()),
         "mixed_precision": bool(mixed_precision_enabled()),
     }
+    flags.update(topology_flags(serve_lane_devices()))
+    return flags
 
 
-def flags_mismatch(entry_flags, flags=None):
-    """Human-readable reason an entry's flags refuse reuse, or None."""
+#: flag keys every executable-reuse decision compares
+_FLAG_KEYS = ("backend", "x64", "code_version", "jax",
+              "pallas", "mixed_precision")
+#: topology keys — compared for executables/manifests, NOT for host-prep
+#: artifacts (prep bits are topology-independent: PR 3 measured
+#: host-sharded prep bit-identical to single-device)
+_TOPOLOGY_KEYS = ("n_devices", "mesh", "lane_block")
+
+
+def flags_mismatch(entry_flags, flags=None, topology=True):
+    """Human-readable reason an entry's flags refuse reuse, or None.
+    ``topology=False`` skips the device-topology keys (host-prep
+    artifacts are valid across topologies)."""
     flags = flags or current_flags()
-    for key in ("backend", "x64", "code_version", "jax",
-                "pallas", "mixed_precision"):
+    keys = _FLAG_KEYS + (_TOPOLOGY_KEYS if topology else ())
+    for key in keys:
         if entry_flags.get(key) != flags.get(key):
             return (f"{key}={entry_flags.get(key)!r} recorded but "
                     f"{flags.get(key)!r} running")
@@ -390,7 +418,10 @@ def warmup(manifest=None, designs=None, cases=None, precision=None,
                 # cache hot, not just the on-disk artifact
                 _execute_padding(physics, spec)
             else:
-                compile_bucket(physics, spec)
+                from raft_tpu.serve.buckets import serve_lane_devices
+
+                compile_bucket(physics, spec,
+                               devices=serve_lane_devices())
         warmed.append({
             "spec": spec.as_dict(),
             "compile_s": round(w.wall_s, 3),
@@ -415,7 +446,12 @@ def _execute_padding(physics, spec):
     """One jit-path execution on always-finite padding lanes (zeta=0, a
     positive-definite system): traces, compiles (or fetches from the
     persistent cache), and runs the bucket executable — so the first real
-    request pays neither compilation nor allocator/dispatch warm-up."""
+    request pays neither compilation nor allocator/dispatch warm-up.
+    Dispatches through the process's default lane topology, so a
+    multi-device process warms the sharded program family it will
+    actually serve with."""
+    from raft_tpu.serve.buckets import dispatch_slots, serve_lane_devices
+
     nodes_av, args_av = bucket_avals(physics, spec)
     dtype = np.dtype(physics.dtype_name)
     nodes = HydroNodes(**{
@@ -433,8 +469,8 @@ def _execute_padding(physics, spec):
         elif i == 3:
             a = a + np.eye(6, dtype=dtype)
         args.append(a)
-    out = slot_pipeline(physics)(nodes, *args)
-    jax.block_until_ready(out[0])
+    dispatch_slots(physics, spec, nodes, args,
+                   devices=serve_lane_devices())
 
 
 # -------------------------------------------------------------- prep cache
@@ -480,7 +516,10 @@ class PrepCache:
         try:
             with np.load(path, allow_pickle=False) as z:
                 meta = json.loads(str(z["meta"]))
-                reason = flags_mismatch(meta.get("flags", {}))
+                # topology=False: the stored arrays are host-side prep
+                # bits, identical whatever mesh later dispatches them
+                reason = flags_mismatch(meta.get("flags", {}),
+                                        topology=False)
                 if reason:
                     logger.warning(
                         "serve prep cache: entry %s refused (%s)",

@@ -79,15 +79,19 @@ from raft_tpu.serve.buckets import (
     SlotPhysics,
     choose_bucket,
     dispatch_slots,
+    lane_block,
     pack_slots,
+    serve_lane_devices,
 )
 from raft_tpu.serve.cache import (
     CompileWatcher,
     PrepCache,
     WarmupManifest,
+    current_flags,
     design_prep_key,
     install_compile_listeners,
     persist_all_compiles,
+    topology_flags,
     warmup,
 )
 from raft_tpu.utils.profiling import logger
@@ -133,10 +137,19 @@ class EngineConfig:
         parameters, per (backend, bucket).
     degrade_to_cpu : when a breaker is open and the default backend is
         an accelerator, serve that bucket on CPU instead of fast-failing.
+    serve_devices / lane_block : multi-chip megabatch topology.  ``None``
+        defers to ``RAFT_TPU_SERVE_DEVICES`` / ``RAFT_TPU_SERVE_LANE_BLOCK``
+        and the backend default (all devices on accelerators, legacy
+        single-device on CPU — buckets.serve_lane_devices); an int pins
+        the lane-mesh width / per-device block explicitly (width 1 = a
+        1-device mesh running the same fixed-block program, the
+        bit-identity baseline of the sharded path).
     """
 
     precision: str = None
     device: str = None
+    serve_devices: int = None
+    lane_block: int = None
     window_ms: float = dataclasses.field(
         default_factory=lambda: _env_float("RAFT_TPU_SERVE_WINDOW_MS", 5.0))
     node_quantum: int = dataclasses.field(
@@ -330,6 +343,13 @@ class Engine:
         self._ema_dispatch_s = None
         self._watch_lock = threading.Lock()
         self._inflight = None                  # dict | None (watchdog)
+        # multi-chip lane topology of the primary backend (the degraded
+        # path re-resolves for CPU); width 1 = legacy single-device
+        self._lane_block = (int(self.config.lane_block)
+                            if self.config.lane_block else lane_block())
+        primary = self._lane_devices(self.config.device)
+        self._mesh_width = len(primary) if primary else 1
+        self._lane_mesh = primary is not None
         self.stats = {
             "requests": 0, "dispatches": 0, "failed": 0,
             "rejected_deadline": 0, "rejected_overload": 0,
@@ -487,16 +507,24 @@ class Engine:
     def _predicted_wait_locked(self, now):
         """Conservative lower bound on this submit's queue wait: the
         estimated remainder of the dispatch currently in flight (EMA of
-        recent dispatch walls).  Zero when idle or without history —
-        admission must never reject a servable request."""
+        recent dispatch walls), plus — on the sharded path — the queued
+        backlog divided by the mesh's per-dispatch request capacity (a
+        wider mesh coalesces proportionally more lanes per dispatch, so
+        the same backlog predicts proportionally less wait).  Zero when
+        idle or without history — admission must never reject a servable
+        request."""
         ema = self._ema_dispatch_s
         if ema is None:
             return 0.0
+        predicted = 0.0
+        if self._mesh_width > 1:
+            per_dispatch = max(1, self.config.coalesce * self._mesh_width)
+            predicted += (len(self._queue) // per_dispatch) * ema
         with self._watch_lock:
             inf = self._inflight
             if inf is None:
-                return 0.0
-            return max(0.0, ema - (now - inf["t0"]))
+                return predicted
+            return predicted + max(0.0, ema - (now - inf["t0"]))
 
     # --------------------------------------------------------------- prep
 
@@ -587,13 +615,25 @@ class Engine:
                 except OSError as e:
                     logger.warning("serve prep cache write failed: %s", e)
             if self._manifest is not None:
-                self._manifest.record(physics, prepped.spec)
+                self._manifest.record(physics, prepped.spec,
+                                      flags=self._manifest_flags())
 
         with self._prep_lock:
             self._prep_memo[key] = prepped
             while len(self._prep_memo) > self._prep_memo_cap:
                 self._prep_memo.popitem(last=False)
         return prepped
+
+    def _manifest_flags(self):
+        """Executable-compatibility flags of THIS engine's dispatches:
+        process flags overlaid with the engine's resolved lane topology
+        (which may be pinned by config rather than env) — so a manifest
+        recorded by a 2-device engine is refused by a single-device
+        warmup and vice versa."""
+        flags = current_flags()
+        flags.update(topology_flags(
+            self._lane_devices(self.config.device), self._lane_block))
+        return flags
 
     # ------------------------------------------------------------ batcher
 
@@ -821,6 +861,8 @@ class Engine:
         self._dispatch_guarded(physics, spec, members, lanes, breaker,
                                backend=backend,
                                sharding=self._sharding_for(
+                                   self.config.device),
+                               devices=self._lane_devices(
                                    self.config.device))
 
     def _can_degrade(self, backend):
@@ -851,7 +893,8 @@ class Engine:
             "backend", self.config.device or jax.default_backend(), spec)
         self._dispatch_guarded(physics, spec, members, lanes, breaker,
                                backend="cpu-degraded",
-                               sharding=self._sharding_for("cpu"))
+                               sharding=self._sharding_for("cpu"),
+                               devices=self._lane_devices("cpu"))
 
     @staticmethod
     def _sharding_for(device):
@@ -861,16 +904,36 @@ class Engine:
 
         return backend_sharding(device)
 
+    def _lane_devices(self, backend):
+        """Lane-mesh devices for one backend, or None (legacy
+        single-device dispatch) — config.serve_devices pins the width,
+        else env/backend policy (buckets.serve_lane_devices)."""
+        return serve_lane_devices(backend, self.config.serve_devices)
+
+    def _dispatch_capacity(self, spec, devices):
+        """Lane capacity of one dispatch: the bucket's slot count,
+        quantized up to whole ``n_devices * lane_block`` per-device
+        blocks on the sharded path (the occupancy denominator — wider
+        meshes serve proportionally larger megabatches)."""
+        if not devices:
+            return spec.n_slots
+        G = len(devices) * self._lane_block
+        return -(-max(spec.n_slots, G) // G) * G
+
     def _dispatch_guarded(self, physics, spec, members, lanes, breaker,
-                          backend, sharding):
+                          backend, sharding, devices=None):
         """One bucket dispatch under the full envelope: watchdog wall
         clock, transient-error retry (same packed operands), breaker
-        accounting, then per-request result delivery."""
+        accounting, then per-request result delivery.  ``devices`` routes
+        the megabatch through the fixed-block lane-sharded executable
+        (bit-identical across mesh widths; buckets.dispatch_slots)."""
         t0 = time.perf_counter()
         entries = self._member_entries(members)
+        capacity = self._dispatch_capacity(spec, devices)
         try:
             with CompileWatcher() as w:
-                nodes_s, args_s, ranges = pack_slots(entries, spec)
+                nodes_s, args_s, ranges = pack_slots(entries, spec,
+                                                     capacity=capacity)
 
                 def _call():
                     if self._chaos is not None:
@@ -878,7 +941,9 @@ class Engine:
                         self._chaos.raise_if(
                             "backend_error", exc=ChaosBackendError)
                     return dispatch_slots(physics, spec, nodes_s, args_s,
-                                          sharding=sharding)
+                                          sharding=sharding,
+                                          devices=devices,
+                                          block=self._lane_block)
 
                 out = self._dispatch_policy.run(
                     lambda: self._watched_call(_call),
@@ -918,7 +983,10 @@ class Engine:
             })
         xr = np.asarray(xr)
         xi = np.asarray(xi)
-        occupancy = lanes / spec.n_slots
+        # occupancy over the QUANTIZED capacity: on the sharded path the
+        # denominator scales with the mesh width, so the stat reads as
+        # "fraction of the whole mesh's lane capacity doing real work"
+        occupancy = lanes / capacity
         self.stats["dispatches"] += 1
         self.stats["occupancy"].append(occupancy)
         self.stats["batch_requests"].append(len(members))
@@ -1043,6 +1111,11 @@ class Engine:
             "warmup": self.stats["warmup"],
             "breakers": self._breakers.snapshot(),
             "breaker_transitions": self._breakers.transition_count(),
+            # lane-mesh topology the primary backend dispatches under
+            "serve_devices": self._mesh_width,
+            "lane_block": (self._lane_block
+                           if self._lane_mesh else None),
+            "mesh": "lane" if self._lane_mesh else None,
         }
         if self._chaos is not None:
             out["chaos"] = self._chaos.snapshot()

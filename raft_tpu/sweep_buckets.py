@@ -14,7 +14,10 @@ touches is recorded in the serve warm-up manifest, so
 ``raft_tpu.serve.cache.warmup()`` in a fresh process pre-compiles (or
 persistent-cache-loads) exactly the executables the next sweep needs —
 the fixed-shape program-reuse discipline of TPU CFD frameworks
-(arXiv:2108.11076) applied to the design sweep.
+(arXiv:2108.11076) applied to the design sweep.  On multi-device
+backends the slabs dispatch through the engine's lane-sharded
+fixed-block executables (serve.buckets.sharded_slot_pipeline), so the
+sweep weak-scales over the same 1-D ``('lane',)`` mesh the server uses.
 
 Routing is opt-in: ``RAFT_TPU_SWEEP_BUCKETS=1`` (or the drivers'
 ``via_buckets=True``).  Off (the default), the drivers' fused pipelines
@@ -47,6 +50,9 @@ import jax.numpy as jnp
 from raft_tpu.serve.buckets import (
     SlotPhysics,
     choose_bucket,
+    lane_block,
+    serve_lane_devices,
+    sharded_slot_pipeline,
     slot_pipeline,
 )
 from raft_tpu.utils.profiling import logger
@@ -103,29 +109,50 @@ def _pad_node_axis(nodes_stacked, n_nodes):
 
 
 def dispatch_lanes(physics, spec, n_lanes, slab_args, checkable=False,
-                   record=True):
+                   record=True, devices=None):
     """Run ``n_lanes`` flattened (design x case) lanes through the
     canonical slot executable of ``spec``, ``spec.n_slots`` lanes per
     dispatch (all dispatches issued async, results concatenated on
     device).
 
-    slab_args(idx) -> (nodes_slab, args_slab): the [n_slots] operand
+    slab_args(idx) -> (nodes_slab, args_slab): the [len(idx)] operand
     gather for the given lane indices (``idx`` is tail-padded with lane
     0 — replicated-first-lane padding, same contract as
     serve.buckets.pack_slots; padded results are trimmed here).
 
+    devices : lane-mesh devices for the multi-chip sharded executables
+        (default: ``serve_lane_devices()`` — every device on accelerator
+        backends, legacy single-device on CPU).  On the sharded path each
+        slab is one ``len(devices) * lane_block()`` super-block laid
+        across the 1-D ``('lane',)`` mesh, the SAME fixed-block program
+        family the serving engine dispatches — so 256-design sweeps
+        weak-scale over the mesh and share the engine's warm executables.
+
     Returns ``(xr [n_lanes, 6, nw], xi, report)`` device arrays.
     """
-    fn = slot_pipeline(physics, checkable)
+    if devices is None:
+        devices = serve_lane_devices()
+    if devices:
+        fn, lane_sharding = sharded_slot_pipeline(
+            physics, devices, checkable)
+        chunk = len(devices) * lane_block()
+        put = lambda a: jax.device_put(a, lane_sharding)  # noqa: E731
+    else:
+        fn = slot_pipeline(physics, checkable)
+        chunk = spec.n_slots
+        put = None
     if record:
         _record_bucket(physics, spec)
     outs = []
-    for s0 in range(0, n_lanes, spec.n_slots):
-        idx = np.arange(s0, min(s0 + spec.n_slots, n_lanes))
-        if len(idx) < spec.n_slots:
+    for s0 in range(0, n_lanes, chunk):
+        idx = np.arange(s0, min(s0 + chunk, n_lanes))
+        if len(idx) < chunk:
             idx = np.concatenate(
-                [idx, np.zeros(spec.n_slots - len(idx), idx.dtype)])
+                [idx, np.zeros(chunk - len(idx), idx.dtype)])
         nodes_slab, args_slab = slab_args(idx)
+        if put is not None:
+            nodes_slab = jax.tree.map(put, nodes_slab)
+            args_slab = tuple(put(a) for a in args_slab)
         outs.append(fn(nodes_slab, *args_slab))       # async dispatch
     if len(outs) == 1:
         xr, xi, rep = outs[0]

@@ -73,6 +73,71 @@ def test_write_full_applies_sanitizer(tmp_path):
                     "bad_metric_error": "RuntimeError: section leaked"}
 
 
+def test_sanitize_multichip_filters_aot_noise_and_structures_tail():
+    noise = ("E0731 14:12:18.699120 4968 cpu_aot_loader.cc:210] Loading "
+             "XLA:CPU AOT result. Target machine feature +prefer-no-gather "
+             "is not supported on the host machine.")
+    doc = {
+        "n_devices": "8",
+        "rc": 0,
+        "tail": "\n".join(
+            [noise] * 3
+            + ["es,fxsr,avx512dq]. This could lead to execution errors "
+               "such as SIGILL.",
+               "dryrun_multichip OK: mesh (4 case x 2 freq), Xi shape "
+               "(4, 6, 8)",
+               "dryrun_multichip OK: serve megabatch on (8 lane,) mesh"]),
+    }
+    bench.sanitize_multichip(doc)
+    assert doc["n_devices"] == 8
+    assert "cpu_aot_loader" not in doc["tail"]
+    assert "SIGILL" not in doc["tail"]
+    assert doc["tail_noise_filtered"] == 4
+    assert doc["sections"] == [
+        "mesh (4 case x 2 freq), Xi shape (4, 6, 8)",
+        "serve megabatch on (8 lane,) mesh",
+    ]
+    # idempotent: a second pass filters nothing new
+    bench.sanitize_multichip(doc)
+    assert doc["tail_noise_filtered"] == 4
+
+
+def test_sanitize_multichip_caps_tail_keeping_the_end():
+    doc = {"tail": "x" * 5000 + "\nfinal verdict line"}
+    bench.sanitize_multichip(doc, tail_cap=100)
+    assert len(doc["tail"]) == 100
+    assert doc["tail"].endswith("final verdict line")
+
+
+def test_sanitize_multichip_applies_error_key_rule():
+    doc = {"tail": "dryrun_multichip OK: fine",
+           "status": "RuntimeError: harness exploded"}
+    bench.sanitize_multichip(doc)
+    assert "status" not in doc
+    assert doc["status_error"].startswith("RuntimeError")
+
+
+def test_committed_multichip_artifacts_are_sanitized():
+    """The committed MULTICHIP_r*.json artifacts carry no AOT loader
+    noise, a capped tail, and the structured n_devices/sections keys
+    (bench.py --sanitize-multichip keeps them that way)."""
+    import glob
+
+    paths = sorted(glob.glob(os.path.join(ROOT, "MULTICHIP_r*.json")))
+    assert paths, "no MULTICHIP artifacts found to check"
+    for path in paths:
+        with open(path) as fh:
+            doc = json.load(fh)
+        name = os.path.basename(path)
+        tail = doc.get("tail", "")
+        for marker in bench._MULTICHIP_NOISE_MARKERS:
+            assert marker not in tail, f"{name}: noise marker {marker!r}"
+        assert len(tail) <= bench._MULTICHIP_TAIL_CAP, name
+        assert isinstance(doc.get("sections"), list), name
+        if "n_devices" in doc:
+            assert isinstance(doc["n_devices"], int), name
+
+
 def test_committed_bench_artifacts_respect_schema():
     """Every committed bench artifact (BENCH_FULL.json and the recorded
     BENCH_r*.json tails) carries exception strings only under *_error

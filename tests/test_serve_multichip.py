@@ -1,0 +1,221 @@
+"""Multi-chip megabatch sharding (PR 8): the served (request x case)
+lane axis laid across a 1-D ('lane',) device mesh with a FIXED per-device
+block shape.
+
+The contract under test is bit-identity across mesh widths: because
+every device always runs the same [block]-shaped partitioned program and
+lanes group into the same consecutive blocks at every width, a megabatch
+dispatched on a 1/2/4-device lane mesh returns ``np.array_equal``
+results — including with padded partial super-blocks and with a
+NaN-quarantined lane inside each device block.  The cache layer must
+refuse manifest entries recorded under a different topology (the
+executables are different programs), while the host-prep cache — whose
+bits are topology-independent — must not.
+
+conftest.py gives every tier-1 process 8 virtual XLA:CPU devices, so the
+real shard_map path compiles and runs here without TPU hardware.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from raft_tpu.designs import deep_spar
+from raft_tpu.model import Model
+from raft_tpu.serve import Engine, EngineConfig
+from raft_tpu.serve.buckets import (
+    SlotPhysics,
+    choose_bucket,
+    dispatch_slots,
+    pack_slots,
+    serve_lane_devices,
+)
+from raft_tpu.serve.cache import (
+    WarmupManifest,
+    current_flags,
+    flags_mismatch,
+    topology_flags,
+    warmup,
+)
+
+NW = (0.05, 0.5)    # small frequency grid keeps compiles cheap
+
+
+def _spar(rho_fill=1800.0, n_cases=2):
+    d = deep_spar(n_cases=n_cases, nw_settings=NW)
+    d["platform"]["members"][0]["rho_fill"] = [float(rho_fill), 0.0, 0.0]
+    return d
+
+
+def _engine(tmp_path, **kw):
+    kw.setdefault("precision", "float64")
+    kw.setdefault("window_ms", 100.0)
+    kw.setdefault("cache_dir", str(tmp_path))
+    return Engine(EngineConfig(**kw))
+
+
+@pytest.fixture(scope="module")
+def packed():
+    """One packed bucket megabatch: 8 lanes (2 real cases + replicated
+    padding) of the small spar, plus its physics/spec."""
+    m = Model(_spar(), precision="float64")
+    m.analyze_unloaded()
+    args, _ = m.prepare_case_inputs(verbose=False)
+    physics = SlotPhysics.from_model(m)
+    nodes = m.nodes.astype(m.dtype)
+    spec = choose_bucket(m.nw, nodes.r.shape[0], args[0].shape[0])
+    nodes_s, args_s, _ = pack_slots([(nodes, args)], spec)
+    return physics, spec, nodes_s, args_s
+
+
+def _run(packed_tuple, n_devices, block, args_override=None):
+    physics, spec, nodes_s, args_s = packed_tuple
+    if args_override is not None:
+        args_s = args_override
+    devs = tuple(jax.devices()[:n_devices])
+    xr, xi, rep = dispatch_slots(physics, spec, nodes_s, args_s,
+                                 devices=devs, block=block)
+    return (np.asarray(xr), np.asarray(xi),
+            np.asarray(rep.converged), np.asarray(rep.nonfinite))
+
+
+# --------------------------------------------------------- bit identity
+
+def test_sharded_bit_identity_across_mesh_widths(packed):
+    """The same megabatch on 1/2/4-device lane meshes at one block size:
+    results (and the solve report) must be equal to the bit."""
+    base = _run(packed, 1, block=2)
+    for n_dev in (2, 4):
+        got = _run(packed, n_dev, block=2)
+        for a, b in zip(base, got):
+            assert np.array_equal(a, b), f"width {n_dev} drifted"
+    assert base[2].all()        # every lane converged
+
+
+def test_sharded_bit_identity_with_padded_partial_block(packed):
+    """block=3 does not divide the 8-lane megabatch: the sharded path
+    pads a partial super-block with replicated lane-0 lanes and trims
+    them after.  The padding must stay inert — trimmed results equal
+    across widths, full-lane count preserved."""
+    base = _run(packed, 1, block=3)
+    got = _run(packed, 2, block=3)
+    assert base[0].shape[0] == packed[1].n_slots
+    for a, b in zip(base, got):
+        assert np.array_equal(a, b)
+
+
+def test_nan_quarantined_lane_in_each_device_block(packed):
+    """A NaN-poisoned lane inside EVERY device block of the 2-device
+    mesh: quarantine must flag exactly those lanes, freeze them finite,
+    and leave the healthy lanes bit-identical to the 1-device mesh."""
+    physics, spec, nodes_s, args_s = packed
+    poisoned = tuple(np.array(a, copy=True) for a in args_s)
+    bad_lanes = (1, 3, 5, 7)    # one per block of 2 at every width
+    for lane in bad_lanes:
+        poisoned[0][lane] = np.nan          # zeta -> NaN excitation
+    base = _run(packed, 1, block=2, args_override=poisoned)
+    got = _run(packed, 2, block=2, args_override=poisoned)
+    for a, b in zip(base, got):
+        assert np.array_equal(a, b)
+    nonfinite = base[3]
+    assert nonfinite[list(bad_lanes)].all()
+    healthy = [i for i in range(spec.n_slots) if i not in bad_lanes]
+    assert not nonfinite[healthy].any()
+    assert np.isfinite(base[0]).all()       # frozen, not NaN'd
+
+    # healthy lanes' bits unchanged by their poisoned block-mates
+    clean = _run(packed, 2, block=2)
+    assert np.array_equal(base[0][healthy], clean[0][healthy])
+
+
+# --------------------------------------------------------------- engine
+
+def test_engine_block_packing_never_splits_results(tmp_path):
+    """Two 3-case requests coalesced on a 2-device mesh with block=2:
+    lanes straddle device-block boundaries (3 does not divide 2), yet
+    every request's served bits must equal the same request served solo
+    on the 1-device lane mesh — packing may split a request across
+    blocks, but never in a way that changes results."""
+    d1, d2 = _spar(1800.0, n_cases=3), _spar(1500.0, n_cases=3)
+    with _engine(tmp_path / "a", serve_devices=2, lane_block=2) as eng:
+        h1, h2 = eng.submit(d1), eng.submit(d2)
+        r1, r2 = h1.result(timeout=600), h2.result(timeout=600)
+        snap = eng.snapshot()
+    assert r1.status == "ok" and r2.status == "ok"
+    assert snap["dispatches"] < snap["requests"]    # they coalesced
+    assert snap["mesh"] == "lane"
+    assert snap["serve_devices"] == 2 and snap["lane_block"] == 2
+
+    with _engine(tmp_path / "b", serve_devices=1, lane_block=2) as solo:
+        s1 = solo.evaluate(d1, timeout=600)
+        s2 = solo.evaluate(d2, timeout=600)
+    assert np.array_equal(r1.Xi, s1.Xi)
+    assert np.array_equal(r2.Xi, s2.Xi)
+    assert np.array_equal(r1.std, s1.std)
+    assert np.array_equal(r2.std, s2.std)
+
+
+def test_engine_capacity_quantized_to_device_blocks(tmp_path):
+    """Occupancy on the sharded path is lanes / quantized capacity: a
+    2-case request in an 8-slot bucket on a 2x2 lane mesh reports
+    2/8 (capacity stays at n_slots when it already divides into whole
+    device blocks)."""
+    with _engine(tmp_path, serve_devices=2, lane_block=2) as eng:
+        r = eng.evaluate(_spar(), timeout=600)
+    assert r.status == "ok"
+    assert r.batch_occupancy == pytest.approx(2 / 8)
+
+
+# ---------------------------------------------------------------- cache
+
+def test_cross_topology_manifest_refused(tmp_path, packed):
+    """A manifest entry recorded under a 4-device lane mesh must be
+    refused (with the topology key in the reason) by a warmup running
+    the legacy single-device topology — the executables are different
+    programs."""
+    physics, spec = packed[0], packed[1]
+    man = WarmupManifest(cache_dir=str(tmp_path))
+    stale = dict(current_flags())
+    stale.update(topology_flags(tuple(jax.devices()[:4]), 2))
+    man.record(physics, spec, flags=stale)
+
+    report = warmup(manifest=man, cache_dir=str(tmp_path), execute=False)
+    assert report["rejected"], report
+    assert "n_devices" in report["rejected"][0]["reason"]
+    assert not report["warmed"]
+
+
+def test_topology_flags_and_mismatch_scope():
+    """flags_mismatch flags topology drift by default; topology=False
+    (the host-prep cache's check — prep bits are topology-independent)
+    ignores it."""
+    flags = current_flags()
+    assert topology_flags(None) == {
+        "n_devices": 1, "mesh": None, "lane_block": None}
+    stale = dict(flags)
+    stale.update(topology_flags(tuple(jax.devices()[:2]), 4))
+    assert stale["n_devices"] == 2 and stale["mesh"] == "lane"
+    reason = flags_mismatch(stale, flags)
+    assert reason and "n_devices" in reason
+    assert flags_mismatch(stale, flags, topology=False) is None
+    assert flags_mismatch(dict(flags), flags) is None
+
+
+# ----------------------------------------------------- device resolution
+
+def test_serve_lane_devices_resolution(monkeypatch):
+    monkeypatch.delenv("RAFT_TPU_SERVE_DEVICES", raising=False)
+    # unset on CPU -> legacy single-device fallback (tier-1 default)
+    assert serve_lane_devices() is None
+    # explicit width wins; 1 is a 1-device MESH, not legacy
+    assert len(serve_lane_devices(n_devices=1)) == 1
+    assert len(serve_lane_devices(n_devices=4)) == 4
+    monkeypatch.setenv("RAFT_TPU_SERVE_DEVICES", "2")
+    assert len(serve_lane_devices()) == 2
+    monkeypatch.setenv("RAFT_TPU_SERVE_DEVICES", "all")
+    assert len(serve_lane_devices()) == len(jax.devices())
+    monkeypatch.setenv("RAFT_TPU_SERVE_DEVICES", "off")
+    assert serve_lane_devices() is None
+    monkeypatch.setenv("RAFT_TPU_SERVE_DEVICES", "bogus")
+    assert serve_lane_devices() is None
