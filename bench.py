@@ -66,8 +66,15 @@ _COMPACT_KEYS = (
     "on_device_per_solve_s", "vs_baseline_on_device",
     "pipelined_per_solve_s", "vs_baseline_pipelined", "rao_linf_err",
     "backend",
+    # iteration spread p95/max stay in BENCH_FULL.json only — the
+    # compact line must hold under the driver's 2000-char stdout tail
+    "rao_iters_p50", "rao_wasted_lane_iters_frac",
     "sweep_n_designs", "sweep_wall_s", "sweep_per_design_ms",
     "sweep_vs_baseline", "sweep_rao_linf_err", "sweep_converged_frac",
+    "sweep_iters_p50", "sweep_wasted_lane_iters_frac",
+    "waterfall_vs_legacy", "waterfall_bit_identical",
+    "waterfall_wasted_lane_iters_frac_legacy",
+    "waterfall_wasted_lane_iters_frac",
     "sweep_rotor_stage_s", "sweep_overlap_saved_s",
     "sweep_overlap_cross_backend_s", "sweep_host_devices",
     "sweep243_vs_baseline", "sweep243_per_design_ms",
@@ -93,6 +100,7 @@ _COMPACT_KEYS = (
     "rao_error", "sweep_error", "sweep243_error", "bem_error",
     "bem_sharded_error", "grad_error", "serve_error",
     "chaos_smoke_error", "kernel_error", "sweep_warm_error",
+    "sweep_waterfall_error",
     "perf_docs_error", "sweep_scaling_error", "sweep1024_error",
     "sweep4096_error", "serve_multichip_error", "multichip_smoke_error",
 )
@@ -404,24 +412,30 @@ def main(argv=None):
             # and is the one section allowed to starve others when a
             # cold cache pushes its first run toward the recorded
             # 389 s; sweep243 ≈ 130 s (8-design baseline 115 s); the
-            # bem trio and grad were never fully recorded under the
-            # enforced budget (r04 bem_error, r05 rc=124), so their
-            # weights stay sized to the pre-budget estimates; serve is
-            # bounded by two CPU subprocesses plus one bucket compile.
-            ("rao", bench_rao, 1.0),
+            # Weights sized to the observed PR 9 round costs in seconds
+            # (weight ~ cost/10 with headroom): rao ~90 s (20 s model build
+            # + the CPU-depth pipelined stage), sweep 230 s (now includes the 30 s
+            # aero-servo slice), waterfall A/B 55 s, bem ~200+ s, serve
+            # 45 s, sweep_warm 35 s; the instant structured skips
+            # (scaling on CPU, sweep243 without the reference design,
+            # multichip single-device) get token weights so they stop
+            # diluting slices for sections that do run.
+            ("rao", bench_rao, 10.5),
             ("sweep", lambda: bench_sweep.run(baseline_limit=16,
-                                              verbose=False), 10.0),
-            ("sweep_scaling", run_scaling, 1.5),
+                                              verbose=False), 25.0),
+            ("sweep_waterfall", lambda: bench_sweep.run_waterfall(
+                verbose=False), 7.0),
+            ("sweep_scaling", run_scaling, 0.5),
             ("sweep243", lambda: bench_sweep.run_geometry(
-                baseline_limit=8, verbose=False), 4.0),
-            ("bem", bench_bem, 3.0),
-            ("bem_sharded", bench_bem_sharded, 0.5),
-            ("bem_stream", bench_bem_stream, 1.5),
-            ("grad", bench_gradients, 1.0),
-            ("serve", bench_serve, 2.0),
-            ("serve_multichip", bench_serve_multichip, 1.0),
-            ("kernel", bench_kernels, 1.0),
-            ("sweep_warm", bench_sweep_warm, 2.0),
+                baseline_limit=8, verbose=False), 0.5),
+            ("bem", bench_bem, 25.0),
+            ("bem_sharded", bench_bem_sharded, 1.0),
+            ("bem_stream", bench_bem_stream, 3.0),
+            ("grad", bench_gradients, 0.5),
+            ("serve", bench_serve, 5.0),
+            ("serve_multichip", bench_serve_multichip, 0.5),
+            ("kernel", bench_kernels, 0.5),
+            ("sweep_warm", bench_sweep_warm, 4.0),
         ]
 
     out = {}
@@ -495,6 +509,7 @@ def bench_rao():
         times.append(time.perf_counter() - t0)
     t_jax = min(times)
     Xi_jax = np.asarray(out[0], np.float64) + 1j * np.asarray(out[1], np.float64)
+    rao_iters = np.asarray(out[2].iters)
 
     # on-device per-solve time: K back-to-back solves inside ONE dispatch
     # (a lax.scan with a data dependency so XLA cannot collapse them).
@@ -542,6 +557,13 @@ def bench_rao():
     B, D = 8, 16   # 128 in-flight solves: deep enough that the ~0.2 s of
     #                fixed tunnel costs (first RTT + final fetch) stay
     #                under ~15% of the total across run-to-run variance
+    if jax.default_backend() == "cpu":
+        # the host backend has no tunnel RTT to amortize, and the 8-wide
+        # vmapped pipeline costs ~0.25 s/solve on a small-core box —
+        # 128-deep best-of-5 would spend >150 s measuring overlap that
+        # cannot exist there.  32 in-flight solves keep the identical
+        # per-solve math at CPU-round cost (depth is recorded below).
+        D = 4
     pipe_v = jax.jit(jax.vmap(pipe, in_axes=(0,) + (None,) * 6))
     combine = jax.jit(
         lambda xs, ys: jax.numpy.stack(
@@ -618,6 +640,11 @@ def bench_rao():
         "rao_linf_err": rao_err,
         "backend": jax.default_backend(),
     }
+    # per-lane fixed-point iteration telemetry (ISSUE 9 satellite): how
+    # much monolithic-while_loop headroom this case batch leaves for the
+    # convergence-aware waterfall (raft_tpu/waterfall.py)
+    from bench_sweep import iters_telemetry
+    out.update(iters_telemetry("rao", rao_iters))
     return out
 
 
@@ -1412,10 +1439,29 @@ def perf_md_text(d):
             row(label,
                 f"{_fmt(d.get(f'{key}_wall_s'))} s total, "
                 f"{_fmt(d.get(f'{key}_per_design_ms'))} ms/design")
+    if "sweep_iters_p50" in d:
+        row("fixed-point iteration spread (hot sweep lanes)",
+            f"p50 {_fmt(d['sweep_iters_p50'], 1)} / p95 "
+            f"{_fmt(d.get('sweep_iters_p95', 0.0), 1)} / max "
+            f"{d.get('sweep_iters_max')}; wasted lane-iteration fraction "
+            f"{_fmt(d.get('sweep_wasted_lane_iters_frac', 0.0))}")
     if "sweep243_vs_baseline" in d:
         row("3⁵ = 243-point 5-parameter geometry study",
             f"{_fmt(d.get('sweep243_wall_s'))} s total — "
             f"{_fmt(d.get('sweep243_vs_baseline'), 1)}× vs the serial loop")
+    if "waterfall_vs_legacy" in d:
+        row(
+            "**convergence-aware fixed-point engine (iteration "
+            f"waterfall), {d.get('waterfall_n_designs', '?')}-design "
+            "heterogeneous dynamics stage**",
+            f"**legacy {_fmt(d.get('waterfall_legacy_dynamics_s'))} s → "
+            f"waterfall {_fmt(d.get('waterfall_dynamics_s'))} s "
+            f"({_fmt(d['waterfall_vs_legacy'], 1)}×)**, bit-identical "
+            f"{d.get('waterfall_bit_identical')}; wasted lane-iteration "
+            "fraction "
+            f"{_fmt(d.get('waterfall_wasted_lane_iters_frac_legacy', 0.0))}"
+            f" → {_fmt(d.get('waterfall_wasted_lane_iters_frac', 0.0))}",
+        )
     if "value" in d:
         row("single-dispatch RAO solve wall-clock (128 ω × 12 cases)",
             f"{_fmt(d['value'], 3)} s ({_fmt(d.get('vs_baseline', 0.0), 1)}× "

@@ -359,6 +359,40 @@ def run(baseline_limit=None, verbose=True):
         "sweep_rotor_telemetry": dict(res_hot["rotor_telemetry"]),
     }
     out.update(_utilization("sweep_dynamics", res_hot))
+    out.update(iters_telemetry("sweep", res_hot["iters"]))
+
+    # ---- small aero-servo slice ----
+    # Without the read-only reference mount the flagship design has no
+    # blade data, so sweep_aero_servo records false and
+    # sweep_rotor_telemetry was all-zeros on every such round — leaving
+    # the ROADMAP rotor-fallback root-cause item unmeasurable.  Run a
+    # 12-design slice of the synthetic demo rotor (designs.demo_semi_aero:
+    # zero-pitch first pass, guided mean-pitch second pass, hub a(w)/b(w))
+    # — 12 designs clears the small-batch threshold (_GUIDE_NODES +
+    # _GUIDE_PROBES + 1) so the guided path, its bracketed pitch samples,
+    # and the probe-verification error are all live numbers on every
+    # round.
+    if not aero_on:
+        from raft_tpu.designs import demo_semi_aero
+
+        aero_base = demo_semi_aero(n_cases=4, n_wind=2,
+                                   nw_settings=(0.02, 0.5))
+        t0a = time.perf_counter()
+        res_aero = run_draft_ballast_sweep(
+            aero_base, [0.92, 0.98, 1.04, 1.1], [0.85, 1.0, 1.15],
+            draft_group=2, verbose=False,
+        )
+        out["sweep_aero_slice_s"] = round(time.perf_counter() - t0a, 3)
+        out["sweep_aero_slice_designs"] = 12
+        out["sweep_aero_slice_wind_cases"] = 2
+        out["sweep_aero_slice_converged_frac"] = float(
+            np.mean(res_aero["converged"]))
+        out["sweep_aero_slice_rotor_stage_s"] = round(
+            res_aero["timing"]["aero_second_s"], 3)
+        # the telemetry key the full-bench round is meant to exercise:
+        # prefer the slice's live rotor numbers over the flagship's zeros
+        out["sweep_rotor_telemetry"] = dict(res_aero["rotor_telemetry"])
+        out["sweep_rotor_telemetry"]["source"] = "demo_semi_aero_slice"
     if verbose:
         print(json.dumps(out))
     return out
@@ -410,6 +444,39 @@ def run_scaling(verbose=True):
 # forced-f32 ("highest") precision, i.e. multiple bf16 passes, so MFU
 # against this peak understates the arithmetic actually performed
 PEAK_FLOPS_BF16 = 197e12
+
+
+def iters_telemetry(prefix, iters):
+    """Iteration telemetry for a dispatch's per-lane fixed-point counts:
+    the percentile spread plus ``wasted_lane_iters_frac`` — the fraction
+    of executed lane-iterations spent on already-converged (frozen)
+    lanes.  Under the legacy monolithic while_loop every lane rides until
+    the slowest lane converges, so executed = n_lanes * max; when the
+    iteration waterfall ran the dispatch (RAFT_TPU_FIXED_POINT != legacy)
+    the engine's own executed count is used instead, so before/after
+    rounds quantify the compaction win against measured headroom."""
+    it = np.asarray(iters, np.float64).ravel()
+    if it.size == 0:
+        return {}
+    useful = float(it.sum())
+    executed = float(it.max()) * it.size
+    out = {}
+    try:
+        from raft_tpu.waterfall import fixed_point_mode, last_dispatch_stats
+
+        st = last_dispatch_stats()
+        if fixed_point_mode() != "legacy" and st.get("lane_iters_executed"):
+            executed = float(st["lane_iters_executed"])
+    except Exception as e:  # telemetry must never fail the bench
+        out[f"{prefix}_iters_telemetry_error"] = f"{type(e).__name__}: {e}"
+    wasted = 1.0 - useful / executed if executed > 0.0 else 0.0
+    out.update({
+        f"{prefix}_iters_p50": float(np.percentile(it, 50)),
+        f"{prefix}_iters_p95": float(np.percentile(it, 95)),
+        f"{prefix}_iters_max": int(it.max()),
+        f"{prefix}_wasted_lane_iters_frac": round(max(wasted, 0.0), 4),
+    })
+    return out
 
 
 def _utilization(prefix, res):
@@ -511,9 +578,133 @@ def run_geometry(baseline_limit=12, verbose=True):
     return out
 
 
+def run_waterfall(n_designs=256, verbose=True):
+    """Convergence-aware fixed-point engine A/B (raft_tpu/waterfall.py):
+    the dynamics stage of a convergence-heterogeneous ``n_designs``-lane
+    megabatch — the flagship hull, one sea state per design, per-design
+    drag coefficients swept over five decades so fixed-point iteration
+    counts spread p50 << max and the slowest lanes hit the nIter cap —
+    dispatched through the legacy monolithic batched while_loop and
+    through the iteration waterfall (fixed K-iteration blocks +
+    active-lane compaction down the serve ladder).  The two paths drive
+    the same phase closures, so the outputs are asserted np.array_equal
+    lane-for-lane; what differs is wall-clock, and the mechanism is
+    recorded as wasted_lane_iters_frac before/after (converged-lane
+    iterations / total executed).  Both paths are timed hot (compile
+    excluded), best-of-3, like every other bench figure."""
+    import dataclasses
+
+    import jax
+
+    from __graft_entry__ import _flagship_design
+    from raft_tpu.model import Model
+    from raft_tpu.serve.buckets import (
+        BucketSpec,
+        SlotPhysics,
+        dispatch_slots,
+        pack_slots,
+    )
+    from raft_tpu.waterfall import last_dispatch_stats, waterfall_dispatch
+
+    base = _flagship_design(NW_MIN, NW_MAX, 1)
+    m = Model(base)
+    m.analyze_unloaded()
+    args, _ = m.prepare_case_inputs(verbose=False)
+    nodes = m.nodes.astype(m.dtype)
+
+    args_l = [np.concatenate([np.asarray(a)] * n_designs, axis=0)
+              for a in args]
+    spec = BucketSpec(nw=m.nw, n_nodes=nodes.r.shape[0],
+                      n_slots=n_designs)
+    nodes_slots, args_slots, _ = pack_slots([(nodes, args_l)], spec)
+    # the heterogeneity knob: member drag coefficients (zeta/B_lin
+    # scaling does NOT spread iteration counts on this hull; Cd does).
+    # The grid mimics a real sweep's convergence profile: a broad body of
+    # typical designs at the ~6-iteration floor plus a ~6% tail of
+    # extreme-drag stragglers at ~2x the iterations, interleaved across
+    # the lane axis — the monolithic while_loop runs EVERY lane to the
+    # straggler count, the waterfall retires the body early.
+    n_tail = max(1, n_designs // 16)
+    body = np.geomspace(1e-3, 0.05, n_designs - n_tail)
+    tail = np.geomspace(3e3, 1e5, n_tail)
+    cdf = np.empty(n_designs)
+    ti = np.arange(n_tail) * (n_designs // n_tail)
+    mask = np.zeros(n_designs, dtype=bool)
+    mask[ti] = True
+    cdf[mask], cdf[~mask] = tail, body
+    upd = {f: np.array(getattr(nodes_slots, f), copy=True) * cdf[:, None]
+           for f in ("Cd_q", "Cd_p1", "Cd_p2", "Cd_End")}
+    nodes_slots = dataclasses.replace(nodes_slots, **upd)
+    physics = SlotPhysics.from_model(m)
+
+    def legacy():
+        out = dispatch_slots(physics, spec, nodes_slots, args_slots)
+        jax.block_until_ready(out)
+        return out
+
+    def waterfall():
+        # returns host numpy (the host syncs at every block boundary);
+        # K=2 retires the 6-iteration body with minimal trip overshoot
+        return waterfall_dispatch(physics, nodes_slots,
+                                  tuple(args_slots), block=2)
+
+    def best_of_3(fn):
+        times, res = [], None
+        for _ in range(3):
+            t0 = time.perf_counter()
+            res = fn()
+            times.append(time.perf_counter() - t0)
+        return min(times), res
+
+    legacy()          # compile
+    waterfall()       # compile every rung's block program once
+    t_legacy, ref = best_of_3(legacy)
+    t_wf, wf = best_of_3(waterfall)
+
+    xr_w, xi_w, rep_w = ref
+    xr, xi, rep = wf
+    bits = (np.array_equal(np.asarray(xr_w), xr)
+            and np.array_equal(np.asarray(xi_w), xi)
+            and np.array_equal(np.asarray(rep_w.iters), rep.iters))
+
+    it = np.asarray(rep_w.iters, np.float64)
+    st = last_dispatch_stats()
+    useful = float(it.sum())
+    wasted_legacy = 1.0 - useful / (float(it.max()) * it.size)
+    wasted_wf = 1.0 - useful / float(st["lane_iters_executed"])
+
+    out = {
+        "waterfall_n_designs": int(n_designs),
+        "waterfall_legacy_dynamics_s": round(t_legacy, 3),
+        "waterfall_dynamics_s": round(t_wf, 3),
+        "waterfall_vs_legacy": round(t_legacy / t_wf, 2),
+        "waterfall_bit_identical": bool(bits),
+        "waterfall_iters_p50": float(np.percentile(it, 50)),
+        "waterfall_iters_p95": float(np.percentile(it, 95)),
+        "waterfall_iters_max": int(it.max()),
+        "waterfall_converged_frac": float(
+            np.mean(np.asarray(rep_w.converged))),
+        "waterfall_wasted_lane_iters_frac_legacy": round(wasted_legacy, 4),
+        "waterfall_wasted_lane_iters_frac": round(max(wasted_wf, 0.0), 4),
+        "waterfall_lane_iters_executed": int(st["lane_iters_executed"]),
+        "waterfall_lane_iters_monolithic": int(
+            st["lane_iters_monolithic"]),
+        "waterfall_block_iters": int(st["block_iters"]),
+        "waterfall_rung_histogram": {
+            str(r): int(n) for r, n in zip(
+                *np.unique(np.asarray(st["rungs"]), return_counts=True))
+        },
+    }
+    if verbose:
+        print(json.dumps(out))
+    return out
+
+
 if __name__ == "__main__":
     limit = int(sys.argv[1]) if len(sys.argv) > 1 else None
     if len(sys.argv) > 2 and sys.argv[2] == "geom":
         run_geometry(baseline_limit=limit or 12)
+    elif len(sys.argv) > 2 and sys.argv[2] == "waterfall":
+        run_waterfall(n_designs=limit or 256)
     else:
         run(baseline_limit=limit)

@@ -308,6 +308,68 @@ def solve_dynamics(
     unless every recovery tier failed AND no finite iterate ever existed
     (then they are zero with ``nonfinite`` set).
     """
+    ph = fixed_point_phases(
+        nodes, u, w, dw, rho, M_lin, B_lin, C_lin, F_lin_r, F_lin_i,
+        XiStart, nIter=nIter, tol=tol, refine=refine, relax=relax,
+    )
+    if checkable:
+        # scan-based fixed-trip-count variant with the same freeze
+        # semantics: jax.experimental.checkify supports scan but not this
+        # while_loop, so the NaN-checking debug pipeline
+        # (raft_tpu.validate.checked_pipeline) requests this path
+        def scan_body(state, _):
+            state = jax.lax.cond(ph.cond(state), ph.body,
+                                 lambda s: s, state)
+            return state, None
+        state, _ = jax.lax.scan(scan_body, ph.init, None, length=nIter + 1)
+    else:
+        state = jax.lax.while_loop(ph.cond, ph.body, ph.init)
+    return ph.finalize(state)
+
+
+class FixedPointPhases:
+    """The dynamics fixed point decomposed into reusable phases.
+
+    ``init`` is the loop-carried state pytree
+    ``(i, XiNext, XiPoint, Xi_lastfinite, done, froze)``; ``cond``/
+    ``body`` are the while_loop pieces; ``finalize(state)`` performs the
+    refined re-solve through the recovery ladder and builds the
+    SolveReport.  :func:`solve_dynamics` composes them back into the
+    legacy monolithic solve (bit-for-bit the pre-refactor graph), and the
+    convergence-aware engine (raft_tpu/waterfall.py) drives the SAME
+    phase closures in fixed K-iteration blocks with active-lane
+    compaction between blocks — per-lane arithmetic is shared by
+    construction, which is what makes the waterfall's bit-parity contract
+    a property of batching alone.
+    """
+
+    def __init__(self, init, cond, body, finalize):
+        self.init = init
+        self.cond = cond
+        self.body = body
+        self.finalize = finalize
+
+
+def fixed_point_phases(
+    nodes,
+    u,
+    w,
+    dw,
+    rho,
+    M_lin,
+    B_lin,
+    C_lin,
+    F_lin_r,
+    F_lin_i,
+    XiStart,
+    nIter=15,
+    tol=0.01,
+    refine=1,
+    relax=0.8,
+):
+    """Build the fixed-point phase closures for one case (see
+    :class:`FixedPointPhases`).  Same operands and semantics as
+    :func:`solve_dynamics`, which delegates here."""
     nw = w.shape[0]
     cdtype = u.dtype
     relax = float(relax)
@@ -365,57 +427,52 @@ def solve_dynamics(
 
     init = (jnp.array(0), XiLast, XiLast, Xi0,
             jnp.array(False), jnp.array(False))
-    if checkable:
-        # scan-based fixed-trip-count variant with the same freeze
-        # semantics: jax.experimental.checkify supports scan but not this
-        # while_loop, so the NaN-checking debug pipeline
-        # (raft_tpu.validate.checked_pipeline) requests this path
-        def scan_body(state, _):
-            state = jax.lax.cond(cond(state), body, lambda s: s, state)
-            return state, None
-        state, _ = jax.lax.scan(scan_body, init, None, length=nIter + 1)
+
+    def finalize(state):
         i, _, XiPoint, Xi, done, froze = state
-    else:
-        i, _, XiPoint, Xi, done, froze = jax.lax.while_loop(cond, body, init)
-    converged = done & ~froze
-    # one re-solve at the final drag-linearization point recovers the full
-    # f32+refinement accuracy for the returned amplitudes without paying
-    # the refinement inside every fixed-point iteration — now through the
-    # conditioned-solve recovery ladder, which also yields the per-case
-    # residual / condition-estimate / recovery-tier health record
-    Zr, Zi, F = assemble(XiPoint)
-    xr_c, xi_c, resid, cond_est, tier = solve_complex_6x6_ladder(
-        Zr, Zi, jnp.real(F), jnp.imag(F), refine=refine
-    )
-    if mp:
-        # automatic fall-back-to-full-precision: any frequency lane the
-        # ladder escalated past baseline, or whose condition estimate
-        # exceeds the f32 ladder threshold, takes the answer from a
-        # full-precision shadow assembly+ladder at the same
-        # linearization point (one extra assembly — the fixed point
-        # already amortized the mixed-precision speedup)
-        Zr_f, Zi_f, F_f = assemble(XiPoint, full_precision=True)
-        xr_f, xi_f, resid_f, cond_f, tier_f = solve_complex_6x6_ladder(
-            Zr_f, Zi_f, jnp.real(F_f), jnp.imag(F_f), refine=refine
+        converged = done & ~froze
+        # one re-solve at the final drag-linearization point recovers the
+        # full f32+refinement accuracy for the returned amplitudes without
+        # paying the refinement inside every fixed-point iteration — now
+        # through the conditioned-solve recovery ladder, which also yields
+        # the per-case residual / condition-estimate / recovery-tier
+        # health record
+        Zr, Zi, F = assemble(XiPoint)
+        xr_c, xi_c, resid, cond_est, tier = solve_complex_6x6_ladder(
+            Zr, Zi, jnp.real(F), jnp.imag(F), refine=refine
         )
-        eps32 = float(np.finfo(np.float32).eps)
-        degraded = (tier != TIER_BASELINE) | (cond_est > 0.02 / eps32)
-        xr_c = jnp.where(degraded[..., None], xr_f, xr_c)
-        xi_c = jnp.where(degraded[..., None], xi_f, xi_c)
-        resid = jnp.where(degraded, resid_f, resid)
-        cond_est = jnp.where(degraded, cond_f, cond_est)
-        tier = jnp.where(degraded, tier_f, tier)
-    Xi_cand = (xr_c + 1j * xi_c).T                             # [6, nw]
-    cand_ok = jnp.all(jnp.isfinite(Xi_cand))
-    # if even the ladder's last tier is non-finite (e.g. NaN node inputs),
-    # fall back to the loop's last finite iterate (zeros if none existed)
-    Xi_out = jnp.where(cand_ok, Xi_cand, Xi)
-    report = SolveReport(
-        converged=converged,
-        iters=i,
-        nonfinite=froze | ~cand_ok,
-        recovery_tier=jnp.max(tier),
-        residual=jnp.max(resid),
-        cond=jnp.max(cond_est),
-    )
-    return jnp.real(Xi_out), jnp.imag(Xi_out), report
+        if mp:
+            # automatic fall-back-to-full-precision: any frequency lane
+            # the ladder escalated past baseline, or whose condition
+            # estimate exceeds the f32 ladder threshold, takes the answer
+            # from a full-precision shadow assembly+ladder at the same
+            # linearization point (one extra assembly — the fixed point
+            # already amortized the mixed-precision speedup)
+            Zr_f, Zi_f, F_f = assemble(XiPoint, full_precision=True)
+            xr_f, xi_f, resid_f, cond_f, tier_f = solve_complex_6x6_ladder(
+                Zr_f, Zi_f, jnp.real(F_f), jnp.imag(F_f), refine=refine
+            )
+            eps32 = float(np.finfo(np.float32).eps)
+            degraded = (tier != TIER_BASELINE) | (cond_est > 0.02 / eps32)
+            xr_c = jnp.where(degraded[..., None], xr_f, xr_c)
+            xi_c = jnp.where(degraded[..., None], xi_f, xi_c)
+            resid = jnp.where(degraded, resid_f, resid)
+            cond_est = jnp.where(degraded, cond_f, cond_est)
+            tier = jnp.where(degraded, tier_f, tier)
+        Xi_cand = (xr_c + 1j * xi_c).T                         # [6, nw]
+        cand_ok = jnp.all(jnp.isfinite(Xi_cand))
+        # if even the ladder's last tier is non-finite (e.g. NaN node
+        # inputs), fall back to the loop's last finite iterate (zeros if
+        # none existed)
+        Xi_out = jnp.where(cand_ok, Xi_cand, Xi)
+        report = SolveReport(
+            converged=converged,
+            iters=i,
+            nonfinite=froze | ~cand_ok,
+            recovery_tier=jnp.max(tier),
+            residual=jnp.max(resid),
+            cond=jnp.max(cond_est),
+        )
+        return jnp.real(Xi_out), jnp.imag(Xi_out), report
+
+    return FixedPointPhases(init, cond, body, finalize)

@@ -33,7 +33,7 @@ from raft_tpu.hydro import (
     excitation_froude_krylov,
     make_wave_spectrum,
 )
-from raft_tpu.dynamics import solve_dynamics
+from raft_tpu.dynamics import fixed_point_phases, solve_dynamics
 from raft_tpu.precision import mixed_precision_enabled
 from raft_tpu.health import (
     apply_debug_nans,
@@ -100,6 +100,16 @@ def _uniform_heading_grid(headings, resolution=1e-3, max_grid=73):
     return tuple((hs[0] + i * step) * resolution for i in range(n))
 
 
+def _fixed_point_engine_requested():
+    """Whether the convergence-aware fixed-point engine handles the
+    non-slots case dispatch: RAFT_TPU_FIXED_POINT != legacy AND the
+    checkable debug pipeline is not requested (the debug path always
+    runs the legacy reference dispatch)."""
+    from raft_tpu.waterfall import fixed_point_mode
+
+    return fixed_point_mode() != "legacy" and not apply_debug_nans()
+
+
 def make_case_dynamics(w, k, depth, rho, g, XiStart, nIter, dtype, cdtype,
                        checkable=False, relax=0.8):
     """Build the single-case device function
@@ -149,6 +159,53 @@ def make_case_dynamics(w, k, depth, rho, g, XiStart, nIter, dtype, cdtype,
         return xr, xi, report
 
     return one_case
+
+
+def make_case_phases(w, k, depth, rho, g, XiStart, nIter, dtype, cdtype,
+                     relax=0.8):
+    """The single-case dynamics split at the fixed-point phase boundaries
+    for the convergence-aware engine (raft_tpu/waterfall.py): the SAME
+    arithmetic as :func:`make_case_dynamics`'s ``one_case``, factored into
+
+    ``prelude(nodes, zeta, beta, F_add_r, F_add_i) -> (u, Fr, Fi)``
+        wave kinematics + Froude-Krylov excitation (loop-invariant), and
+    ``phases(nodes, u, C_lin, M_lin, B_lin, Fr, Fi)``
+        the :class:`raft_tpu.dynamics.FixedPointPhases` closures over the
+        prelude outputs.
+
+    Both run under the same full-f32 matmul-precision context as
+    ``one_case`` (the context sets per-op precision at trace time, so
+    splitting the trace does not change any op's parameters).
+    """
+    w = np.asarray(w).astype(dtype)
+    k = np.asarray(k).astype(dtype)
+    dw = float(w[1] - w[0])
+    rho = float(rho)
+    depth = float(depth)
+    g = float(g)
+    nIter = int(nIter)
+    XiStart = float(XiStart)
+
+    def prelude(nodes, zeta, beta, F_add_r, F_add_i):
+        with jax.default_matmul_precision("highest"):
+            u, ud, pD = wave_kinematics(
+                zeta.astype(cdtype), beta, w, k, depth, nodes.r,
+                rho=rho, g=g, dtype=cdtype,
+            )
+            F_iner = excitation_froude_krylov(
+                nodes, u, ud, pD, rho, mp=mixed_precision_enabled()
+            )  # [nw,6]
+            Fr = jnp.real(F_iner) + F_add_r
+            Fi = jnp.imag(F_iner) + F_add_i
+        return u, Fr, Fi
+
+    def phases(nodes, u, C_lin, M_lin, B_lin, Fr, Fi):
+        return fixed_point_phases(
+            nodes, u, w, dw, rho, M_lin, B_lin, C_lin, Fr, Fi,
+            XiStart, nIter=nIter, relax=relax,
+        )
+
+    return prelude, phases
 
 
 class Model:
@@ -701,6 +758,17 @@ class Model:
                     "dynamics", backend=jax.default_backend()):
                 xr, xi, report = slotted_case_dispatch(
                     self, self.slots, args)
+        elif _fixed_point_engine_requested():
+            # convergence-aware engine (RAFT_TPU_FIXED_POINT=waterfall|
+            # fused): fixed K-iteration blocks with active-lane
+            # compaction, per-lane bit-identical to the legacy pipeline
+            # (raft_tpu/waterfall.py); the checkable debug pipeline
+            # always keeps the legacy reference dispatch
+            from raft_tpu.waterfall import waterfall_case_dispatch
+
+            with timer("rao_solve"), tracer.span(
+                    "dynamics", backend=jax.default_backend()):
+                xr, xi, report = waterfall_case_dispatch(self, args)
         else:
             if self._pipeline is None:
                 with timer("pipeline_compile"):

@@ -25,6 +25,20 @@ gather/scatter lowerings XLA picks for generic linear algebra):
    has no Pallas equivalent), and VMEM-tiled matmul / matmul-subtract
    updates (:func:`mm_pallas` / :func:`mm_sub_pallas`) for the row
    scaling and the rank-``block`` elimination update.
+ - :func:`fused_block_fn` — the fused per-iteration fixed-point
+   megakernel behind the convergence-aware engine's ``fused`` mode
+   (raft_tpu/waterfall.py).  One grid step owns one (design x case)
+   lane and runs a whole K-iteration waterfall block on-chip: drag
+   linearization, damping update, impedance assembly, the batched
+   [nw] 12x12 real-block complex solve, the under-relaxed update, and
+   the convergence/NaN-quarantine flags — with the iterate XiLast
+   resident in VMEM across all K iterations, so the per-iteration HBM
+   round trips of the XLA scan (every einsum materializes [N, 3, nw]
+   intermediates to HBM between dispatch boundaries) collapse into one
+   fused loop.  Complex arithmetic is carried as explicit re/im pairs
+   (TPU Pallas has no complex dtype).  Numerics are tolerance-level,
+   not bitwise, against the XLA phase programs (reduction orders
+   differ); the finalize phase always runs the XLA recovery ladder.
 
 Dispatch contract (the safety half of the ISSUE):
 
@@ -254,6 +268,231 @@ def mm_sub_pallas(X, L, R):
     tm, tn = _tile(nr), _tile(nc)
     return _mm_call(nr, K, nc, tm, tn, X.dtype.name, _interpret(),
                     True)(X, L, R)
+
+
+# ------------------------------------------------ fused fixed-point block
+
+def _fused_fp_kernel(dw, rho, tol, relax, w_old, nIter, K):
+    """Kernel body factory for one waterfall block: K gated fixed-point
+    iterations of ONE (design x case) lane, entirely in VMEM.
+
+    The per-iteration math mirrors ``fixed_point_phases``'s ``body``
+    (raft_tpu/dynamics.py) composed with :func:`linearized_drag` and
+    ``assemble_impedance``, in split re/im real arithmetic; the gating
+    mirrors the waterfall's ``where(cond, body(s), s)`` trips, so a
+    converged/frozen lane's state rides through unchanged bit-for-bit
+    (the body IS computed — branchless, like the XLA select — and
+    discarded).  The scalars are baked in as compile-time constants;
+    the frequency grid rides in as a lane-shared input (Pallas forbids
+    captured array constants), its block mapped to (0,) for every grid
+    step.
+    """
+    from raft_tpu.utils.frames import translate_matrix_3to6
+
+    c_drag = float(np.sqrt(8.0 / np.pi) * 0.5 * rho)
+    nIter = int(nIter)
+
+    def kernel(w_ref, r_ref, q_ref, p1sq_ref, p2sq_ref, qmat_ref, p1mat_ref,
+               p2mat_ref, aq_ref, ap1_ref, ap2_ref, aend_ref,
+               cdq_ref, cdp1_ref, cdp2_ref, cdend_ref, sub_ref,
+               ure_ref, uim_ref, c_ref, m_ref, b_ref, flr_ref, fli_ref,
+               it_ref, xnr_ref, xni_ref, xpr_ref, xpi_ref,
+               xfr_ref, xfi_ref, dn_ref, fz_ref,
+               oit_ref, oxnr_ref, oxni_ref, oxpr_ref, oxpi_ref,
+               oxfr_ref, oxfi_ref, odn_ref, ofz_ref):
+        r = r_ref[0]                                   # [N, 3]
+        q = q_ref[0]
+        p1_sq = p1sq_ref[0]
+        p2_sq = p2sq_ref[0]
+        qMat = qmat_ref[0]                             # [N, 3, 3]
+        p1Mat = p1mat_ref[0]
+        p2Mat = p2mat_ref[0]
+        a_q, a_p1, a_p2 = aq_ref[0], ap1_ref[0], ap2_ref[0]
+        a_end_abs = aend_ref[0]
+        Cd_q, Cd_p1, Cd_p2 = cdq_ref[0], cdp1_ref[0], cdp2_ref[0]
+        Cd_End = cdend_ref[0]
+        m3 = (sub_ref[0] > 0)[:, None, None]           # [N, 1, 1]
+        ur, ui = ure_ref[0], uim_ref[0]                # [N, 3, W]
+        C = c_ref[0]                                   # [6, 6]
+        M, B = m_ref[0], b_ref[0]                      # [W, 6, 6]
+        Flr, Fli = flr_ref[0], fli_ref[0]              # [W, 6]
+        dt = ur.dtype
+        w_arr = w_ref[...]                             # [W]
+        w2 = (w_arr * w_arr)[:, None, None]
+
+        def fp_step(XLr, XLi):
+            # --- drag linearization at the point XL [6, W] (split re/im
+            # mirror of hydro.linearized_drag; i*w*dr -> (-w di, w dr))
+            def cross_rth(th):                         # [3, W] -> [N, 3, W]
+                return jnp.stack(
+                    [th[2][None, :] * (-r[:, 1][:, None])
+                     + th[1][None, :] * r[:, 2][:, None],
+                     th[2][None, :] * r[:, 0][:, None]
+                     - th[0][None, :] * r[:, 2][:, None],
+                     -th[1][None, :] * r[:, 0][:, None]
+                     + th[0][None, :] * r[:, 1][:, None]],
+                    axis=1)
+
+            drr = XLr[None, :3, :] + cross_rth(XLr[3:, :])
+            dri = XLi[None, :3, :] + cross_rth(XLi[3:, :])
+            vrr = jnp.where(m3, ur - (-w_arr * dri), 0.0)
+            vri = jnp.where(m3, ui - (w_arr * drr), 0.0)
+            cq_r = vrr * q[:, :, None]
+            cq_i = vri * q[:, :, None]
+            vRMS_q = jnp.sqrt(
+                jnp.sum(cq_r * cq_r + cq_i * cq_i, axis=(1, 2)) * dw)
+            abs2 = vrr * vrr + vri * vri
+            vRMS_p1 = jnp.sqrt(
+                jnp.sum(abs2 * p1_sq[:, :, None], axis=(1, 2)) * dw)
+            vRMS_p2 = jnp.sqrt(
+                jnp.sum(abs2 * p2_sq[:, :, None], axis=(1, 2)) * dw)
+            Bq = c_drag * vRMS_q * a_q * Cd_q
+            Bp1 = c_drag * vRMS_p1 * a_p1 * Cd_p1
+            Bp2 = c_drag * vRMS_p2 * a_p2 * Cd_p2
+            Bend = c_drag * vRMS_q * a_end_abs * Cd_End
+            Bmat = ((Bq + Bend)[:, None, None] * qMat
+                    + Bp1[:, None, None] * p1Mat
+                    + Bp2[:, None, None] * p2Mat)
+            B_drag = jnp.sum(
+                jnp.where(m3, translate_matrix_3to6(Bmat, r), 0.0), axis=0)
+            f3r = jnp.einsum("nij,njw->niw", Bmat, ur)
+            f3i = jnp.einsum("nij,njw->niw", Bmat, ui)
+
+            def sum_force(f3):
+                f3 = jnp.where(m3, f3, 0.0)
+                fw = jnp.moveaxis(f3, -1, 1)           # [N, W, 3]
+                mom = jnp.cross(r[:, None, :], fw)
+                return jnp.concatenate(
+                    [jnp.sum(fw, axis=0), jnp.sum(mom, axis=0)], axis=-1)
+
+            # --- impedance + excitation, then the [W] batch of complex
+            # 6x6 solves as augmented 12x13 eliminations in one loop
+            Zr = -w2 * M + C
+            Zi = w_arr[:, None, None] * (B + B_drag[None])
+            FR = sum_force(f3r) + Flr
+            FI = sum_force(f3i) + Fli
+            A = jnp.concatenate(
+                [jnp.concatenate([Zr, -Zi], axis=-1),
+                 jnp.concatenate([Zi, Zr], axis=-1)], axis=-2)
+            rhs = jnp.concatenate([FR, FI], axis=-1)[..., None]
+            Maug = jnp.concatenate([A, rhs], axis=-1)  # [W, 12, 13]
+            Maug = jax.lax.fori_loop(
+                0, 12, lambda i, Mx: _gj_elim_body(Mx, i), Maug)
+            x = Maug[:, :, 12]                         # [W, 12]
+            return x[:, :6].T, x[:, 6:].T              # [6, W] re, im
+
+        def trip(_, carry):
+            it, xnr, xni, xpr, xpi, xfr, xfi, dn, fz = carry
+            run = (it < nIter + 1) & (dn == 0)
+            Xr, Xj = fp_step(xnr, xni)
+            finite = jnp.all(jnp.isfinite(Xr)) & jnp.all(jnp.isfinite(Xj))
+            num = jnp.sqrt((Xr - xnr) ** 2 + (Xj - xni) ** 2)
+            den = jnp.sqrt(Xr * Xr + Xj * Xj) + dt.type(tol)
+            conv = jnp.all(num / den < tol)            # NaN compares False
+            newdone = conv | ~finite
+            new = (it + 1,
+                   jnp.where(newdone, xnr, w_old * xnr + relax * Xr),
+                   jnp.where(newdone, xni, w_old * xni + relax * Xj),
+                   xnr, xni,
+                   jnp.where(finite, Xr, xfr),
+                   jnp.where(finite, Xj, xfi),
+                   jnp.where(newdone, 1, dn).astype(dn.dtype),
+                   jnp.where(finite, fz, 1).astype(fz.dtype))
+            return tuple(
+                jnp.where(run, n, o) for n, o in zip(new, carry))
+
+        carry = (it_ref[0], xnr_ref[0], xni_ref[0], xpr_ref[0],
+                 xpi_ref[0], xfr_ref[0], xfi_ref[0], dn_ref[0], fz_ref[0])
+        carry = jax.lax.fori_loop(0, K, trip, carry)
+        oit_ref[0] = carry[0]
+        oxnr_ref[0] = carry[1]
+        oxni_ref[0] = carry[2]
+        oxpr_ref[0] = carry[3]
+        oxpi_ref[0] = carry[4]
+        oxfr_ref[0] = carry[5]
+        oxfi_ref[0] = carry[6]
+        odn_ref[0] = carry[7]
+        ofz_ref[0] = carry[8]
+
+    return kernel
+
+
+def _lane_spec(a):
+    """One-lane BlockSpec for a [L, ...] operand: grid step l owns row l."""
+    rest = tuple(a.shape[1:])
+    nr = len(rest)
+    return pl.BlockSpec((1,) + rest, lambda l, _n=nr: (l,) + (0,) * _n)
+
+
+@lru_cache(maxsize=16)
+def fused_block_fn(physics, relax, block):
+    """The ``fused`` engine's block program: same signature as the
+    waterfall's XLA block (``(nodes, u, C, M, B, Fr, Fi, state) ->
+    state``, all leading [L]) with the K gated fixed-point trips running
+    inside ONE Pallas megakernel, one lane per grid step.
+
+    physics : raft_tpu.serve.buckets.SlotPhysics
+    relax / block : under-relaxation weight and iterations per block
+
+    Complex operands/state are split into re/im pairs at the kernel
+    boundary and re-married after (TPU Pallas has no complex dtype); the
+    per-lane flags come back as int32 and are cast to the XLA state's
+    bool/int dtypes, so the host-side waterfall driver and the XLA
+    finalize consume the kernel's state unchanged.  Off-TPU the kernel
+    runs in interpret mode — tier-1 parity-tests the exact kernel body
+    against the XLA phase programs (tolerance-level: reduction orders
+    differ inside the kernel).
+    """
+    w = np.frombuffer(physics.w_bytes, np.float64, count=physics.nw)
+    dtype = np.dtype(physics.dtype_name)
+    dw = float(w[1] - w[0])
+    relax = float(relax)
+    w_old = round(1.0 - relax, 12)
+    kernel = _fused_fp_kernel(dw, physics.rho, 0.01,
+                              relax, w_old, physics.nIter, int(block))
+    w_in = jnp.asarray(w.astype(dtype))
+    w_spec = pl.BlockSpec((physics.nw,), lambda l: (0,))
+
+    def block_fn(nodes, u, C, M, B, Fr, Fi, state):
+        i0, xn, xp, xf, dn, fz = state
+        L = u.shape[0]
+        if nodes.r.ndim == 2:
+            # lane-shared node bundle (waterfall shared_nodes mode):
+            # the kernel grid owns one lane per step, so broadcast
+            nodes = jax.tree.map(
+                lambda a: jnp.broadcast_to(
+                    jnp.asarray(a)[None], (L,) + a.shape), nodes)
+        re, im = jnp.real, jnp.imag
+        p1_sq = jnp.diagonal(nodes.p1Mat, axis1=-2, axis2=-1)
+        p2_sq = jnp.diagonal(nodes.p2Mat, axis1=-2, axis2=-1)
+        ins = (nodes.r, nodes.q, p1_sq, p2_sq,
+               nodes.qMat, nodes.p1Mat, nodes.p2Mat,
+               nodes.a_q, nodes.a_p1, nodes.a_p2, nodes.a_end_abs,
+               nodes.Cd_q, nodes.Cd_p1, nodes.Cd_p2, nodes.Cd_End,
+               nodes.submerged.astype(jnp.int32),
+               re(u), im(u), C, M, B, Fr, Fi,
+               i0.astype(jnp.int32), re(xn), im(xn), re(xp), im(xp),
+               re(xf), im(xf),
+               dn.astype(jnp.int32), fz.astype(jnp.int32))
+        sd = jax.ShapeDtypeStruct
+        xs = tuple(xn.shape)                           # (L, 6, W)
+        out_shape = [sd((L,), np.int32)] + [sd(xs, dtype)] * 6 + [
+            sd((L,), np.int32), sd((L,), np.int32)]
+        outs = pl.pallas_call(
+            kernel,
+            grid=(L,),
+            in_specs=[w_spec] + [_lane_spec(a) for a in ins],
+            out_specs=[_lane_spec(s) for s in out_shape],
+            out_shape=out_shape,
+            interpret=_interpret(),
+        )(w_in, *ins)
+        oi, oxnr, oxni, oxpr, oxpi, oxfr, oxfi, odn, ofz = outs
+        mk = lambda a, b: jax.lax.complex(               # noqa: E731
+            a, b).astype(xn.dtype)
+        return (oi.astype(i0.dtype), mk(oxnr, oxni), mk(oxpr, oxpi),
+                mk(oxfr, oxfi), odn.astype(dn.dtype), ofz.astype(fz.dtype))
+
+    return jax.jit(block_fn)
 
 
 def gj_stage_pallas(A, b, kb0, nblk, block=512):

@@ -379,6 +379,17 @@ def dispatch_slots(physics, spec, nodes_slots, args_slots, sharding=None,
         return _dispatch_slots_sharded(
             physics, spec, nodes_slots, args_slots, tuple(devices),
             block=block, checkable=checkable)
+    from raft_tpu.waterfall import fixed_point_mode
+
+    if fixed_point_mode() != "legacy" and not checkable:
+        # convergence-aware engine (RAFT_TPU_FIXED_POINT=waterfall|
+        # fused): same lanes, fixed K-iteration blocks with active-lane
+        # compaction, per-lane bit-identical on the waterfall path
+        # (raft_tpu/waterfall.py).  The checkable debug dispatch and the
+        # lane-sharded multi-chip path keep the legacy executables.
+        from raft_tpu.waterfall import waterfall_dispatch
+
+        return waterfall_dispatch(physics, nodes_slots, args_slots)
     fn = slot_pipeline(physics, checkable)
     if sharding is not None:
         put = lambda a: jax.device_put(np.asarray(a), sharding)  # noqa: E731

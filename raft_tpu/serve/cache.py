@@ -177,6 +177,7 @@ _CODE_VERSION_MODULES = (
     "raft_tpu.dynamics", "raft_tpu.hydro", "raft_tpu.waves",
     "raft_tpu.geometry", "raft_tpu.model", "raft_tpu.serve.buckets",
     "raft_tpu.pallas_kernels", "raft_tpu.precision",
+    "raft_tpu.waterfall",
 )
 
 
@@ -214,6 +215,7 @@ def current_flags():
     from raft_tpu.pallas_kernels import pallas_enabled
     from raft_tpu.precision import mixed_precision_enabled
     from raft_tpu.serve.buckets import serve_lane_devices
+    from raft_tpu.waterfall import fixed_point_mode
 
     flags = {
         "backend": jax.default_backend(),
@@ -225,6 +227,11 @@ def current_flags():
         # trusted by) a process running another
         "pallas": bool(pallas_enabled()),
         "mixed_precision": bool(mixed_precision_enabled()),
+        # the fixed-point engine mode selects a different dispatch
+        # decomposition (monolithic while_loop vs waterfall block
+        # programs vs fused Pallas blocks) — an executable family warmed
+        # under one mode must be refused under another
+        "fixed_point": fixed_point_mode(),
     }
     flags.update(topology_flags(serve_lane_devices()))
     return flags
@@ -232,7 +239,7 @@ def current_flags():
 
 #: flag keys every executable-reuse decision compares
 _FLAG_KEYS = ("backend", "x64", "code_version", "jax",
-              "pallas", "mixed_precision")
+              "pallas", "mixed_precision", "fixed_point")
 #: topology keys — compared for executables/manifests, NOT for host-prep
 #: artifacts (prep bits are topology-independent: PR 3 measured
 #: host-sharded prep bit-identical to single-device)

@@ -49,6 +49,7 @@ from raft_tpu.health import FailedPoint
 from raft_tpu.model import Model, make_case_dynamics
 from raft_tpu.resilience import SolveRetryPolicy
 from raft_tpu.sweep_buckets import grouped_sweep_pipeline, sweep_buckets_enabled
+from raft_tpu.waterfall import fixed_point_mode, grouped_waterfall_pipeline
 from raft_tpu.utils.profiling import logger
 
 
@@ -363,6 +364,11 @@ def run_sweep(
         logger.warning(
             "run_sweep: via_buckets requested but multi-process run — "
             "falling back to the fused per-shape pipeline")
+    # convergence-aware fixed-point engine (RAFT_TPU_FIXED_POINT):
+    # single-process only, like the bucket routing (the waterfall's
+    # host-side compaction has no multi-host collective ordering)
+    use_waterfall = (not use_buckets) and jax.process_count() == 1 \
+        and fixed_point_mode() != "legacy"
     retry_policy = SolveRetryPolicy.from_flag(retry_nonconverged)
     if out_dir:
         os.makedirs(out_dir, exist_ok=True)
@@ -562,6 +568,12 @@ def run_sweep(
             # escalated (nIter, relax) is not a canonical serving
             # configuration (see raft_tpu/sweep_buckets.py)
             pipeline = grouped_sweep_pipeline(m0)
+        elif use_waterfall:
+            # convergence-aware engine (RAFT_TPU_FIXED_POINT): flattened
+            # lanes through fixed K-iteration blocks with active-lane
+            # compaction, per-lane bit-identical to the legacy pipeline;
+            # the retry dispatch below stays on the legacy reference path
+            pipeline = grouped_waterfall_pipeline(m0)
         else:
             pipeline = _sweep_pipeline(m0, sharding, m0.nIter, 0.8)
         dev_in = jax.device_put((nodes_b,) + args_b, sharding)

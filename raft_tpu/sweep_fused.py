@@ -60,8 +60,10 @@ from raft_tpu.resilience import SolveRetryPolicy
 from raft_tpu.sweep_buckets import sweep_buckets_enabled
 from raft_tpu.statics import compute_statics
 from raft_tpu.sweep import pad_and_stack_nodes
+from raft_tpu.health import apply_debug_nans
 from raft_tpu.utils.placement import put_cpu
 from raft_tpu.utils.profiling import logger
+from raft_tpu.waterfall import fixed_point_mode
 
 _am_f64 = jax.jit(added_mass_morison)
 
@@ -572,6 +574,16 @@ def _chunked_aero_dynamics(model0, cases, wind, aero_on, pitch_mean,
         from raft_tpu.sweep_buckets import fused_bucket_pipeline
 
         pipeline = fused_bucket_pipeline(model0, return_xi)
+    elif (fixed_point_mode() != "legacy" and jax.process_count() == 1
+          and not apply_debug_nans()):
+        # convergence-aware iteration waterfall (raft_tpu/waterfall.py):
+        # hop out converged lanes between fixed K-iteration blocks and
+        # compact survivors down the canonical lane ladder.  The bounded
+        # retry below stays on the legacy pipeline — escalated
+        # (nIter, relax) re-solves are health-ladder reference paths.
+        from raft_tpu.waterfall import fused_waterfall_pipeline
+
+        pipeline = fused_waterfall_pipeline(model0, return_xi)
     else:
         pipeline = _dynamics_pipeline(model0, return_xi)
     backend = jax.default_backend()

@@ -1,0 +1,466 @@
+"""Convergence-aware fixed-point engine: the iteration waterfall.
+
+The dynamics fixed point (raft_tpu/dynamics.py) is vmapped over
+(design x case) lanes, so the batched ``while_loop`` iterates until the
+SLOWEST lane converges — already-converged lanes keep re-running the
+full ``linearized_drag`` einsums, impedance assembly, and [nw]x6x6
+solves as frozen ``where``-selects every iteration.  BENCH_FULL.json
+measures the cost: ``dynamics_first_s`` is essentially the whole sweep
+wall.  This module converts that waste directly into wall-clock:
+
+ 1. the monolithic loop is re-expressed as fixed **K-iteration blocks**
+    (a scan of ``where(cond, body(s), s)`` trips — per-lane semantics
+    identical to the batched while_loop, the equivalence tier-1 pins via
+    the ``checkable=True`` scan path);
+ 2. after each block the engine hops out converged/frozen lanes on the
+    host and **compacts the survivors** into the next smaller canonical
+    lane-count rung (the serve layer's slot-ladder vocabulary —
+    8/16/32/64/128, doubling above — so every block executes a
+    pre-warmable fixed-shape program and jit's shape cache bounds the
+    program family; no recompiles in steady state);
+ 3. the finished lanes' loop states are scattered back into original
+    lane order and ONE vmapped finalize runs the refined recovery-ladder
+    re-solve for every lane (the health ladder always takes the XLA
+    reference path).
+
+Bit-parity contract: a lane's per-iteration arithmetic is lane-local
+(vmapped lanes are data-independent, and the phase closures are the SAME
+``fixed_point_phases`` objects ``solve_dynamics`` composes), so a lane's
+trajectory is bit-identical whether it rides a full or a compacted
+block; gathers, host round-trips, and replicated-lane padding are exact.
+``tests/test_waterfall.py`` pins ``np.array_equal`` against the legacy
+dispatch on CPU, including NaN-quarantined and non-converged lanes
+landing in compacted blocks.
+
+Mode selection: ``RAFT_TPU_FIXED_POINT=waterfall|fused|legacy`` (default
+``legacy`` — tier-1 bits unchanged).  ``fused`` rides the same waterfall
+driver but executes each block through the fused per-iteration Pallas
+megakernel (raft_tpu/pallas_kernels.py, ``fused_block_step``) instead of
+the XLA scan — tolerance-level parity, interpret-mode tested on CPU.
+The health-ladder retry tiers (sweep.SolveRetryPolicy) and the
+``checkable`` debug pipelines always take the legacy XLA reference path.
+The mode is part of the serve cache's executable flags
+(raft_tpu/serve/cache.py), so executables compiled under a different
+fixed-point mode are refused, never silently mixed.
+"""
+
+import os
+from functools import lru_cache
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.utils.profiling import logger
+
+MODES = ("legacy", "waterfall", "fused")
+
+#: lane-count rungs every block program is quantized to (the serve slot
+#: ladder); above the top rung capacities double, so the program family
+#: stays logarithmic in sweep size
+LANE_LADDER = (8, 16, 32, 64, 128)
+
+DEFAULT_BLOCK_ITERS = 4
+
+
+def fixed_point_mode():
+    """The requested fixed-point engine: ``RAFT_TPU_FIXED_POINT`` in
+    {legacy, waterfall, fused}, default legacy (bit-for-bit the
+    monolithic while_loop dispatch)."""
+    raw = os.environ.get("RAFT_TPU_FIXED_POINT", "").strip().lower()
+    if not raw:
+        return "legacy"
+    if raw in MODES:
+        return raw
+    logger.warning(
+        "RAFT_TPU_FIXED_POINT=%r not in %s; using legacy", raw, MODES)
+    return "legacy"
+
+
+def block_iters():
+    """Fixed-point iterations per waterfall block
+    (``RAFT_TPU_FIXED_POINT_BLOCK``, default 4 — nIter=15 gives at most
+    4 block dispatches per rung, enough hop-out granularity to harvest a
+    p50<<max convergence spread without drowning in dispatch overhead)."""
+    try:
+        k = int(os.environ.get("RAFT_TPU_FIXED_POINT_BLOCK",
+                               DEFAULT_BLOCK_ITERS))
+    except ValueError:
+        k = DEFAULT_BLOCK_ITERS
+    return max(1, k)
+
+
+def ladder_lanes(n):
+    """Smallest canonical lane-count rung holding ``n`` lanes."""
+    n = max(int(n), 1)
+    for L in LANE_LADDER:
+        if L >= n:
+            return L
+    L = LANE_LADDER[-1]
+    while L < n:
+        L *= 2
+    return L
+
+
+def _pad_rows(a, lanes):
+    """Pad a leading lane axis to ``lanes`` by replicating row 0 —
+    always-real inert work under the engine's packing contract (padding
+    lanes are vmap-independent and their results are discarded)."""
+    L0 = a.shape[0]
+    if L0 == lanes:
+        return a
+    return jnp.concatenate(
+        [a, jnp.repeat(a[:1], lanes - L0, axis=0)], axis=0)
+
+
+@lru_cache(maxsize=32)
+def _phase_pipelines(physics, relax, block, kernel, shared_nodes=False):
+    """The jitted vmapped phase programs of one physics configuration:
+    ``(prelude_fn, block_fn, finalize_fn)``.  Shapes bind at call time,
+    so jit's shape cache holds one executable per lane-count rung; the
+    persistent compilation cache makes them warm-restartable exactly like
+    the serve bucket executables.  ``kernel=True`` swaps the block
+    program's K-step scan for the fused Pallas megakernel.
+
+    ``shared_nodes=True`` vmaps with the node bundle UNBATCHED
+    (``in_axes`` None for nodes) — bit-identical to the Model's legacy
+    closed-over-nodes case pipeline, which differs at the ulp level from
+    a per-lane-broadcast node axis (XLA batches some node-only
+    contractions differently); the single-Model case dispatch uses this
+    so waterfall mode preserves the legacy bits exactly."""
+    from raft_tpu.model import make_case_phases
+
+    w = np.frombuffer(physics.w_bytes, np.float64, count=physics.nw)
+    k = np.frombuffer(physics.k_bytes, np.float64, count=physics.nw)
+    dtype = np.dtype(physics.dtype_name).type
+    cdtype = np.dtype(physics.cdtype_name).type
+    prelude, phases = make_case_phases(
+        w, k, physics.depth, physics.rho, physics.g, physics.XiStart,
+        physics.nIter, dtype, cdtype, relax=relax,
+    )
+
+    def prelude_one(nodes, zeta, beta, C_lin, M_lin, B_lin,
+                    F_add_r, F_add_i):
+        u, Fr, Fi = prelude(nodes, zeta, beta, F_add_r, F_add_i)
+        ph = phases(nodes, u, C_lin, M_lin, B_lin, Fr, Fi)
+        return u, Fr, Fi, ph.init
+
+    def block_one(nodes, u, C_lin, M_lin, B_lin, Fr, Fi, state):
+        with jax.default_matmul_precision("highest"):
+            ph = phases(nodes, u, C_lin, M_lin, B_lin, Fr, Fi)
+
+            def trip(s, _):
+                return jax.lax.cond(ph.cond(s), ph.body,
+                                    lambda x: x, s), None
+
+            state, _ = jax.lax.scan(trip, state, None, length=block)
+        return state
+
+    def finalize_one(nodes, u, C_lin, M_lin, B_lin, Fr, Fi, state):
+        with jax.default_matmul_precision("highest"):
+            ph = phases(nodes, u, C_lin, M_lin, B_lin, Fr, Fi)
+            return ph.finalize(state)
+
+    nodes_ax = None if shared_nodes else 0
+    vmap8 = lambda f: jax.vmap(f, in_axes=(nodes_ax,) + (0,) * 7)  # noqa: E731
+    if kernel:
+        from raft_tpu.pallas_kernels import HAVE_PALLAS, fused_block_fn
+        from raft_tpu.precision import mixed_precision_enabled
+
+        if not HAVE_PALLAS or mixed_precision_enabled():
+            # the megakernel implements the full-precision baseline
+            # arithmetic only — under RAFT_TPU_MIXED_PRECISION (or with
+            # no Pallas) the fused mode degrades to the XLA waterfall
+            # rather than silently changing the assembly precision
+            logger.warning(
+                "fused fixed-point mode unavailable (%s); using the XLA "
+                "waterfall block",
+                "mixed precision enabled" if HAVE_PALLAS
+                else "Pallas not importable")
+            block_fn = jax.jit(vmap8(block_one))
+        else:
+            block_fn = fused_block_fn(physics, relax, block)
+    else:
+        block_fn = jax.jit(vmap8(block_one))
+    return (jax.jit(vmap8(prelude_one)), block_fn,
+            jax.jit(vmap8(finalize_one)))
+
+
+# engine stats of the most recent dispatch (bench/test introspection):
+# populated by waterfall_dispatch, read via last_dispatch_stats()
+_LAST_STATS = {}
+
+
+def last_dispatch_stats():
+    """Stats dict of the most recent waterfall dispatch in this process:
+    ``n_lanes``, ``blocks``, ``lane_iters_executed`` (sum of per-rung
+    lane-count x K over all blocks), ``lane_iters_monolithic`` (what the
+    frozen-lane while_loop pays: max trips x padded lane count), and
+    ``rungs`` (the lane-count sequence the waterfall descended)."""
+    return dict(_LAST_STATS)
+
+
+def waterfall_dispatch(physics, nodes_slots, args_slots, relax=0.8,
+                       block=None, kernel=None, slab=None,
+                       shared_nodes=False):
+    """Run flattened (design x case) lanes through the iteration
+    waterfall.
+
+    physics : raft_tpu.serve.buckets.SlotPhysics (the scalars/frequency
+        grid baked into the phase executables — same key the serve
+        bucket pipelines use)
+    nodes_slots : HydroNodes pytree with leading [L] lane axis (working
+        dtype)
+    args_slots : the 7-tuple from ``Model.prepare_case_inputs`` with
+        leading [L]: (zeta, beta, C_lin, M_lin, B_lin, F_add_r, F_add_i)
+    kernel : route blocks through the fused Pallas megakernel (default:
+        ``fixed_point_mode() == "fused"``)
+    slab : maximum lanes per waterfall descent (default: the top ladder
+        rung) — megabatches beyond it run slab-by-slab, bounding operand
+        memory and keeping every program inside the pre-warmable rung
+        family
+    shared_nodes : the node bundle has NO lane axis and is shared by all
+        lanes (vmapped with in_axes None) — bit-identical to the Model's
+        closed-over-nodes case pipeline; the default per-lane node axis
+        matches the serve slot executables and the sweep pipelines
+
+    Returns ``(xr [L, 6, nw], xi, report)`` numpy-backed outputs in the
+    caller's lane order, per-lane bit-identical to the legacy monolithic
+    dispatch of the same lanes.
+    """
+    if kernel is None:
+        kernel = fixed_point_mode() == "fused"
+    K = int(block) if block else block_iters()
+    S = int(slab) if slab else LANE_LADDER[-1]
+    L = int(args_slots[0].shape[0])
+    if L > S:
+        outs, agg = [], None
+        for s0 in range(0, L, S):
+            sl = slice(s0, min(s0 + S, L))
+            nodes_s = nodes_slots if shared_nodes else jax.tree.map(
+                lambda a: a[sl], nodes_slots)
+            args_s = tuple(a[sl] for a in args_slots)
+            outs.append(waterfall_dispatch(
+                physics, nodes_s, args_s, relax=relax, block=block,
+                kernel=kernel, slab=S, shared_nodes=shared_nodes))
+            st = last_dispatch_stats()
+            if agg is None:
+                agg = st
+                agg["rungs"] = list(st["rungs"])
+            else:
+                for key in ("n_lanes", "blocks", "lane_iters_executed",
+                            "lane_iters_monolithic"):
+                    agg[key] += st[key]
+                agg["rungs"] += st["rungs"]
+        _LAST_STATS.clear()
+        _LAST_STATS.update(agg)
+        cat = lambda *xs: np.concatenate(xs, axis=0)  # noqa: E731
+        return (cat(*[o[0] for o in outs]), cat(*[o[1] for o in outs]),
+                jax.tree.map(cat, *[o[2] for o in outs]))
+    prelude_fn, block_fn, finalize_fn = _phase_pipelines(
+        physics, float(relax), K, bool(kernel), bool(shared_nodes))
+    Lq = ladder_lanes(L)
+    if shared_nodes:
+        nodes_p = jax.tree.map(jnp.asarray, nodes_slots)
+    else:
+        nodes_p = jax.tree.map(
+            lambda a: _pad_rows(jnp.asarray(a), Lq), nodes_slots)
+    args_p = tuple(_pad_rows(jnp.asarray(a), Lq) for a in args_slots)
+
+    u, Fr, Fi, state = prelude_fn(nodes_p, *args_p)
+    C_p, M_p, B_p = args_p[2:5]
+    nodes_cur = nodes_p
+    operands = (u, C_p, M_p, B_p, Fr, Fi)
+    operands_full = operands                 # original order, for finalize
+
+    max_trips = int(physics.nIter) + 1
+    # host-side waterfall bookkeeping: row -> original lane id (-1 = inert
+    # padding), per-lane final-state store filled as lanes retire
+    ids = np.concatenate(
+        [np.arange(L), np.full(Lq - L, -1, np.int64)])
+    state_store = None
+    trips = 0
+    blocks = 0
+    lane_iters = 0
+    rungs = []
+
+    def _store(state_dev, rows, lanes):
+        nonlocal state_store
+        leaves = [np.asarray(leaf) for leaf in state_dev]
+        if state_store is None:
+            state_store = [
+                np.zeros((L,) + leaf.shape[1:], leaf.dtype)
+                for leaf in leaves]
+        for buf, leaf in zip(state_store, leaves):
+            buf[lanes] = leaf[rows]
+
+    while True:
+        rungs.append(len(ids))
+        state = block_fn(nodes_cur, *operands, state)
+        blocks += 1
+        trips += K
+        lane_iters += len(ids) * K
+        done = np.asarray(state[4])
+        retire = done | (trips >= max_trips)
+        real = ids >= 0
+        retiring = retire & real
+        if retiring.any():
+            _store(state, np.where(retiring)[0], ids[retiring])
+        survivors = np.where(~retire & real)[0]
+        if survivors.size == 0:
+            break
+        Ln = ladder_lanes(survivors.size)
+        if Ln >= len(ids):
+            # no smaller rung to compact into: keep riding the current
+            # fixed-shape program (converged lanes freeze via cond)
+            continue
+        rows = np.concatenate(
+            [survivors,
+             np.full(Ln - survivors.size, survivors[0], np.int64)])
+        idx = jnp.asarray(rows)
+        take = lambda a: jnp.take(a, idx, axis=0)  # noqa: E731
+        operands = tuple(jax.tree.map(take, op) for op in operands)
+        if not shared_nodes:
+            nodes_cur = jax.tree.map(take, nodes_cur)
+        state = jax.tree.map(take, state)
+        ids = np.concatenate(
+            [ids[survivors], np.full(Ln - survivors.size, -1, np.int64)])
+
+    # scatter the retired per-lane loop states back into original lane
+    # order (exact: no arithmetic touches a state after its lane's last
+    # gated trip) and finalize every lane in ONE vmapped recovery-ladder
+    # program at the original rung
+    state_full = tuple(
+        jnp.asarray(_pad_rows(jnp.asarray(buf), Lq))
+        for buf in state_store)
+    xr, xi, report = finalize_fn(nodes_p, *operands_full, state_full)
+
+    _LAST_STATS.clear()
+    _LAST_STATS.update(
+        n_lanes=L, blocks=blocks, rungs=rungs,
+        lane_iters_executed=lane_iters,
+        lane_iters_monolithic=trips * Lq,
+        block_iters=K, kernel=bool(kernel),
+    )
+
+    take = lambda a: np.asarray(a)[:L]  # noqa: E731
+    return take(xr), take(xi), jax.tree.map(take, report)
+
+
+def grouped_waterfall_pipeline(model0, relax=0.8):
+    """Waterfall drop-in for ``sweep._sweep_pipeline``'s [design, case]
+    executable: call signature ``(nodes_b, zeta, beta, C, M, B, Fr, Fi)``
+    with leading [nd] (nodes) / [nd, nc] (args) axes, output
+    ``(xr [nd, nc, 6, nw], xi, report)`` exactly like the vmapped
+    pipeline — lanes flattened design-major/case-minor through the
+    iteration waterfall.  The sweep's bounded non-convergence retry
+    intentionally keeps the legacy pipeline (escalated (nIter, relax) is
+    a reference-path re-solve, per the health-ladder contract)."""
+    from raft_tpu.serve.buckets import SlotPhysics
+
+    physics = SlotPhysics.from_model(model0)
+
+    def pipeline(nodes_b, *args_b):
+        nd, nc = args_b[0].shape[:2]
+        L = int(nd) * int(nc)
+        nodes_flat = jax.tree.map(
+            lambda a: jnp.repeat(jnp.asarray(a), nc, axis=0), nodes_b)
+        args_flat = tuple(
+            jnp.reshape(jnp.asarray(a), (L,) + tuple(a.shape[2:]))
+            for a in args_b)
+        xr, xi, rep = waterfall_dispatch(
+            physics, nodes_flat, args_flat, relax=relax)
+        shape = lambda a: a.reshape((nd, nc) + a.shape[1:])  # noqa: E731
+        return shape(xr), shape(xi), jax.tree.map(shape, rep)
+
+    return pipeline
+
+
+def fused_waterfall_pipeline(model0, return_xi, relax=0.8):
+    """Waterfall drop-in for ``sweep_fused._dynamics_pipeline``'s
+    executable: same call signature ``(nodes_g, zeta, beta, C_g, M0_g,
+    a_g, b_g)`` (leading group axes [G, gd(, nB)]), same output tuple
+    ``(std, report[, xr, xi])`` shaped flat [nd_flat * nc, ...] along
+    the leading axis (design-major, case-minor — exactly what
+    ``_unpack_dyn`` reshapes).  The rank-1 hub aero-servo profiles are
+    materialized per lane (``M_lin = M0 + a(w) * P_hub``, elementwise
+    identical to the fused pipeline's in-graph expression) because the
+    waterfall phase programs take full [nw, 6, 6] matrices per lane;
+    ``waterfall_dispatch`` then slabs the megabatch at the top ladder
+    rung, so peak per-program operand memory stays bounded.  The
+    sweep's bounded non-convergence retry keeps the legacy pipeline
+    (health-ladder reference path)."""
+    from raft_tpu.serve.buckets import SlotPhysics
+    from raft_tpu.utils.frames import translate_matrix_3to6
+
+    physics = SlotPhysics.from_model(model0)
+    dtype = np.dtype(physics.dtype_name).type
+    w = np.frombuffer(physics.w_bytes, np.float64, count=physics.nw)
+    dw = float(w[1] - w[0])
+    nw = physics.nw
+    E00 = np.zeros((1, 3, 3))
+    E00[0, 0, 0] = 1.0
+    P_hub = jnp.asarray(
+        np.asarray(
+            translate_matrix_3to6(E00, np.array([0.0, 0.0,
+                                                 float(model0.hHub)]))
+        )[0],
+        dtype,
+    )
+
+    def pipeline(nodes_g, zeta, beta, C_g, M0_g, a_g, b_g):
+        lead = C_g.shape[:-3]          # (G, gd, nB) or (G, gd)
+        ncc = C_g.shape[-3]
+        n_designs = int(np.prod(lead[:2], dtype=np.int64))  # nodes axis
+        n_rows = int(np.prod(lead, dtype=np.int64))         # C/a/b rows
+        L = n_rows * ncc
+        nB = n_rows // n_designs
+        nodes_flat = jax.tree.map(
+            lambda a: a.reshape((n_designs,) + a.shape[2:]), nodes_g)
+        C_flat = C_g.reshape((n_rows, ncc, 6, 6))
+        M0_flat = M0_g.reshape((n_rows, 6, 6))
+        a_flat = a_g.reshape((n_rows, ncc, nw))
+        b_flat = b_g.reshape((n_rows, ncc, nw))
+
+        idx = jnp.arange(L)
+        ri = idx // ncc                                  # design-row idx
+        ci = idx % ncc                                   # case idx
+        di = ri // nB                                    # node-bundle idx
+        nodes_l = jax.tree.map(
+            lambda a: jnp.take(a, di, axis=0), nodes_flat)
+        M0_s = jnp.take(M0_flat, ri, axis=0)             # [L, 6, 6]
+        a_s = a_flat[ri, ci]                             # [L, nw]
+        b_s = b_flat[ri, ci]
+        M_lin = M0_s[:, None] + a_s[:, :, None, None] * P_hub
+        B_lin = b_s[:, :, None, None] * P_hub
+        Fz = jnp.zeros((L, nw, 6), dtype)
+        args = (jnp.take(zeta, ci, axis=0),
+                jnp.take(beta, ci, axis=0),
+                C_flat[ri, ci], M_lin, B_lin, Fz, Fz)
+        xr, xi, rep = waterfall_dispatch(physics, nodes_l, args,
+                                         relax=relax)
+        std = np.sqrt(np.sum(xr * xr + xi * xi, axis=-1) * dw)
+        if return_xi:
+            return std, rep, xr, xi
+        return std, rep
+
+    return pipeline
+
+
+def waterfall_case_dispatch(model, args):
+    """The single-Model entry: route ``Model.analyze_cases``'s prepared
+    case inputs through the iteration waterfall (what the non-slots
+    dispatch does when ``RAFT_TPU_FIXED_POINT`` != legacy).  The node
+    bundle is SHARED across lanes (vmapped in_axes None) and NEVER
+    node-padded: the fixed point couples frequencies and nodes through
+    the drag-RMS reductions, so only the pure vmap lane axis is
+    quantized and per-lane arithmetic is bit-identical to the legacy
+    closed-over-nodes pipeline's."""
+    from raft_tpu.serve.buckets import SlotPhysics
+
+    physics = SlotPhysics.from_model(model)
+    nodes = model.nodes.astype(model.dtype)
+    return waterfall_dispatch(physics, nodes, tuple(args),
+                              relax=float(getattr(model, "relax", 0.8)),
+                              shared_nodes=True)

@@ -18,9 +18,65 @@ jax.config.update("jax_platforms", "cpu")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+_SESSION_T0 = None
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "slow: compile-heavy test (> ~1 min); excluded from the fast lane "
         "`pytest -m 'not slow'`, always run in CI/driver full suites",
     )
+
+
+def pytest_sessionstart(session):
+    # pytest's own _sessionstarttime attribute moved between versions, so
+    # the duration recorder keeps its own wall-clock anchor
+    global _SESSION_T0
+    import time as _time
+
+    _SESSION_T0 = _time.time()
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    """Tier-1 wall-clock recorder (ISSUE 9 CI guard): when
+    ``RAFT_TPU_TIER1_RECORD=<path>`` is set, dump the run's wall-clock
+    and the slowest per-test call durations to a JSON artifact.  The
+    committed artifact (TIER1_DURATIONS.json) is validated by
+    tests/test_tier1_budget.py, which fails the suite when recorded
+    tier-1 wall creeps past 80% of the driver's 870 s timeout or an
+    unmarked test exceeds the per-test ceiling — so runtime creep
+    (263 s -> 522 s over six rounds) breaks loudly instead of silently
+    eating the timeout margin.  Capture:
+
+        RAFT_TPU_TIER1_RECORD=TIER1_DURATIONS.json \\
+            python -m pytest tests/ -q -m 'not slow' --durations=25
+    """
+    path = os.environ.get("RAFT_TPU_TIER1_RECORD")
+    if not path:
+        return
+    import json
+    import time as _time
+
+    durations = []
+    for replist in terminalreporter.stats.values():
+        for rep in replist:
+            if getattr(rep, "when", None) == "call":
+                durations.append(
+                    {"test": rep.nodeid,
+                     "seconds": round(rep.duration, 2)})
+    durations.sort(key=lambda d: -d["seconds"])
+    start = _SESSION_T0 or getattr(terminalreporter, "_sessionstarttime", None)
+    wall = (_time.time() - start) if start else 0.0
+    doc = {
+        "recorded_at": _time.strftime("%Y-%m-%d"),
+        "cmd": "python -m pytest tests/ -q -m 'not slow'",
+        "wall_s": round(wall, 1),
+        "exitstatus": int(exitstatus),
+        "n_tests": len(durations),
+        "slowest": durations[:25],
+    }
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, indent=1)
+    os.replace(tmp, path)

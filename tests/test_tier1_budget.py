@@ -1,0 +1,74 @@
+"""Tier-1 wall-clock budget guard (ISSUE 9 CI satellite).
+
+Tier-1 runtime crept 263 s -> 522 s over six rounds against the driver's
+870 s `timeout -k`; nothing failed until a round would have been lost to
+rc=124.  The conftest recorder (``RAFT_TPU_TIER1_RECORD``) captures the
+suite's wall-clock and slowest per-test call durations into the
+committed TIER1_DURATIONS.json; these schema-style tests fail the suite
+when the RECORDED numbers breach policy:
+
+- tier-1 wall over 80% of the 870 s budget (creep must be paid down or
+  tests moved to the `slow` lane BEFORE the margin is gone);
+- any single recorded (i.e. unmarked-slow, tier-1-lane) test over the
+  per-test ceiling — subprocess- or compile-heavy tests belong under
+  ``@pytest.mark.slow``.
+
+Regenerate the artifact with:
+
+    RAFT_TPU_TIER1_RECORD=TIER1_DURATIONS.json \
+        python -m pytest tests/ -q -m 'not slow' --durations=25
+"""
+
+import json
+import os
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ARTIFACT = os.path.join(ROOT, "TIER1_DURATIONS.json")
+
+TIER1_TIMEOUT_S = 870.0       # the driver's `timeout -k 10 870`
+WALL_BUDGET_FRAC = 0.80       # fail while margin still exists
+# Per-test ceiling: over this and unmarked-slow -> fail.  Set above the
+# worst pre-existing tier-1 test (chaos SIGTERM subprocess drain, ~122 s
+# recorded) rather than demoting it to `slow` — the fault-envelope tests
+# are load-bearing for every round; the ceiling stops NEW tests from
+# matching it.
+PER_TEST_CEILING_S = 150.0
+
+
+@pytest.fixture(scope="module")
+def recorded():
+    if not os.path.exists(ARTIFACT):
+        pytest.skip("no TIER1_DURATIONS.json yet (recorder has not run)")
+    with open(ARTIFACT) as fh:
+        return json.load(fh)
+
+
+def test_artifact_schema(recorded):
+    for key in ("recorded_at", "cmd", "wall_s", "n_tests", "slowest"):
+        assert key in recorded, key
+    assert recorded["n_tests"] > 0
+    assert isinstance(recorded["slowest"], list) and recorded["slowest"]
+    for entry in recorded["slowest"]:
+        assert set(entry) == {"test", "seconds"}
+
+
+def test_tier1_wall_within_budget(recorded):
+    cap = TIER1_TIMEOUT_S * WALL_BUDGET_FRAC
+    assert recorded["wall_s"] <= cap, (
+        f"recorded tier-1 wall {recorded['wall_s']} s exceeds "
+        f"{WALL_BUDGET_FRAC:.0%} of the {TIER1_TIMEOUT_S:.0f} s driver "
+        f"timeout ({cap:.0f} s): pay down the creep or move "
+        f"compile/subprocess-heavy tests to the `slow` lane "
+        f"(see TIER1_DURATIONS.json slowest entries)"
+    )
+
+
+def test_no_unmarked_test_over_ceiling(recorded):
+    over = [e for e in recorded["slowest"]
+            if e["seconds"] > PER_TEST_CEILING_S]
+    assert not over, (
+        f"tier-1-lane tests over the {PER_TEST_CEILING_S:.0f} s per-test "
+        f"ceiling (mark them @pytest.mark.slow): {over}"
+    )

@@ -1,0 +1,192 @@
+"""Convergence-aware fixed-point engine (raft_tpu/waterfall.py).
+
+The contract under test is the engine's bit-parity guarantee: a lane's
+fixed-point trajectory is identical whether it rides the monolithic
+batched while_loop, the fixed-trip scan variant, or the waterfall's
+compacted K-iteration blocks — including NaN-quarantined lanes and
+lanes that never converge — because all three drive the SAME
+``fixed_point_phases`` closures and vmapped lanes are data-independent.
+The fused Pallas megakernel (interpret mode on CPU) is pinned at
+tolerance level with identical convergence/quarantine flags.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from raft_tpu.designs import deep_spar
+from raft_tpu.model import Model
+from raft_tpu.pallas_kernels import fused_block_fn  # noqa: F401  (lint)
+from raft_tpu.serve.buckets import (
+    BucketSpec,
+    SlotPhysics,
+    dispatch_slots,
+    pack_slots,
+)
+from raft_tpu.serve.cache import current_flags, flags_mismatch
+from raft_tpu.waterfall import (
+    LANE_LADDER,
+    fixed_point_mode,
+    ladder_lanes,
+    last_dispatch_stats,
+    waterfall_dispatch,
+)
+
+NW = (0.05, 0.5)    # small frequency grid keeps compiles cheap
+
+
+def _spar():
+    d = deep_spar(n_cases=2, nw_settings=NW)
+    d["platform"]["members"][0]["rho_fill"] = [1800.0, 0.0, 0.0]
+    return d
+
+
+@pytest.fixture(scope="module")
+def packed():
+    """A 16-lane megabatch with a real convergence spread: node drag
+    coefficients swept over 3+ decades (iteration counts then range from
+    ~6 to ~11), one NaN-poisoned lane, and per-lane zeta/B_lin scaling —
+    the convergence-heterogeneous workload the waterfall exists for."""
+    m = Model(_spar(), precision="float64")
+    m.analyze_unloaded()
+    args, _ = m.prepare_case_inputs(verbose=False)
+    nodes = m.nodes.astype(m.dtype)
+
+    reps = 8
+    args16 = [np.concatenate([np.asarray(a)] * reps, axis=0) for a in args]
+    L = args16[0].shape[0]
+    args16[0] = np.array(args16[0], copy=True) * np.geomspace(
+        0.02, 50.0, L)[:, None]
+    args16[4] = np.array(args16[4], copy=True)
+    args16[4] *= np.geomspace(1e-3, 1.0, L)[:, None, None, None]
+    args16[2] = np.array(args16[2], copy=True)
+    args16[2][7] = np.nan                     # NaN-quarantined lane
+
+    spec = BucketSpec(nw=m.nw, n_nodes=nodes.r.shape[0], n_slots=16)
+    nodes_slots, args_slots, _ = pack_slots([(nodes, args16)], spec)
+    cdf = np.geomspace(0.2, 400.0, 16)
+    upd = {f: np.array(getattr(nodes_slots, f), copy=True) * cdf[:, None]
+           for f in ("Cd_q", "Cd_p1", "Cd_p2", "Cd_End")}
+    nodes_slots = dataclasses.replace(nodes_slots, **upd)
+    physics = SlotPhysics.from_model(m)
+    ref = dispatch_slots(physics, spec, nodes_slots, args_slots)
+    return physics, spec, nodes_slots, args_slots, ref
+
+
+def _report_fields(rep):
+    return {f: np.asarray(getattr(rep, f))
+            for f in ("converged", "iters", "nonfinite", "recovery_tier",
+                      "residual", "cond")}
+
+
+def test_ladder_lanes_quantization():
+    assert [ladder_lanes(n) for n in (1, 8, 9, 16, 100, 128)] == \
+        [8, 8, 16, 16, 128, 128]
+    assert ladder_lanes(129) == 256
+    assert ladder_lanes(700) == 1024
+    assert LANE_LADDER == (8, 16, 32, 64, 128)
+
+
+def test_default_mode_is_legacy(monkeypatch):
+    monkeypatch.delenv("RAFT_TPU_FIXED_POINT", raising=False)
+    assert fixed_point_mode() == "legacy"
+    monkeypatch.setenv("RAFT_TPU_FIXED_POINT", "nonsense")
+    assert fixed_point_mode() == "legacy"
+    monkeypatch.setenv("RAFT_TPU_FIXED_POINT", "waterfall")
+    assert fixed_point_mode() == "waterfall"
+
+
+def test_scan_path_bit_parity_with_while_loop(packed):
+    """The checkable=True fixed-trip scan (a scan of gated cond trips)
+    is bit-identical to the default batched while_loop — the equivalence
+    the waterfall's block decomposition is built on."""
+    physics, spec, nodes_slots, args_slots, ref = packed
+    xr_w, xi_w, rep_w = ref
+    xr_s, xi_s, rep_s = dispatch_slots(physics, spec, nodes_slots,
+                                       args_slots, checkable=True)
+    assert np.array_equal(np.asarray(xr_w), np.asarray(xr_s))
+    assert np.array_equal(np.asarray(xi_w), np.asarray(xi_s))
+    fw, fs = _report_fields(rep_w), _report_fields(rep_s)
+    for name in fw:
+        assert np.array_equal(fw[name], fs[name]), name
+
+
+def test_waterfall_bit_parity_with_compaction_and_nan(packed):
+    """Waterfall blocks + active-lane compaction reproduce the legacy
+    monolithic dispatch TO THE BIT — per-lane amplitudes and every
+    SolveReport field — on a megabatch whose lanes converge at different
+    iterations, including the NaN-quarantined lane and lanes retired in
+    compacted (smaller-rung) blocks."""
+    physics, spec, nodes_slots, args_slots, ref = packed
+    xr_w, xi_w, rep_w = ref
+    xr, xi, rep = waterfall_dispatch(physics, nodes_slots,
+                                     tuple(args_slots), block=2,
+                                     kernel=False)
+    assert np.array_equal(np.asarray(xr_w), xr)
+    assert np.array_equal(np.asarray(xi_w), xi)
+    fw, fv = _report_fields(rep_w), _report_fields(rep)
+    for name in fw:
+        assert np.array_equal(fw[name], fv[name]), name
+    # the spread actually exercised compaction and saved lane-iterations
+    st = last_dispatch_stats()
+    assert st["n_lanes"] == 16 and not st["kernel"]
+    assert min(st["rungs"]) < max(st["rungs"]), st["rungs"]
+    assert st["lane_iters_executed"] < st["lane_iters_monolithic"]
+    iters = fv["iters"]
+    assert iters.max() > iters.min()          # heterogeneous by design
+    assert fv["nonfinite"][7] and not fv["converged"][7]
+
+
+def test_fused_megakernel_interpret_parity(packed):
+    """The fused per-iteration Pallas megakernel (interpret mode on CPU)
+    rides the same waterfall driver: identical iteration counts and
+    convergence/quarantine flags, amplitudes at tolerance level (the
+    kernel's reduction orders differ from XLA's)."""
+    physics, spec, nodes_slots, args_slots, ref = packed
+    xr_w, xi_w, rep_w = ref
+    xr, xi, rep = waterfall_dispatch(physics, nodes_slots,
+                                     tuple(args_slots), block=2,
+                                     kernel=True)
+    assert last_dispatch_stats()["kernel"]
+    fw, fv = _report_fields(rep_w), _report_fields(rep)
+    for name in ("converged", "iters", "nonfinite", "recovery_tier"):
+        assert np.array_equal(fw[name], fv[name]), name
+    np.testing.assert_allclose(xr, np.asarray(xr_w), rtol=1e-8,
+                               atol=1e-12)
+    np.testing.assert_allclose(xi, np.asarray(xi_w), rtol=1e-8,
+                               atol=1e-12)
+
+
+def test_analyze_cases_waterfall_mode_matches_legacy(monkeypatch):
+    """Model.analyze_cases under RAFT_TPU_FIXED_POINT=waterfall returns
+    the legacy path's bits (same phase closures, same lane count after
+    ladder padding discard)."""
+    monkeypatch.delenv("RAFT_TPU_FIXED_POINT", raising=False)
+    m0 = Model(_spar(), precision="float64")
+    m0.analyze_unloaded()
+    m0.analyze_cases(display=0)
+
+    monkeypatch.setenv("RAFT_TPU_FIXED_POINT", "waterfall")
+    m1 = Model(_spar(), precision="float64")
+    m1.analyze_unloaded()
+    m1.analyze_cases(display=0)
+    assert np.array_equal(m0.Xi, m1.Xi)
+    for name in ("converged", "iters", "nonfinite"):
+        assert np.array_equal(m0.results["solve_report"][name],
+                              m1.results["solve_report"][name]), name
+
+
+def test_cache_flags_refuse_cross_mode_executables(monkeypatch):
+    """Warm-up entries recorded under one fixed-point mode are refused
+    under another: the mode is a numerics-relevant dispatch flag, so a
+    waterfall-mode executable must never warm a legacy serve process (or
+    vice versa)."""
+    monkeypatch.delenv("RAFT_TPU_FIXED_POINT", raising=False)
+    legacy = current_flags()
+    assert legacy["fixed_point"] == "legacy"
+    assert flags_mismatch(legacy) is None
+    monkeypatch.setenv("RAFT_TPU_FIXED_POINT", "fused")
+    reason = flags_mismatch(legacy)
+    assert reason is not None and "fixed_point" in reason
+    assert current_flags()["fixed_point"] == "fused"
