@@ -93,6 +93,9 @@ _COMPACT_KEYS = (
     "serve_cold_first_s", "serve_warm_first_s",
     "serve_rejected_overload", "serve_watchdog_trips",
     "serve_breaker_transitions",
+    "serve_http_p50_s", "serve_http_p95_s", "serve_http_inproc_p50_s",
+    "serve_http_overhead_ms", "serve_http_2rep_speedup",
+    "smoke_http_overhead_ms", "smoke_http_bits",
     "kernel_backend_mode", "kernel_gj6_speedup",
     "kernel_gj6_max_abs_diff", "kernel_gjstage_speedup",
     "kernel_gjstage_max_abs_diff",
@@ -100,6 +103,7 @@ _COMPACT_KEYS = (
     "rao_error", "sweep_error", "sweep243_error", "bem_error",
     "bem_sharded_error", "grad_error", "serve_error",
     "chaos_smoke_error", "kernel_error", "sweep_warm_error",
+    "serve_http_error", "serve_http_smoke_error",
     "sweep_waterfall_error",
     "perf_docs_error", "sweep_scaling_error", "sweep1024_error",
     "sweep4096_error", "serve_multichip_error", "multichip_smoke_error",
@@ -374,6 +378,7 @@ def main(argv=None):
     if args.smoke:
         sections = [("smoke", bench_smoke),
                     ("serve_smoke", bench_serve_smoke),
+                    ("serve_http_smoke", bench_serve_http_smoke),
                     ("chaos_smoke", bench_chaos_smoke),
                     ("multichip_smoke", bench_multichip_smoke),
                     ("kernel", lambda: bench_kernels(
@@ -433,6 +438,7 @@ def main(argv=None):
             ("bem_stream", bench_bem_stream, 3.0),
             ("grad", bench_gradients, 0.5),
             ("serve", bench_serve, 5.0),
+            ("serve_http", bench_serve_http, 6.0),
             ("serve_multichip", bench_serve_multichip, 0.5),
             ("kernel", bench_kernels, 0.5),
             ("sweep_warm", bench_sweep_warm, 4.0),
@@ -962,6 +968,149 @@ def bench_serve_smoke(n_requests=3):
         "smoke_serve_dispatches": snap["dispatches"],
         "smoke_serve_occupancy": round(snap["occupancy_mean"], 3),
         "smoke_serve_s": round(time.perf_counter() - t0, 3),
+    }
+
+
+def bench_serve_http(n_requests=8, n_cases=4):
+    """Network-transport figures (docs/serving.md "Network transport &
+    replicas"): (a) wire p50/p95 through a local HTTP front end vs
+    in-process p50/p95 on the SAME warmed engine — the difference is
+    the transport overhead; (b) 2-replica vs 1-replica router
+    throughput on a two-family request mix (subprocess replicas sharing
+    one warm cache dir), recorded with the per-replica served split so
+    a degenerate hash placement can't masquerade as scaling."""
+    import tempfile
+
+    from raft_tpu.designs import deep_spar
+    from raft_tpu.serve import (Engine, EngineConfig, HashRing, Router,
+                                WireClient, routing_key, serve_http,
+                                wire)
+
+    out = {}
+    design = deep_spar(n_cases=n_cases, nw_settings=(0.05, 0.8))
+    with tempfile.TemporaryDirectory() as tmp:
+        eng = Engine(EngineConfig(precision="float64", window_ms=10.0,
+                                  cache_dir=tmp))
+        first = eng.evaluate(design, timeout=560)
+        assert first.status == "ok", first.error
+        inproc = []
+        for _ in range(n_requests):
+            t0 = time.perf_counter()
+            res = eng.evaluate(design, timeout=560)
+            inproc.append(time.perf_counter() - t0)
+            assert res.status == "ok", res.error
+        transport = serve_http(eng)
+        client = WireClient("127.0.0.1", transport.port)
+        wire_lat = []
+        doc = None
+        for _ in range(n_requests):
+            t0 = time.perf_counter()
+            doc = client.solve({"design": design, "xi": True})
+            wire_lat.append(time.perf_counter() - t0)
+            assert doc["status"] == "ok", doc.get("error")
+        # over-the-wire bit parity with the in-process result
+        assert np.array_equal(wire.result_from_doc(doc).Xi, res.Xi)
+        transport.close()
+        eng.shutdown()
+    inproc_p50 = float(np.percentile(inproc, 50))
+    wire_p50 = float(np.percentile(wire_lat, 50))
+    out.update({
+        "serve_http_requests": n_requests,
+        "serve_http_inproc_p50_s": round(inproc_p50, 4),
+        "serve_http_inproc_p95_s": round(
+            float(np.percentile(inproc, 95)), 4),
+        "serve_http_p50_s": round(wire_p50, 4),
+        "serve_http_p95_s": round(float(np.percentile(wire_lat, 95)), 4),
+        "serve_http_overhead_ms": round(
+            (wire_p50 - inproc_p50) * 1e3, 2),
+    })
+
+    # ---- 1-replica vs 2-replica router throughput ------------------
+    # two design families chosen (deterministically, via the ring) to
+    # land on DIFFERENT replicas of the 2-replica set, so the scaling
+    # figure measures two busy processes, not one hot one
+    ring2 = HashRing(["r0", "r1"])
+    fam_a = deep_spar(n_cases=2, nw_settings=(0.05, 0.5))
+    target = "r1" if ring2.lookup(routing_key(fam_a)) == "r0" else "r0"
+    fam_b = None
+    for bump in range(1, 16):
+        cand = deep_spar(n_cases=2, nw_settings=(0.05, 0.5))
+        mem = cand["platform"]["members"][0]
+        mem["d"] = [float(v) + 0.01 * bump for v in mem["d"]]
+        if ring2.lookup(routing_key(cand)) == target:
+            fam_b = cand
+            break
+    assert fam_b is not None, "no hull variant hashed to the 2nd replica"
+    mix = [fam_a if i % 2 == 0 else fam_b for i in range(n_requests)]
+    walls = {}
+    spread = {}
+    with tempfile.TemporaryDirectory() as shared:
+        for n_rep in (1, 2):
+            router = Router(n_replicas=n_rep, cache_dir=shared,
+                            precision="float64", window_ms=10.0)
+            try:
+                for fam in (fam_a, fam_b):       # warm (and fill the
+                    warm = router.evaluate(fam, timeout=560)  # shared
+                    assert warm.status == "ok", warm.error    # cache)
+                t0 = time.perf_counter()
+                handles = [router.submit(d) for d in mix]
+                results = [h.result(timeout=560) for h in handles]
+                walls[n_rep] = time.perf_counter() - t0
+                assert all(r.status == "ok" for r in results)
+                spread[n_rep] = {
+                    r["id"]: r["served"]
+                    for r in router.snapshot()["replicas"]}
+            finally:
+                router.shutdown()
+    out.update({
+        "serve_http_1rep_wall_s": round(walls[1], 3),
+        "serve_http_2rep_wall_s": round(walls[2], 3),
+        "serve_http_2rep_speedup": round(
+            walls[1] / max(walls[2], 1e-9), 2),
+        "serve_http_replica_spread": spread[2],
+    })
+    return out
+
+
+def bench_serve_http_smoke():
+    """Tier-1-safe transport smoke: engine + HTTP front end in one
+    process (no replica subprocesses), asserting over-the-wire bit
+    parity with the in-process result and recording the transport
+    overhead — a broken wire schema is caught by ``--smoke`` in CI,
+    not by a lost driver round."""
+    import tempfile
+
+    from raft_tpu.designs import deep_spar
+    from raft_tpu.serve import (Engine, EngineConfig, WireClient,
+                                serve_http, wire)
+
+    t0 = time.perf_counter()
+    design = deep_spar(n_cases=2, nw_settings=(0.05, 0.5))
+    with tempfile.TemporaryDirectory() as tmp:
+        eng = Engine(EngineConfig(precision="float64", window_ms=10.0,
+                                  cache_dir=tmp))
+        first = eng.evaluate(design, timeout=400)     # compile
+        assert first.status == "ok", first.error
+        t1 = time.perf_counter()
+        res = eng.evaluate(design, timeout=400)
+        inproc_s = time.perf_counter() - t1
+        transport = serve_http(eng)
+        client = WireClient("127.0.0.1", transport.port)
+        t2 = time.perf_counter()
+        doc = client.solve({"design": design, "xi": True})
+        wire_s = time.perf_counter() - t2
+        assert doc["status"] == "ok", doc.get("error")
+        assert np.array_equal(wire.result_from_doc(doc).Xi, res.Xi)
+        ready, probe = transport.readiness()
+        assert ready and probe["queue_depth"] == 0
+        transport.close()
+        eng.shutdown()
+    return {
+        "smoke_http_inproc_s": round(inproc_s, 4),
+        "smoke_http_wire_s": round(wire_s, 4),
+        "smoke_http_overhead_ms": round((wire_s - inproc_s) * 1e3, 2),
+        "smoke_http_bits": "identical",
+        "smoke_http_s": round(time.perf_counter() - t0, 3),
     }
 
 

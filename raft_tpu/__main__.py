@@ -9,14 +9,15 @@ warm-up of the serving buckets (manifest-driven; see docs/serving.md).
 
 ``python -m raft_tpu serve [design.yaml ...]`` — long-lived serving
 engine reading JSON-line requests from stdin and writing JSON-line
-results to stdout.
+results to stdout (the default legacy path), or with
+``--http PORT [--replicas N]`` an HTTP/1.1 JSON server over one
+engine or an N-replica consistent-hash router (docs/serving.md,
+"Network transport & replicas").
 """
 
 import argparse
 import json
 import sys
-
-import numpy as np
 
 
 def _analyze_main(argv):
@@ -89,13 +90,17 @@ class _SignalShutdown(BaseException):
 
 
 def _serve_main(argv):
+    import os
+
     p = _serve_parser(
         "raft_tpu serve",
         "Long-lived serving engine: JSON-line requests on stdin "
         '({"design": "path.yaml", "cases": [...], "deadline_s": 10}), '
-        "JSON-line results on stdout.  SIGTERM/SIGINT shut down "
-        "gracefully: in-flight batches drain and every outstanding "
-        'handle resolves with a terminal status ("shutdown" at worst).')
+        "JSON-line results on stdout.  With --http, an HTTP/1.1 JSON "
+        "server (and optionally an N-replica router) instead of the "
+        "stdin loop.  SIGTERM/SIGINT shut down gracefully: in-flight "
+        "batches drain and every outstanding handle resolves with a "
+        'terminal status ("shutdown" at worst).')
     p.add_argument("--window-ms", type=float, default=None,
                    help="micro-batching window (default "
                         "RAFT_TPU_SERVE_WINDOW_MS or 5 ms)")
@@ -104,7 +109,26 @@ def _serve_main(argv):
     p.add_argument("--xi", action="store_true",
                    help="include the full complex response amplitudes "
                         "in each result line")
+    p.add_argument("--http", type=int, default=None, metavar="PORT",
+                   help="serve the wire protocol over HTTP on PORT "
+                        "(0 = OS-assigned, read back from the ready "
+                        "line; default RAFT_TPU_SERVE_HTTP_PORT; "
+                        "omitted entirely = legacy stdin JSONL loop)")
+    p.add_argument("--replicas", type=int, default=None, metavar="N",
+                   help="with --http: front N spawned engine replica "
+                        "processes with the consistent-hash router "
+                        "(default RAFT_TPU_SERVE_REPLICAS or 0 = serve "
+                        "one in-process engine)")
     args = p.parse_args(argv)
+
+    http_port = args.http
+    if http_port is None and os.environ.get("RAFT_TPU_SERVE_HTTP_PORT"):
+        http_port = int(os.environ["RAFT_TPU_SERVE_HTTP_PORT"])
+    if args.cache_dir is None and os.environ.get(
+            "RAFT_TPU_SERVE_SHARED_CACHE"):
+        args.cache_dir = os.environ["RAFT_TPU_SERVE_SHARED_CACHE"]
+    if http_port is not None:
+        return _serve_http_main(args, http_port)
 
     import signal
 
@@ -179,24 +203,69 @@ def _serve_main(argv):
             if not isinstance(v, (list, dict))}}), flush=True)
 
 
-def _emit_result(res, include_xi=False):
-    doc = {
-        "event": "result", "rid": res.rid, "status": res.status,
-        "latency_s": round(res.latency_s, 4),
-        "batch_requests": res.batch_requests,
-        "batch_occupancy": round(res.batch_occupancy, 3),
+def _serve_http_main(args, http_port):
+    """The --http serve path: one in-process engine (replicas=0) or an
+    N-replica router, fronted by serve/transport.py.  stdout carries
+    only the ready/shutdown lines; requests ride the wire."""
+    import os
+    import signal
+    import threading
+
+    from raft_tpu.io.schema import load_design
+    from raft_tpu.serve import Engine, EngineConfig, serve_http, warmup
+
+    n_replicas = args.replicas
+    if n_replicas is None:
+        n_replicas = int(os.environ.get("RAFT_TPU_SERVE_REPLICAS", "0"))
+
+    if n_replicas > 0:
+        from raft_tpu.serve import Router
+
+        backend = Router(
+            n_replicas=n_replicas, cache_dir=args.cache_dir,
+            precision=args.precision, device=args.device,
+            window_ms=args.window_ms, warmup=not args.no_warmup)
+    else:
+        cfg = EngineConfig(precision=args.precision, device=args.device,
+                           cache_dir=args.cache_dir)
+        if args.window_ms is not None:
+            cfg.window_ms = args.window_ms
+        designs = [load_design(path) for path in args.designs]
+        if not args.no_warmup:
+            warmup(designs=designs or None, precision=args.precision,
+                   cache_dir=args.cache_dir)
+        backend = Engine(cfg)
+
+    stop = threading.Event()
+    sig_caught = []
+
+    def _on_signal(signum, frame):
+        sig_caught.append(signum)
+        stop.set()
+
+    old_handlers = {
+        s: signal.signal(s, _on_signal)
+        for s in (signal.SIGTERM, signal.SIGINT)
     }
-    if res.error:
-        doc["error"] = res.error
-    if res.status == "ok":
-        doc["std"] = res.std.tolist()
-        rep = res.solve_report
-        doc["converged"] = np.asarray(rep["converged"]).tolist()
-        doc["nonfinite"] = np.asarray(rep["nonfinite"]).tolist()
-        if include_xi:
-            doc["Xi_re"] = res.Xi.real.tolist()
-            doc["Xi_im"] = res.Xi.imag.tolist()
-    print(json.dumps(doc), flush=True)
+    transport = serve_http(backend, port=http_port)
+    try:
+        print(json.dumps({"event": "ready", "port": transport.port,
+                          "replicas": n_replicas}), flush=True)
+        stop.wait()
+    finally:
+        for s, h in old_handlers.items():
+            signal.signal(s, h)
+        report = transport.drain(drain_queue=not sig_caught)
+        print(json.dumps({"event": "shutdown",
+                          "signal": sig_caught[0] if sig_caught else None,
+                          **report}), flush=True)
+
+
+def _emit_result(res, include_xi=False):
+    from raft_tpu.serve import wire
+
+    print(json.dumps(wire.result_doc(res, include_xi=include_xi)),
+          flush=True)
 
 
 def main(argv=None):

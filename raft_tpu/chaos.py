@@ -45,6 +45,13 @@ Fault classes and their hook points:
                     TransientError the retry policy may re-attempt
 ``corrupt_cache``   a just-written prep-cache entry is overwritten with
                     garbage — the load path must refuse + delete it
+``conn_drop``       the HTTP transport closes the client socket after the
+                    accepted chunk but before the terminal result line
+                    (serve/transport.py) — the client sees a dropped
+                    stream while the engine handle still resolves
+``replica_kill``    the router SIGKILLs the replica it just forwarded the
+                    request to (serve/router.py) — the in-flight request
+                    must be retried on another replica, bit-identically
 ==================  ======================================================
 
 Per-rid targeting caveat: the engine deduplicates prep per design key,
@@ -71,7 +78,7 @@ from raft_tpu.utils.profiling import logger
 CHAOS_ENV = "RAFT_TPU_CHAOS"
 
 FAULTS = ("prep_raise", "prep_slow", "nan_lane", "dispatch_stall",
-          "backend_error", "corrupt_cache")
+          "backend_error", "corrupt_cache", "conn_drop", "replica_kill")
 
 _DEFAULT_VALUES = {"prep_slow": 1.0, "dispatch_stall": 5.0}
 

@@ -273,3 +273,13 @@ class BreakerBoard:
         with self._lock:
             return sum(len(br.transitions)
                        for br in self._breakers.values())
+
+    def states(self):
+        """{key: state} without per-breaker snapshots — cheap enough for
+        a readiness probe polled every few seconds."""
+        with self._lock:
+            items = list(self._breakers.items())
+        return {str(k): br.state for k, br in items}
+
+    def open_count(self):
+        return sum(1 for s in self.states().values() if s != STATE_CLOSED)

@@ -32,9 +32,17 @@ terminal-status guarantee for every submitted handle — all exercised
 deterministically by the chaos harness (raft_tpu/chaos.py,
 ``RAFT_TPU_CHAOS``).
 
-Entry points: ``python -m raft_tpu serve|warmup`` (CLI) and the
-in-process :class:`Engine` API used by tests and ``bench.py``.
-Design document: docs/serving.md.
+Scale-out (PR 10): an HTTP/1.1 JSON transport
+(:mod:`raft_tpu.serve.transport`) over the engine with streaming
+terminal results and breaker-driven ``/healthz``/``/readyz``, and an
+N-replica consistent-hash router (:mod:`raft_tpu.serve.router`) that
+keeps per-bucket executables hot per replica and shares one on-disk
+warm-up/XLA cache between replicas.  Wire schema:
+:mod:`raft_tpu.serve.wire`.
+
+Entry points: ``python -m raft_tpu serve [--http PORT [--replicas N]]``
+/ ``warmup`` (CLI) and the in-process :class:`Engine` API used by
+tests and ``bench.py``.  Design document: docs/serving.md.
 """
 
 from raft_tpu.serve.buckets import (  # noqa: F401
@@ -60,4 +68,16 @@ from raft_tpu.serve.engine import (  # noqa: F401
     EngineConfig,
     Request,
     RequestResult,
+)
+from raft_tpu.serve.router import (  # noqa: F401
+    HashRing,
+    Router,
+    routing_key,
+    spawn_replica,
+)
+from raft_tpu.serve.transport import (  # noqa: F401
+    ConnectionDropped,
+    HttpTransport,
+    WireClient,
+    serve_http,
 )

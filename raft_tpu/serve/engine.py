@@ -224,6 +224,7 @@ class RequestResult:
     batch_requests: int = 0          # requests coalesced in the dispatch
     batch_occupancy: float = 0.0     # real lanes / bucket slots
     backend: str = None              # backend the dispatch ran on
+    replica: str = None              # replica id when routed (router.py)
 
     @property
     def ok(self):
@@ -1083,6 +1084,30 @@ class Engine:
 
     # -------------------------------------------------------------- stats
 
+    def probe(self):
+        """Cheap readiness gauge: queue depth, in-flight count, shed /
+        stop flags and breaker-board state in one read.
+
+        Deliberately lock-free on the engine side — ``len()`` of a list
+        or dict is atomic under the GIL and a readiness probe tolerates
+        a stale-by-one value, so a probe polled every few seconds can
+        never convoy with the hot ``submit`` path on ``self._lock``.
+        Only the breaker board takes its own (uncontended) lock.
+        """
+        stopped = self._stop
+        shedding = self._shedding
+        return {
+            "queue_depth": len(self._queue),
+            "in_flight": len(self._outstanding),
+            "shedding": shedding,
+            "stopped": stopped,
+            "accepting": not (stopped or shedding),
+            "max_queue": self.config.max_queue,
+            "low_water": self.config.low_water,
+            "breakers_open": self._breakers.open_count(),
+            "breaker_states": self._breakers.states(),
+        }
+
     def snapshot(self):
         """Flat stats summary (bench.py's serve section reads this)."""
         lat = np.asarray(self.stats["latency_s"], float)
@@ -1104,6 +1129,8 @@ class Engine:
             "shutdown_resolved": self.stats["shutdown_resolved"],
             "degraded_dispatches": self.stats["degraded_dispatches"],
             "outstanding": len(self._outstanding),
+            "queue_depth": len(self._queue),
+            "in_flight": len(self._outstanding),
             "prep_cache_hits": self.stats["prep_cache_hits"],
             "prep_memo_hits": self.stats["prep_memo_hits"],
             "first_result_s": self.stats["first_result_s"],

@@ -1,0 +1,484 @@
+"""N-replica front tier for the serve engine (scale-out over processes).
+
+``Router`` spawns (or attaches to) N ``python -m raft_tpu serve --http
+0`` engine replicas and fronts them with the same ``submit``/``probe``/
+``snapshot``/``shutdown`` surface as the engine itself, so the HTTP
+transport (serve/transport.py) can serve a router exactly as it serves
+a single engine.
+
+Placement — hot executables stay hot.  Requests hash by
+``routing_key(design, cases)``: a stable digest of the
+physics/bucket-determining design subset (frequency settings, site,
+member geometry, case count) that deliberately EXCLUDES
+non-physics-key fields like ballast fills, so a family of design
+variants that share per-bucket executables lands on one replica and
+keeps its compiled programs warm.  The key walks a consistent-hash
+ring (virtual nodes), so growing the replica set only moves the keys
+that land on the new replica — every other replica keeps its warmed
+buckets (pinned in tests/test_router.py).
+
+Warm one, warm all.  Every replica shares one on-disk cache directory
+(``RAFT_TPU_CACHE_DIR``): the persistent XLA compilation cache, the
+prep-npz cache and the warm-up manifest (serve/cache.py).  A bucket
+compiled or a design prepped by replica 1 is a disk hit for replica
+2's first request.
+
+Resilience at the router tier (resilience.py, reused as designed in
+PR 5): a per-replica ``CircuitBreaker`` via ``BreakerBoard``; forwards
+that fail with a ``TransientError`` (dropped connection, dead replica,
+replica mid-drain) retry on the next replica in ring-preference order
+— safe because a solve is pure; deadline admission happens before any
+forwarding (``deadline_s <= 0`` never crosses the wire) and the
+remaining deadline is re-checked per attempt.
+
+Fault injection: the ``replica_kill`` chaos fault (chaos.py) SIGKILLs
+the replica a request was just forwarded to, forcing the
+retry-on-other-replica path; the chaos env is stripped from replica
+processes so the fault stays at the router tier.
+"""
+
+import hashlib
+import json
+import os
+import queue
+import signal
+import subprocess
+import sys
+import threading
+import time
+from bisect import bisect_right
+from concurrent.futures import ThreadPoolExecutor
+
+from raft_tpu.chaos import get_injector
+from raft_tpu.resilience import BreakerBoard, TransientError
+from raft_tpu.serve import wire
+from raft_tpu.serve.engine import _Pending
+from raft_tpu.serve.transport import ConnectionDropped, WireClient
+from raft_tpu.utils.profiling import logger
+
+DEFAULT_READY_TIMEOUT_S = 300.0
+_VNODES = 64
+
+
+def _hash_point(text):
+    return int.from_bytes(
+        hashlib.sha256(text.encode()).digest()[:8], "big")
+
+
+def _jsonable_design(obj):
+    if hasattr(obj, "tolist"):
+        return obj.tolist()
+    if hasattr(obj, "item"):
+        return obj.item()
+    if isinstance(obj, dict):
+        return {str(k): _jsonable_design(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_jsonable_design(v) for v in obj]
+    return obj
+
+
+# member fields that determine physics/bucket identity; fills and
+# densities (l_fill, rho_fill, rho_shell) are ballast knobs that leave
+# the compiled executables untouched, so variants share a replica.
+_ROUTING_MEMBER_KEYS = ("name", "type", "shape", "rA", "rB", "gamma",
+                        "potMod", "stations", "d", "t", "Cd", "Ca",
+                        "CdEnd", "CaEnd")
+
+
+def routing_key(design, cases=None):
+    """Stable physics/bucket placement key for a request.
+
+    Built from the frequency settings (the nw bucket axis), the site,
+    member geometry (node/strip layout) and the case count (the slot
+    bucket axis) — NOT from the full design, so e.g. a ballast sweep
+    over one hull maps to one replica's warmed executables.
+    """
+    if cases is not None:
+        n_cases = len(cases)
+    else:
+        n_cases = len(design.get("cases", {}).get("data", []) or [])
+    doc = {
+        "settings": design.get("settings"),
+        "site": design.get("site"),
+        "dlsMax": design.get("platform", {}).get("dlsMax"),
+        "members": [
+            {k: m.get(k) for k in _ROUTING_MEMBER_KEYS if k in m}
+            for m in design.get("platform", {}).get("members", [])
+        ],
+        "n_cases": int(n_cases),
+    }
+    payload = json.dumps(_jsonable_design(doc), sort_keys=True)
+    return hashlib.sha256(payload.encode()).hexdigest()[:16]
+
+
+class HashRing:
+    """Consistent-hash ring with virtual nodes.
+
+    ``lookup(key)`` is stable across processes (sha256, no process
+    seed) and across replica-set growth: adding a replica only claims
+    the arc segments its virtual nodes land on — keys outside those
+    segments keep their assignment (the property
+    tests/test_router.py pins)."""
+
+    def __init__(self, ids, vnodes=_VNODES):
+        self.ids = list(ids)
+        self._points = sorted(
+            (_hash_point(f"{rid}#{v}"), rid)
+            for rid in self.ids for v in range(vnodes))
+
+    def lookup(self, key):
+        h = _hash_point(key)
+        idx = bisect_right(self._points, (h, "")) % len(self._points)
+        return self._points[idx][1]
+
+    def preference(self, key):
+        """All replica ids in ring-walk order from the key's point —
+        element 0 is the primary, the rest are the failover order."""
+        h = _hash_point(key)
+        start = bisect_right(self._points, (h, ""))
+        order, seen = [], set()
+        n = len(self._points)
+        for i in range(n):
+            rid = self._points[(start + i) % n][1]
+            if rid not in seen:
+                seen.add(rid)
+                order.append(rid)
+        return order
+
+
+class Replica:
+    """One engine replica endpoint (spawned subprocess or attached)."""
+
+    def __init__(self, replica_id, host, port, proc=None,
+                 stderr_path=None):
+        self.id = replica_id
+        self.host, self.port = host, port
+        self.proc = proc
+        self.stderr_path = stderr_path
+        self.client = WireClient(host, port)
+        self.alive = True
+        self.served = 0
+
+    def dead(self):
+        if self.proc is not None and self.proc.poll() is not None:
+            self.alive = False
+        return not self.alive
+
+    def info(self):
+        return {"id": self.id, "host": self.host, "port": self.port,
+            "alive": self.alive, "served": self.served,
+            "pid": self.proc.pid if self.proc is not None else None}
+
+
+def _repo_root():
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def spawn_replica(replica_id, cache_dir=None, precision=None, device=None,
+                  window_ms=None, warmup=True, extra_argv=(),
+                  env_overrides=None,
+                  ready_timeout_s=DEFAULT_READY_TIMEOUT_S):
+    """Launch one engine replica; blocks until its ready line reports
+    the OS-assigned port (the replica binds ``--http 0`` — no fixed
+    ports anywhere)."""
+    argv = [sys.executable, "-m", "raft_tpu", "serve", "--http", "0"]
+    if precision:
+        argv += ["--precision", precision]
+    if device:
+        argv += ["--device", device]
+    if window_ms is not None:
+        argv += ["--window-ms", str(window_ms)]
+    if not warmup:
+        argv += ["--no-warmup"]
+    if cache_dir:
+        argv += ["--cache-dir", str(cache_dir)]
+    argv += list(extra_argv)
+
+    env = dict(os.environ)
+    # chaos stays at the router tier; serve-scale env must not recurse
+    for k in ("RAFT_TPU_CHAOS", "RAFT_TPU_SERVE_HTTP_PORT",
+              "RAFT_TPU_SERVE_REPLICAS"):
+        env.pop(k, None)
+    if cache_dir:
+        env["RAFT_TPU_CACHE_DIR"] = str(cache_dir)
+    env["PYTHONPATH"] = _repo_root() + os.pathsep + env.get(
+        "PYTHONPATH", "")
+    env.update(env_overrides or {})
+
+    stderr_path = None
+    stderr_fh = subprocess.DEVNULL
+    if cache_dir:
+        stderr_path = os.path.join(str(cache_dir),
+                                   f"replica-{replica_id}.stderr.log")
+        stderr_fh = open(stderr_path, "w")
+    try:
+        proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
+                                stderr=stderr_fh, text=True, env=env)
+    finally:
+        if stderr_fh is not subprocess.DEVNULL:
+            stderr_fh.close()
+
+    lines = queue.Queue()
+
+    def _pump():
+        for line in proc.stdout:
+            lines.put(line)
+        lines.put(None)
+
+    threading.Thread(target=_pump, daemon=True,
+                     name=f"replica-{replica_id}-stdout").start()
+
+    deadline = time.monotonic() + ready_timeout_s
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            proc.kill()
+            raise TimeoutError(
+                f"replica {replica_id} not ready in {ready_timeout_s}s"
+                + (f" (stderr: {stderr_path})" if stderr_path else ""))
+        try:
+            line = lines.get(timeout=min(remaining, 1.0))
+        except queue.Empty:
+            continue
+        if line is None:
+            raise RuntimeError(
+                f"replica {replica_id} exited rc={proc.poll()} before "
+                f"ready" + (f" (stderr: {stderr_path})"
+                            if stderr_path else ""))
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            continue
+        if doc.get("event") == "ready" and "port" in doc:
+            return Replica(replica_id, "127.0.0.1", int(doc["port"]),
+                           proc=proc, stderr_path=stderr_path)
+
+
+class Router:
+    """See module docstring.  Engine-compatible front surface."""
+
+    def __init__(self, n_replicas=2, cache_dir=None, precision=None,
+                 device=None, window_ms=None, warmup=True,
+                 replica_argv=(), env_overrides=None,
+                 endpoints=None, ready_timeout_s=DEFAULT_READY_TIMEOUT_S,
+                 breaker_failures=3, breaker_cooldown_s=5.0):
+        self.cache_dir = str(cache_dir) if cache_dir else None
+        self._lock = threading.Lock()
+        self._rid = 0
+        self._stop = False
+        self._outstanding = {}
+        self.stats = {
+            "requests": 0, "forwarded": 0, "replica_retries": 0,
+            "dead_replica_skips": 0, "rejected_deadline": 0,
+            "failed": 0, "ok": 0, "shutdown_resolved": 0,
+            "chaos_replica_kills": 0,
+        }
+        if endpoints is not None:          # attach mode
+            self.replicas = {
+                f"r{i}": Replica(f"r{i}", host, port)
+                for i, (host, port) in enumerate(endpoints)}
+        else:
+            # parallel spawn: replicas share the import/compile-heavy
+            # startup wall-clock instead of paying it N times serially
+            with ThreadPoolExecutor(max_workers=max(1, n_replicas)) as ex:
+                futs = {
+                    f"r{i}": ex.submit(
+                        spawn_replica, f"r{i}", cache_dir=self.cache_dir,
+                        precision=precision, device=device,
+                        window_ms=window_ms, warmup=warmup,
+                        extra_argv=replica_argv,
+                        env_overrides=env_overrides,
+                        ready_timeout_s=ready_timeout_s)
+                    for i in range(n_replicas)}
+                try:
+                    self.replicas = {rid: f.result()
+                                     for rid, f in futs.items()}
+                except Exception:
+                    for f in futs.values():
+                        if f.done() and f.exception() is None:
+                            f.result().proc.kill()
+                    raise
+        self._ring = HashRing(sorted(self.replicas))
+        self._breakers = BreakerBoard(
+            failure_threshold=breaker_failures,
+            cooldown_s=breaker_cooldown_s)
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(8, 4 * len(self.replicas)),
+            thread_name_prefix="router-fwd")
+        logger.info("router up: %d replica(s) %s", len(self.replicas),
+                    {r.id: r.port for r in self.replicas.values()})
+
+    # -- engine-compatible front surface ----------------------------
+
+    def submit(self, design, cases=None, deadline_s=None):
+        t0 = time.perf_counter()
+        with self._lock:
+            if self._stop:
+                raise RuntimeError("router is shut down")
+            self._rid += 1
+            rid = self._rid
+            self.stats["requests"] += 1
+            pend = _Pending(rid)
+            self._outstanding[rid] = pend
+            # deadline admission before any forwarding
+            if deadline_s is not None and deadline_s <= 0:
+                self.stats["rejected_deadline"] += 1
+                self._resolve_locked(rid, pend, wire.result_from_doc({
+                    "rid": rid, "status": "rejected_deadline",
+                    "error": f"deadline_s={deadline_s:.3f} already "
+                             f"expired at router admission"}))
+                return pend
+        self._pool.submit(self._forward, rid, pend, design, cases,
+                          deadline_s, t0)
+        return pend
+
+    def evaluate(self, design, cases=None, deadline_s=None, timeout=None):
+        return self.submit(design, cases=cases,
+                           deadline_s=deadline_s).result(timeout)
+
+    def probe(self):
+        alive = sum(1 for r in self.replicas.values() if not r.dead())
+        stopped = self._stop
+        return {
+            "queue_depth": len(self._outstanding),
+            "in_flight": len(self._outstanding),
+            "shedding": False,
+            "stopped": stopped,
+            "accepting": not stopped and alive > 0,
+            "replicas": len(self.replicas),
+            "replicas_alive": alive,
+            "breakers_open": self._breakers.open_count(),
+            "breaker_states": self._breakers.states(),
+        }
+
+    def snapshot(self):
+        out = dict(self.stats)
+        out["in_flight"] = len(self._outstanding)
+        out["queue_depth"] = len(self._outstanding)
+        out["replicas"] = [r.info() for r in self.replicas.values()]
+        out["breakers"] = self._breakers.snapshot()
+        return out
+
+    def shutdown(self, wait=True, drain=False, timeout=30.0):
+        """Stop admitting, resolve every outstanding handle with a
+        terminal status, then SIGTERM the replicas (each drains its own
+        engine the same way)."""
+        with self._lock:
+            if self._stop:
+                return
+            self._stop = True
+        self._pool.shutdown(wait=wait)
+        with self._lock:
+            leftovers = list(self._outstanding.items())
+            self._outstanding.clear()
+        for rid, pend in leftovers:
+            if pend._set(wire.result_from_doc({
+                    "rid": rid, "status": "shutdown",
+                    "error": "router stopped"})):
+                self.stats["shutdown_resolved"] += 1
+        for rep in self.replicas.values():
+            if rep.proc is not None and rep.proc.poll() is None:
+                rep.proc.send_signal(signal.SIGTERM)
+        deadline = time.monotonic() + timeout
+        for rep in self.replicas.values():
+            if rep.proc is None:
+                continue
+            try:
+                rep.proc.wait(max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                logger.warning("replica %s ignored SIGTERM; killing",
+                               rep.id)
+                rep.proc.kill()
+                rep.proc.wait(5)
+
+    # -- forwarding -------------------------------------------------
+
+    def route(self, design, cases=None):
+        """The replica id a request WOULD land on (tests/bench)."""
+        return self._ring.lookup(routing_key(design, cases))
+
+    def _resolve_locked(self, rid, pend, res):
+        self._outstanding.pop(rid, None)
+        pend._set(res)
+
+    def _resolve(self, rid, pend, res):
+        with self._lock:
+            self._resolve_locked(rid, pend, res)
+
+    def _forward(self, rid, pend, design, cases, deadline_s, t0):
+        key = routing_key(design, cases)
+        order = self._ring.preference(key)
+        inj = get_injector()
+        last_err = None
+        attempted = breaker_skips = 0
+        for replica_id in order:
+            rep = self.replicas[replica_id]
+            elapsed = time.perf_counter() - t0
+            if deadline_s is not None and deadline_s - elapsed <= 0:
+                self.stats["rejected_deadline"] += 1
+                return self._resolve(rid, pend, wire.result_from_doc({
+                    "rid": rid, "status": "rejected_deadline",
+                    "error": f"deadline expired after {elapsed:.3f}s at "
+                             f"router (last: {last_err})"}))
+            if rep.dead():
+                self.stats["dead_replica_skips"] += 1
+                self._breakers.get(replica_id).record_failure(
+                    "replica process dead")
+                last_err = f"{replica_id} dead"
+                continue
+            breaker = self._breakers.get(replica_id)
+            if not breaker.allow():
+                breaker_skips += 1
+                last_err = f"{replica_id} breaker open"
+                continue
+            on_sent = None
+            if inj is not None and inj.should("replica_kill",
+                                              rid) is not None:
+                self.stats["chaos_replica_kills"] += 1
+
+                def on_sent(rep=rep):
+                    logger.warning("chaos replica_kill: SIGKILL %s "
+                                   "(rid=%d in flight)", rep.id, rid)
+                    if rep.proc is not None:
+                        rep.proc.kill()
+                        rep.proc.wait(10)
+            req = {"design": design, "cases": cases, "xi": True}
+            if deadline_s is not None:
+                req["deadline_s"] = deadline_s - elapsed
+            try:
+                self.stats["forwarded"] += 1
+                attempted += 1
+                doc = rep.client.solve(req, on_sent=on_sent)
+            except (ConnectionDropped, TransientError) as e:
+                breaker.record_failure(str(e))
+                self.stats["replica_retries"] += 1
+                last_err = str(e)
+                logger.warning("forward rid=%d to %s failed (%s); "
+                               "retrying on next replica", rid,
+                               replica_id, e)
+                continue
+            if doc.get("status") == "shutdown" and not self._stop:
+                # replica mid-drain: the request was NOT served — treat
+                # as transient and try the next replica
+                breaker.record_failure("replica draining")
+                self.stats["replica_retries"] += 1
+                last_err = f"{replica_id} draining"
+                continue
+            breaker.record_success()
+            rep.served += 1
+            self.stats["ok" if doc.get("status") == "ok"
+                       else "failed"] += 1
+            res = wire.result_from_doc(doc, rid=rid)
+            res.replica = replica_id
+            res.latency_s = time.perf_counter() - t0
+            return self._resolve(rid, pend, res)
+        # a request whose forwards all genuinely failed is "failed"; one
+        # that never got past open breakers is "rejected_circuit"
+        status = ("rejected_circuit"
+                  if not attempted and breaker_skips else "failed")
+        self.stats["failed"] += 1
+        return self._resolve(rid, pend, wire.result_from_doc({
+            "rid": rid, "status": status,
+            "error": f"no replica served the request "
+                     f"(tried {len(order)}; last: {last_err})"}))

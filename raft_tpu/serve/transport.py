@@ -1,0 +1,339 @@
+"""HTTP/1.1 JSON transport over the serve engine — stdlib only.
+
+``serve_http(backend)`` wraps anything with the engine's front surface
+(``submit``/``probe``/``snapshot``/``shutdown`` — both ``Engine`` and
+``router.Router`` qualify) in a threaded ``http.server`` front end:
+
+* ``POST /v1/solve`` — body is a wire request document
+  (serve/wire.py).  The response is chunked NDJSON: an ``accepted``
+  line with the assigned rid as soon as admission control takes the
+  request, then exactly one terminal result line (the engine's
+  exactly-once terminal-status guarantee, PR 5).  The HTTP status is
+  committed at the accepted chunk (200); the terminal status rides in
+  the body.  ``?stream=0`` buffers instead and maps the terminal
+  status to an HTTP code (wire.HTTP_STATUS).
+* ``GET /healthz`` — liveness: 200 whenever the process can answer.
+* ``GET /readyz`` — readiness from ``backend.probe()`` (the cheap
+  lock-free gauge): 503 while draining, stopped, or shedding
+  (queue above high-water), or when every circuit breaker is open.
+* ``GET /statz`` — full ``snapshot()`` as JSON.
+
+Drain (``HttpTransport.drain``) reuses the engine's terminal-status
+guarantee for the SIGTERM story: stop admitting (503), shut the
+backend down — which resolves every in-flight handle with a terminal
+status and thereby unblocks every handler thread mid-wait — then wait
+for the active handlers to flush their terminal chunk before closing
+the listener socket.  Every accepted rid gets its terminal line before
+its socket closes (pinned by the router SIGTERM subprocess test).
+
+Fault injection: the ``conn_drop`` chaos fault (chaos.py) closes the
+client connection after the accepted chunk and before the terminal
+line — the client must surface ``ConnectionDropped`` while the engine
+handle still resolves internally.
+
+No fixed ports anywhere: ``port=0`` binds an OS-assigned port which is
+read back from the listening socket (``HttpTransport.port``); the repo
+lint tests/test_no_fixed_ports.py keeps it that way.
+"""
+
+import http.client
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from raft_tpu.chaos import get_injector
+from raft_tpu.resilience import TransientError
+from raft_tpu.serve import wire
+from raft_tpu.utils.profiling import logger
+
+# Upper bound on one handler's wait for a terminal result.  The engine
+# resolves every handle eventually (terminal-status guarantee), but a
+# handler thread must not hold a socket forever if a solve outlives any
+# sane client; past this the transport emits a terminal "failed" line
+# itself (the late engine resolution is then counted by the engine as a
+# late_resolution, not lost).
+DEFAULT_RESULT_WAIT_S = 600.0
+
+MAX_BODY_BYTES = 64 * 1024 * 1024
+
+
+class ConnectionDropped(TransientError):
+    """The server closed the stream before the terminal result line —
+    retry-eligible (the solve is pure; re-submitting cannot double
+    apply)."""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "raft-tpu-serve"
+
+    def log_message(self, fmt, *args):  # stdout belongs to the CLI lines
+        logger.debug("http: " + fmt % args)
+
+    # -- plumbing ---------------------------------------------------
+
+    @property
+    def transport(self):
+        return self.server.transport
+
+    def _send_json(self, code, doc):
+        payload = (wire.dumps(doc) + "\n").encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
+    def _chunk(self, doc):
+        data = (wire.dumps(doc) + "\n").encode()
+        self.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
+        self.wfile.flush()
+
+    def _end_chunks(self):
+        self.wfile.write(b"0\r\n\r\n")
+        self.wfile.flush()
+
+    # -- routes -----------------------------------------------------
+
+    def do_GET(self):
+        path = self.path.split("?", 1)[0]
+        if path == "/healthz":
+            return self._send_json(200, {"status": "alive",
+                                         "uptime_s": round(
+                                             self.transport.uptime_s, 3)})
+        if path == "/readyz":
+            ready, probe = self.transport.readiness()
+            return self._send_json(200 if ready else 503, probe)
+        if path == "/statz":
+            return self._send_json(200, self.transport.backend.snapshot())
+        return self._send_json(404, {"error": f"no route {path}"})
+
+    def do_POST(self):
+        path, _, query = self.path.partition("?")
+        if path != "/v1/solve":
+            return self._send_json(404, {"error": f"no route {path}"})
+        if self.transport.draining:
+            return self._send_json(503, {"error": "draining"})
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            if length > MAX_BODY_BYTES:
+                return self._send_json(413, {"error": "body too large"})
+            doc = json.loads(self.rfile.read(length))
+            design, cases, deadline_s, want_xi = wire.parse_request(doc)
+            if isinstance(design, str):
+                from raft_tpu.io.schema import load_design
+                design = load_design(design)
+        except wire.WireError as e:
+            return self._send_json(400, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 — bad body, keep serving
+            return self._send_json(
+                400, {"error": f"{type(e).__name__}: {e}"})
+
+        stream = "stream=0" not in query
+        try:
+            handle = self.transport.backend.submit(
+                design, cases=cases, deadline_s=deadline_s)
+        except RuntimeError as e:           # backend already stopped
+            return self._send_json(503, {"error": str(e)})
+
+        self.transport.note_accept(handle.rid)
+        try:
+            if stream:
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                self._chunk({"event": "accepted", "rid": handle.rid})
+            inj = get_injector()
+            if inj is not None and inj.should("conn_drop",
+                                              handle.rid) is not None:
+                # chaos: drop the client mid-stream.  The engine handle
+                # is deliberately left to resolve on its own.
+                logger.warning("chaos conn_drop: closing rid=%d stream",
+                               handle.rid)
+                self.close_connection = True
+                self.connection.close()
+                return
+            doc = self.transport.wait_terminal(handle)
+            if stream:
+                self._chunk(doc)
+                self._end_chunks()
+            else:
+                self._send_json(wire.HTTP_STATUS.get(doc["status"], 500),
+                                doc)
+        except (BrokenPipeError, ConnectionResetError):
+            # client went away mid-wait; the engine still resolves the
+            # handle (terminal-status guarantee is server-side).
+            self.close_connection = True
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+class HttpTransport:
+    """Owns the listener socket + serve thread; see module docstring."""
+
+    def __init__(self, backend, host="127.0.0.1", port=0,
+                 result_wait_s=DEFAULT_RESULT_WAIT_S):
+        self.backend = backend
+        self.result_wait_s = result_wait_s
+        self.draining = False
+        self._t0 = time.monotonic()
+        self._active = 0                  # solve handlers mid-request
+        self._accepted = 0
+        self._lock = threading.Lock()
+        self._idle = threading.Condition(self._lock)
+        self._server = _Server((host, port), _Handler)
+        self._server.transport = self
+        self.host, self.port = self._server.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="raft-http",
+            daemon=True)
+        self._thread.start()
+        logger.info("http transport listening on %s:%d", self.host,
+                    self.port)
+
+    @property
+    def uptime_s(self):
+        return time.monotonic() - self._t0
+
+    def note_accept(self, rid):
+        with self._lock:
+            self._accepted += 1
+
+    def readiness(self):
+        probe = dict(self.backend.probe())
+        probe["draining"] = self.draining
+        probe["accepted"] = self._accepted
+        breakers = probe.get("breaker_states") or {}
+        all_open = bool(breakers) and probe.get("breakers_open", 0) >= len(
+            breakers)
+        ready = (probe.get("accepting", False) and not self.draining
+                 and not all_open)
+        probe["ready"] = ready
+        return ready, probe
+
+    def wait_terminal(self, handle):
+        """Block a handler thread for the terminal result document."""
+        with self._lock:
+            self._active += 1
+        try:
+            try:
+                res = handle.result(timeout=self.result_wait_s)
+            except TimeoutError:
+                return {"event": "result", "rid": handle.rid,
+                        "status": "failed",
+                        "error": f"transport result wait exceeded "
+                                 f"{self.result_wait_s:.0f}s"}
+            return wire.result_doc(res, include_xi=True)
+        finally:
+            with self._idle:
+                self._active -= 1
+                self._idle.notify_all()
+
+    def drain(self, drain_queue=False, timeout=30.0):
+        """Graceful shutdown: refuse new work, resolve ALL in-flight
+        requests to terminal lines, then close the listener."""
+        self.draining = True
+        # Resolves every outstanding handle (terminal-status guarantee),
+        # which unblocks every handler sitting in wait_terminal().
+        self.backend.shutdown(wait=True, drain=drain_queue,
+                              timeout=timeout)
+        deadline = time.monotonic() + timeout
+        with self._idle:
+            while self._active and time.monotonic() < deadline:
+                self._idle.wait(0.1)
+            leftover = self._active
+        if leftover:  # pragma: no cover — handlers always unblock above
+            logger.warning("drain: %d handler(s) still active at close",
+                           leftover)
+        self.close()
+        return {"accepted": self._accepted, "active_at_close": leftover}
+
+    def close(self):
+        self._server.shutdown()
+        self._server.server_close()
+
+
+def serve_http(backend, host="127.0.0.1", port=0, **kw):
+    """Start an HTTP front end on ``backend``; returns the transport
+    (read ``.port`` back — port 0 requests an OS-assigned one)."""
+    return HttpTransport(backend, host=host, port=port, **kw)
+
+
+class WireClient:
+    """Minimal stdlib HTTP client for the wire protocol (used by the
+    router's forwarding tier, the tests and the bench).
+
+    ``solve`` returns the terminal result document; any transport-level
+    failure (refused connection, dropped stream, premature EOF) raises
+    ``ConnectionDropped`` — a TransientError, so the router's retry
+    policy may re-attempt on another replica."""
+
+    def __init__(self, host, port, timeout=DEFAULT_RESULT_WAIT_S):
+        self.host, self.port, self.timeout = host, port, timeout
+
+    def _conn(self, timeout=None):
+        return http.client.HTTPConnection(
+            self.host, self.port,
+            timeout=self.timeout if timeout is None else timeout)
+
+    def get(self, path, timeout=10.0):
+        """GET a JSON endpoint -> (status_code, doc)."""
+        conn = self._conn(timeout)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            return resp.status, json.loads(resp.read())
+        finally:
+            conn.close()
+
+    def solve(self, doc, on_sent=None):
+        """POST a request document, stream the response, return the
+        terminal result document.  ``on_sent`` fires after the request
+        bytes are on the wire (the replica_kill chaos hook point)."""
+        body = wire.dumps(doc).encode()
+        conn = self._conn()
+        try:
+            try:
+                conn.request("POST", "/v1/solve", body=body, headers={
+                    "Content-Type": "application/json"})
+                if on_sent is not None:
+                    on_sent()
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    err = {}
+                    try:
+                        err = json.loads(resp.read())
+                    except (ValueError, OSError,
+                            http.client.HTTPException):
+                        err = {"error": f"HTTP {resp.status} "
+                                        f"(unparseable error body)"}
+                    return {"event": "result", "rid": err.get("rid", -1),
+                            "status": err.get("status", "failed"),
+                            "http_status": resp.status,
+                            "error": err.get("error",
+                                             f"HTTP {resp.status}")}
+                terminal = None
+                while True:
+                    line = resp.readline()
+                    if not line:
+                        break
+                    event = json.loads(line)
+                    if event.get("event") == "result":
+                        terminal = event
+                if terminal is None:
+                    raise ConnectionDropped(
+                        f"stream from {self.host}:{self.port} ended "
+                        f"before a terminal result line")
+                return terminal
+            except (ConnectionError, http.client.HTTPException,
+                    TimeoutError, OSError) as e:
+                raise ConnectionDropped(
+                    f"{self.host}:{self.port}: "
+                    f"{type(e).__name__}: {e}") from e
+        finally:
+            conn.close()

@@ -1,0 +1,169 @@
+"""Wire schema for the served solve — the ONE encoding shared by the
+stdin JSONL loop (``__main__._emit_result``), the HTTP transport
+(serve/transport.py) and the replica router (serve/router.py).
+
+Request document::
+
+    {"design": <design dict | path str>,   # required
+     "cases":  [...],                      # optional case rows
+     "deadline_s": 10.0,                   # optional admission deadline
+     "xi": true}                           # include complex amplitudes
+
+Terminal result document (one per request — the engine's exactly-once
+terminal-status guarantee means every accepted rid produces exactly one
+of these)::
+
+    {"event": "result", "rid": 3, "status": "ok", ...,
+     "std": [[...]], "converged": [...], "nonfinite": [...],
+     "Xi_re": [[[...]]], "Xi_im": [[[...]]], "Xi_dtype": "complex128",
+     "bucket": {"nw": 40, "n_nodes": 80, "n_slots": 8}}
+
+Bit-exactness over the wire: ``json`` serializes Python floats via
+``repr``, which round-trips float64 exactly, and a float32 value is
+exactly representable as a double — so ``Xi_re``/``Xi_im`` lists decode
+to arrays ``np.array_equal`` to the originals in both precisions
+(pinned in tests/test_transport.py).  ``std``/``Xi`` dtypes ride along
+so the decoder rebuilds the exact array dtype the engine produced.
+"""
+
+import json
+
+import numpy as np
+
+from raft_tpu.serve.buckets import BucketSpec
+from raft_tpu.serve.engine import RequestResult
+
+WIRE_VERSION = 1
+
+# HTTP status a terminal result maps to when a response is NOT streamed
+# (streamed responses commit 200 at the accepted chunk; the terminal
+# status then rides inside the body — documented in docs/serving.md).
+HTTP_STATUS = {
+    "ok": 200,
+    "failed": 500,
+    "rejected_deadline": 504,
+    "rejected_overload": 503,
+    "rejected_circuit": 503,
+    "watchdog_timeout": 504,
+    "shutdown": 503,
+}
+
+
+class WireError(ValueError):
+    """A malformed request document (HTTP 400)."""
+
+
+def jsonable(obj):
+    """Recursively convert numpy scalars/arrays so json.dumps accepts
+    the value (used for stats/snapshot endpoints, not results)."""
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, np.generic):
+        return obj.item()
+    if isinstance(obj, dict):
+        return {str(k): jsonable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [jsonable(v) for v in obj]
+    if isinstance(obj, (str, int, float, bool)) or obj is None:
+        return obj
+    return str(obj)
+
+
+def parse_request(doc):
+    """Validate a request document -> (design, cases, deadline_s, xi).
+
+    ``design`` may still be a path string — loading it is the caller's
+    job (the transport loads; the router forwards it verbatim so every
+    replica resolves paths identically)."""
+    if not isinstance(doc, dict):
+        raise WireError("request must be a JSON object")
+    if "design" not in doc:
+        raise WireError("request missing 'design'")
+    design = doc["design"]
+    if not isinstance(design, (dict, str)):
+        raise WireError("'design' must be a design dict or a path string")
+    cases = doc.get("cases")
+    if cases is not None and not isinstance(cases, list):
+        raise WireError("'cases' must be a list of case rows")
+    deadline_s = doc.get("deadline_s")
+    if deadline_s is not None:
+        try:
+            deadline_s = float(deadline_s)
+        except (TypeError, ValueError):
+            raise WireError("'deadline_s' must be a number") from None
+    return design, cases, deadline_s, bool(doc.get("xi", False))
+
+
+def result_doc(res, include_xi=False):
+    """RequestResult -> terminal result document (a superset of the
+    legacy stdin-loop line, so existing consumers keep working)."""
+    doc = {
+        "event": "result", "rid": res.rid, "status": res.status,
+        "latency_s": round(res.latency_s, 4),
+        "batch_requests": res.batch_requests,
+        "batch_occupancy": round(res.batch_occupancy, 3),
+    }
+    if res.error:
+        doc["error"] = res.error
+    if res.backend:
+        doc["backend"] = res.backend
+    if res.bucket is not None:
+        doc["bucket"] = res.bucket.as_dict()
+    if res.replica is not None:
+        doc["replica"] = res.replica
+    if res.status == "ok":
+        std = np.asarray(res.std)
+        doc["std"] = std.tolist()
+        doc["std_dtype"] = str(std.dtype)
+        rep = res.solve_report or {}
+        for key in ("converged", "nonfinite"):
+            if key in rep:
+                doc[key] = np.asarray(rep[key]).tolist()
+        if include_xi and res.Xi is not None:
+            doc["Xi_re"] = res.Xi.real.tolist()
+            doc["Xi_im"] = res.Xi.imag.tolist()
+            doc["Xi_dtype"] = str(res.Xi.dtype)
+    return doc
+
+
+def result_from_doc(doc, rid=None):
+    """Terminal result document -> RequestResult with the arrays rebuilt
+    bit-identically (see module docstring)."""
+    Xi = None
+    if "Xi_re" in doc:
+        cdt = np.dtype(doc.get("Xi_dtype", "complex128"))
+        fdt = np.float32 if cdt == np.complex64 else np.float64
+        re = np.asarray(doc["Xi_re"], dtype=fdt)
+        Xi = np.empty(re.shape, dtype=cdt)
+        Xi.real = re
+        Xi.imag = np.asarray(doc["Xi_im"], dtype=fdt)
+    std = None
+    if "std" in doc:
+        std = np.asarray(doc["std"],
+                         dtype=np.dtype(doc.get("std_dtype", "float64")))
+    report = {k: np.asarray(doc[k]) for k in ("converged", "nonfinite")
+              if k in doc}
+    bucket = BucketSpec(**doc["bucket"]) if doc.get("bucket") else None
+    return RequestResult(
+        rid=doc["rid"] if rid is None else rid,
+        status=doc["status"],
+        error=doc.get("error"),
+        Xi=Xi, std=std,
+        solve_report=report or None,
+        bucket=bucket,
+        latency_s=float(doc.get("latency_s", 0.0)),
+        batch_requests=int(doc.get("batch_requests", 0)),
+        batch_occupancy=float(doc.get("batch_occupancy", 0.0)),
+        backend=doc.get("backend"),
+        replica=doc.get("replica"),
+    )
+
+
+def dumps(doc):
+    """One wire line (no trailing newline).  Results built by
+    ``result_doc`` are already plain JSON types; anything else (stats,
+    snapshots) goes through ``jsonable``."""
+    try:
+        return json.dumps(doc)
+    except TypeError:
+        return json.dumps(jsonable(doc))

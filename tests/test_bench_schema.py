@@ -138,6 +138,41 @@ def test_committed_multichip_artifacts_are_sanitized():
             assert isinstance(doc["n_devices"], int), name
 
 
+def test_serve_http_section_pinned_in_compact_schema():
+    """The network-transport bench section (PR 10) stays wired: both
+    entry points exist and the headline keys ride the compact driver
+    line (keys dropped from _COMPACT_KEYS silently vanish from the
+    recorded round — the r03/r04 failure mode)."""
+    assert callable(bench.bench_serve_http)
+    assert callable(bench.bench_serve_http_smoke)
+    for key in ("serve_http_p50_s", "serve_http_p95_s",
+                "serve_http_inproc_p50_s", "serve_http_overhead_ms",
+                "serve_http_2rep_speedup", "smoke_http_overhead_ms",
+                "smoke_http_bits", "serve_http_error",
+                "serve_http_smoke_error"):
+        assert key in bench._COMPACT_KEYS, key
+
+
+def test_sanitizer_covers_serve_http_values():
+    out = {
+        "serve_http_overhead_ms": 1.66,
+        "serve_http_replica_spread": {"r0": 4, "r1": 4},
+        "smoke_http_bits": "identical",
+        "serve_http_error": "TimeoutError: replica r1 not ready in 300s",
+        # a section that leaks a caught exception under a METRIC key
+        # must have it moved aside on flush
+        "serve_http_2rep_speedup":
+            "ConnectionRefusedError: [Errno 111] Connection refused",
+    }
+    bench._sanitize_schema(out)
+    assert out["serve_http_overhead_ms"] == 1.66
+    assert out["smoke_http_bits"] == "identical"
+    assert out["serve_http_error"].startswith("TimeoutError")
+    assert "serve_http_2rep_speedup" not in out
+    assert out["serve_http_2rep_speedup_error"].startswith(
+        "ConnectionRefusedError")
+
+
 def test_committed_bench_artifacts_respect_schema():
     """Every committed bench artifact (BENCH_FULL.json and the recorded
     BENCH_r*.json tails) carries exception strings only under *_error

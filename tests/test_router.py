@@ -1,0 +1,335 @@
+"""Replica router (raft_tpu/serve/router.py): scale-out contracts.
+
+The acceptance criteria of the scale-out tier, end to end over real
+subprocess replicas:
+
+* placement — ``routing_key`` is a pure function of the
+  physics/bucket-determining design subset (stable across processes,
+  blind to ballast knobs), and the consistent-hash ring moves only the
+  keys a new replica claims;
+* over-the-wire equality — an HTTP request through a 2-replica router
+  returns results ``np.array_equal``-identical to the direct
+  ``Model.analyze_cases`` dispatch, including under an injected
+  ``replica_kill`` (the in-flight request retries on the surviving
+  replica);
+* warm one, warm all — a freshly spawned replica's first request hits
+  the prep-npz manifest an earlier replica wrote into the shared cache
+  directory;
+* SIGTERM drain — every request id accepted by the router CLI gets a
+  terminal status line before its socket closes, and the router exits 0.
+
+All servers bind port 0 and read the assigned port back
+(tests/test_no_fixed_ports.py keeps it that way).
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu.designs import deep_spar
+from raft_tpu.model import Model
+from raft_tpu.serve import (
+    HashRing,
+    Router,
+    WireClient,
+    routing_key,
+    serve_http,
+    spawn_replica,
+    wire,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NW = (0.05, 0.5)    # small frequency grid keeps compiles cheap
+
+
+def _spar(rho_fill=1800.0):
+    d = deep_spar(n_cases=2, nw_settings=NW)
+    d["platform"]["members"][0]["rho_fill"] = [float(rho_fill), 0.0, 0.0]
+    return d
+
+
+def _wait_for(pred, timeout, what):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+# ----------------------------------------------------------- unit: ring
+
+def test_hash_ring_lookup_is_stable():
+    a = HashRing(["r0", "r1", "r2"])
+    b = HashRing(["r0", "r1", "r2"])
+    keys = [f"key{i}" for i in range(200)]
+    assert [a.lookup(k) for k in keys] == [b.lookup(k) for k in keys]
+    # every replica owns a share
+    owners = {a.lookup(k) for k in keys}
+    assert owners == {"r0", "r1", "r2"}
+
+
+def test_hash_ring_growth_only_moves_keys_to_the_new_replica():
+    r2 = HashRing(["r0", "r1"])
+    r3 = HashRing(["r0", "r1", "r2"])
+    keys = [f"key{i}" for i in range(500)]
+    moved = 0
+    for k in keys:
+        before, after = r2.lookup(k), r3.lookup(k)
+        if after != before:
+            moved += 1
+            assert after == "r2", (
+                f"{k} moved {before}->{after}, not to the new replica")
+    # roughly 1/3 of keys relocate; a full reshuffle would be ~all
+    assert 0 < moved < len(keys) // 2
+
+
+def test_hash_ring_preference_is_primary_then_failovers():
+    ring = HashRing(["r0", "r1", "r2"])
+    for k in ("a", "b", "c", "d"):
+        pref = ring.preference(k)
+        assert pref[0] == ring.lookup(k)
+        assert sorted(pref) == ["r0", "r1", "r2"]
+
+
+# ---------------------------------------------------- unit: routing key
+
+def test_routing_key_ignores_ballast_but_not_physics():
+    base = _spar(1800.0)
+    ballast = _spar(1700.0)
+    assert routing_key(base) == routing_key(ballast)
+    # fill level is a ballast knob too
+    filled = _spar(1800.0)
+    filled["platform"]["members"][0]["l_fill"] = [30.0]
+    assert routing_key(base) == routing_key(filled)
+    # the frequency grid IS physics/bucket identity
+    wide = deep_spar(n_cases=2, nw_settings=(0.05, 0.8))
+    assert routing_key(base) != routing_key(wide)
+    # so is member geometry
+    fat = _spar(1800.0)
+    mem = fat["platform"]["members"][0]
+    mem["d"] = [float(v) + 1.0 for v in mem["d"]]
+    assert routing_key(base) != routing_key(fat)
+    # and the case count (slot-bucket axis)
+    assert routing_key(base, cases=[{}] * 7) != routing_key(base)
+
+
+def test_routing_key_stable_across_processes():
+    """Same design -> same key in a fresh interpreter (the property
+    that lets any router instance place requests identically)."""
+    key_here = routing_key(_spar())
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "from raft_tpu.designs import deep_spar\n"
+         "from raft_tpu.serve import routing_key\n"
+         "d = deep_spar(n_cases=2, nw_settings=(0.05, 0.5))\n"
+         "d['platform']['members'][0]['rho_fill'] = [1800.0, 0.0, 0.0]\n"
+         "print(routing_key(d))"],
+        capture_output=True, text=True, timeout=120, env=env, cwd=ROOT)
+    assert out.returncode == 0, out.stderr[-800:]
+    assert out.stdout.strip().splitlines()[-1] == key_here
+
+
+# --------------------------------------- unit: admission + dead replica
+
+def test_router_deadline_admission_and_dead_endpoint():
+    # a port that was just free: bind 0, read it back, close — nothing
+    # listens there (no fixed literals, per the port lint)
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_port = s.getsockname()[1]
+    s.close()
+    router = Router(endpoints=[("127.0.0.1", dead_port)],
+                    breaker_failures=3, breaker_cooldown_s=60.0)
+    try:
+        # deadline admission: never crosses the wire
+        res = router.evaluate(_spar(), deadline_s=0.0, timeout=10)
+        assert res.status == "rejected_deadline"
+        assert router.stats["forwarded"] == 0
+        # unreachable replica: transient failures, then the breaker opens
+        for _ in range(3):
+            res = router.evaluate(_spar(), timeout=30)
+            assert res.status == "failed"
+        assert router.probe()["breakers_open"] == 1
+        res = router.evaluate(_spar(), timeout=30)
+        assert res.status == "rejected_circuit"
+    finally:
+        router.shutdown()
+
+
+# ------------------------------------------------- e2e: real replicas
+
+@pytest.fixture(scope="module")
+def shared_cache(tmp_path_factory):
+    return str(tmp_path_factory.mktemp("router_shared_cache"))
+
+
+@pytest.fixture(scope="module")
+def router2(shared_cache):
+    """One 2-replica router shared by the module — the replicas compile
+    the NW bucket once into the shared cache; every later test (and the
+    spawned third replica) starts warm from it."""
+    router = Router(n_replicas=2, cache_dir=shared_cache,
+                    precision="float64", window_ms=20.0)
+    yield router
+    router.shutdown()
+
+
+def test_http_to_2replica_router_matches_direct_dispatch(router2):
+    transport = serve_http(router2)
+    try:
+        client = WireClient("127.0.0.1", transport.port)
+        doc = client.solve({"design": _spar(), "xi": True})
+        assert doc["status"] == "ok", doc.get("error")
+        assert doc["replica"] in router2.replicas
+        res = wire.result_from_doc(doc)
+        m = Model(_spar(), precision="float64", slots=res.bucket)
+        m.analyze_unloaded()
+        m.analyze_cases(display=0)
+        assert np.array_equal(res.Xi, m.Xi)
+        code, probe = client.get("/readyz")
+        assert code == 200 and probe["replicas_alive"] == 2
+    finally:
+        transport.close()      # close the front end, keep the router
+
+
+def test_same_physics_routes_to_same_replica(router2):
+    expected = router2.route(_spar())
+    # ballast variants of one hull share the hot replica
+    for rho in (1650.0, 1750.0, 1850.0):
+        res = router2.evaluate(_spar(rho), timeout=400)
+        assert res.status == "ok", res.error
+        assert res.replica == expected
+
+
+def test_replica_kill_retries_on_other_replica_bit_identically(
+        router2, monkeypatch):
+    d = _spar()
+    first = router2.evaluate(d, timeout=400)
+    assert first.status == "ok", first.error
+    kills_before = router2.stats["chaos_replica_kills"]
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "replica_kill*1:7")
+    retried = router2.evaluate(d, timeout=400)
+    monkeypatch.delenv("RAFT_TPU_CHAOS")
+    assert retried.status == "ok", retried.error
+    assert router2.stats["chaos_replica_kills"] == kills_before + 1
+    assert router2.stats["replica_retries"] >= 1
+    # served by the OTHER replica, bit-identical to the first answer
+    assert retried.replica != first.replica
+    assert np.array_equal(retried.Xi, first.Xi)
+    assert router2.probe()["replicas_alive"] == 1
+
+
+def test_warm_one_warm_all_via_shared_cache(router2, shared_cache):
+    """A fresh replica process on the shared cache dir answers its
+    first request from the prep manifest + persistent XLA cache the
+    module's replicas already wrote (subprocess acceptance test of the
+    cache-sharing layout)."""
+    manifest = os.path.join(shared_cache, "serve",
+                            "serve_manifest.json")
+    assert os.path.exists(manifest), "module replicas wrote no manifest"
+    d = _spar()    # the design family the module fixture already served
+    t0 = time.monotonic()
+    rep = spawn_replica("fresh", cache_dir=shared_cache,
+                        precision="float64", window_ms=20.0)
+    try:
+        doc = rep.client.solve({"design": d, "xi": True})
+        first_request_s = time.monotonic() - t0
+        assert doc["status"] == "ok", doc.get("error")
+        code, snap = rep.client.get("/statz")
+        assert code == 200
+        # the first request hit the on-disk prep entry replica 1 wrote
+        assert snap["prep_cache_hits"] >= 1, snap
+        # and the warmed executables: no interactive compile marathon
+        assert first_request_s < 120.0
+    finally:
+        rep.proc.send_signal(signal.SIGTERM)
+        rep.proc.wait(30)
+
+
+def test_router_sigterm_terminal_status_for_every_accepted_rid(
+        shared_cache):
+    """SIGTERM the router CLI mid-flight: 100% of accepted request ids
+    get a terminal result line before their sockets close, and the
+    router exits 0 after draining its replica."""
+    import http.client
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    env["RAFT_TPU_CACHE_DIR"] = shared_cache
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "raft_tpu", "serve", "--http", "0",
+         "--replicas", "1", "--precision", "float64",
+         "--cache-dir", shared_cache],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=env, cwd=ROOT)
+    lines = []
+    threading.Thread(
+        target=lambda: [lines.append(ln) for ln in proc.stdout],
+        daemon=True).start()
+    try:
+        _wait_for(lambda: any('"ready"' in ln for ln in lines), 240,
+                  "router ready line")
+        port = json.loads(
+            next(ln for ln in lines if '"ready"' in ln))["port"]
+        assert port != 0
+
+        body = json.dumps({"design": _spar()}).encode()
+        accepted, results = {}, {}
+
+        def _solve(i):
+            conn = http.client.HTTPConnection("127.0.0.1", port,
+                                              timeout=400)
+            try:
+                conn.request("POST", "/v1/solve", body=body, headers={
+                    "Content-Type": "application/json"})
+                resp = conn.getresponse()
+                while True:
+                    ln = resp.readline()
+                    if not ln:
+                        break
+                    ev = json.loads(ln)
+                    if ev.get("event") == "accepted":
+                        accepted[i] = ev["rid"]
+                    elif ev.get("event") == "result":
+                        results[i] = ev
+            finally:
+                conn.close()
+
+        threads = [threading.Thread(target=_solve, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        _wait_for(lambda: len(accepted) == 3, 120, "3 accepted chunks")
+        proc.send_signal(signal.SIGTERM)
+        for t in threads:
+            t.join(timeout=400)
+        assert not any(t.is_alive() for t in threads)
+    finally:
+        try:
+            proc.wait(180)     # graceful: drain + replica shutdown
+        except subprocess.TimeoutExpired:
+            proc.kill()
+    assert proc.wait(60) == 0
+    # 100% terminal coverage: every accepted rid got a result line
+    from raft_tpu.serve import TERMINAL_STATUSES
+    assert set(results) == set(accepted)
+    got_rids = {results[i]["rid"] for i in results}
+    assert got_rids == set(accepted.values())
+    for ev in results.values():
+        assert ev["status"] in TERMINAL_STATUSES
+    shutdown = [ln for ln in lines if '"shutdown"' in ln]
+    assert shutdown and json.loads(shutdown[0])["signal"] == 15

@@ -1,0 +1,260 @@
+"""HTTP transport (raft_tpu/serve/transport.py): the wire contract.
+
+Pins the subsystem acceptance criteria at the single-process tier: the
+terminal result decoded off the wire is ``np.array_equal``-identical
+to the in-process engine result AND to the direct
+``Model.analyze_cases`` dispatch under the same bucket; ``/healthz`` /
+``/readyz`` report the engine probe gauge; admission failures map to
+the documented status codes; the ``conn_drop`` chaos fault drops the
+client stream without leaking the engine handle; and drain resolves
+every in-flight request to a terminal line.
+
+Every server here binds port 0 and reads the assigned port back
+(tests/test_no_fixed_ports.py keeps it that way).
+"""
+
+import http.client
+import json
+
+import numpy as np
+import pytest
+
+from raft_tpu.designs import deep_spar
+from raft_tpu.model import Model
+from raft_tpu.serve import (
+    ConnectionDropped,
+    Engine,
+    EngineConfig,
+    WireClient,
+    serve_http,
+    wire,
+)
+
+NW = (0.05, 0.5)    # small frequency grid keeps compiles cheap
+
+
+def _spar(rho_fill=1800.0):
+    d = deep_spar(n_cases=2, nw_settings=NW)
+    d["platform"]["members"][0]["rho_fill"] = [float(rho_fill), 0.0, 0.0]
+    return d
+
+
+@pytest.fixture(scope="module")
+def served_http(tmp_path_factory):
+    """One engine + HTTP front end shared by the module (compiles once)."""
+    eng = Engine(EngineConfig(
+        precision="float64", window_ms=20.0,
+        cache_dir=str(tmp_path_factory.mktemp("serve_http"))))
+    transport = serve_http(eng)
+    client = WireClient("127.0.0.1", transport.port)
+    yield eng, transport, client
+    transport.close()
+    eng.shutdown()
+
+
+# ------------------------------------------------------------ wire schema
+
+def test_wire_result_roundtrip_is_bit_exact():
+    from raft_tpu.serve.buckets import BucketSpec
+    from raft_tpu.serve.engine import RequestResult
+
+    rng = np.random.default_rng(7)
+    for cdt in (np.complex128, np.complex64):
+        Xi = (rng.standard_normal((2, 6, 5))
+              + 1j * rng.standard_normal((2, 6, 5))).astype(cdt)
+        std = np.abs(Xi[:, :, 0]).astype(Xi.real.dtype)
+        res = RequestResult(
+            rid=3, status="ok", Xi=Xi, std=std,
+            solve_report={"converged": np.array([True, False]),
+                          "nonfinite": np.array([0, 1])},
+            bucket=BucketSpec(5, 16, 4), latency_s=0.25,
+            batch_requests=2, batch_occupancy=0.5, backend="cpu")
+        # through an actual JSON string, as over the socket
+        doc = json.loads(json.dumps(wire.result_doc(res, include_xi=True)))
+        back = wire.result_from_doc(doc)
+        assert back.Xi.dtype == Xi.dtype
+        assert np.array_equal(back.Xi, Xi)
+        assert np.array_equal(back.std, std)
+        assert back.bucket == res.bucket
+        assert np.array_equal(back.solve_report["converged"],
+                              [True, False])
+
+
+def test_parse_request_validation():
+    with pytest.raises(wire.WireError, match="missing 'design'"):
+        wire.parse_request({})
+    with pytest.raises(wire.WireError, match="JSON object"):
+        wire.parse_request([1, 2])
+    with pytest.raises(wire.WireError, match="deadline_s"):
+        wire.parse_request({"design": {}, "deadline_s": "soon"})
+    design, cases, deadline, xi = wire.parse_request(
+        {"design": {"a": 1}, "deadline_s": 5, "xi": True})
+    assert deadline == 5.0 and xi and cases is None
+
+
+# ------------------------------------------------------------- endpoints
+
+def test_port_zero_binds_and_reads_back(served_http):
+    _, transport, _ = served_http
+    assert transport.port != 0
+
+
+def test_healthz_readyz_statz(served_http):
+    eng, _, client = served_http
+    code, doc = client.get("/healthz")
+    assert code == 200 and doc["status"] == "alive"
+    code, doc = client.get("/readyz")
+    assert code == 200 and doc["ready"]
+    # the probe gauge rides in the readiness body
+    for key in ("queue_depth", "in_flight", "shedding", "accepting",
+                "breakers_open", "breaker_states", "draining"):
+        assert key in doc
+    code, doc = client.get("/statz")
+    assert code == 200 and doc["requests"] == eng.snapshot()["requests"]
+    code, doc = client.get("/nope")
+    assert code == 404
+
+
+def test_engine_probe_gauge_matches_snapshot(served_http):
+    eng, _, _ = served_http
+    probe = eng.probe()
+    snap = eng.snapshot()
+    assert probe["queue_depth"] == snap["queue_depth"]
+    assert probe["in_flight"] == snap["in_flight"]
+    assert probe["accepting"] and not probe["stopped"]
+    assert probe["max_queue"] == eng.config.max_queue
+    assert isinstance(probe["breaker_states"], dict)
+
+
+# ------------------------------------------------- solve over the wire
+
+def test_wire_solve_identical_to_inprocess_and_direct(served_http):
+    eng, _, client = served_http
+    d = _spar()
+    doc = client.solve({"design": d, "xi": True})
+    assert doc["status"] == "ok", doc.get("error")
+    res = wire.result_from_doc(doc)
+    # vs the in-process engine path
+    direct = eng.evaluate(d, timeout=400)
+    assert direct.status == "ok"
+    assert np.array_equal(res.Xi, direct.Xi)
+    assert np.array_equal(res.std, direct.std)
+    # vs the unbatched Model dispatch under the served bucket
+    m = Model(d, precision="float64", slots=res.bucket)
+    m.analyze_unloaded()
+    m.analyze_cases(display=0)
+    assert np.array_equal(res.Xi, m.Xi)
+
+
+def test_wire_streaming_accepted_then_terminal(served_http):
+    _, transport, _ = served_http
+    d = _spar()
+    conn = http.client.HTTPConnection("127.0.0.1", transport.port,
+                                      timeout=300)
+    try:
+        conn.request("POST", "/v1/solve",
+                     body=json.dumps({"design": d}).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 200
+        events = []
+        while True:
+            line = resp.readline()
+            if not line:
+                break
+            events.append(json.loads(line))
+    finally:
+        conn.close()
+    assert [e["event"] for e in events] == ["accepted", "result"]
+    assert events[0]["rid"] == events[1]["rid"]
+    assert events[1]["status"] == "ok"
+
+
+def test_wire_deadline_rejection(served_http):
+    _, _, client = served_http
+    doc = client.solve({"design": _spar(), "deadline_s": -1.0})
+    assert doc["status"] == "rejected_deadline"
+
+
+def test_wire_malformed_request_is_400_and_survivable(served_http):
+    _, transport, client = served_http
+    conn = http.client.HTTPConnection("127.0.0.1", transport.port,
+                                      timeout=30)
+    try:
+        conn.request("POST", "/v1/solve", body=b"{not json",
+                     headers={"Content-Type": "application/json"})
+        assert conn.getresponse().status == 400
+    finally:
+        conn.close()
+    code, doc = client.get("/readyz")     # server unbothered
+    assert code == 200 and doc["ready"]
+
+
+def test_wire_missing_design_is_400(served_http):
+    _, transport, _ = served_http
+    conn = http.client.HTTPConnection("127.0.0.1", transport.port,
+                                      timeout=30)
+    try:
+        conn.request("POST", "/v1/solve",
+                     body=json.dumps({"cases": []}).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 400
+        assert "design" in json.loads(resp.read())["error"]
+    finally:
+        conn.close()
+
+
+# ------------------------------------------------------------ conn_drop
+
+def test_conn_drop_chaos_drops_stream_not_engine(served_http,
+                                                 monkeypatch):
+    eng, _, client = served_http
+    requests_before = eng.snapshot()["requests"]
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "conn_drop*1:13")
+    with pytest.raises(ConnectionDropped):
+        client.solve({"design": _spar()})
+    monkeypatch.delenv("RAFT_TPU_CHAOS")
+    # the engine accepted the request and resolved its handle
+    # internally (terminal-status guarantee is server-side)
+    snap = eng.snapshot()
+    assert snap["requests"] == requests_before + 1
+    # and the server keeps serving afterwards
+    doc = client.solve({"design": _spar()})
+    assert doc["status"] == "ok"
+    assert eng.snapshot()["outstanding"] == 0
+
+
+# ---------------------------------------------------------------- drain
+
+def test_drain_resolves_inflight_to_terminal_lines(tmp_path):
+    """A separate engine (the module fixture must survive): requests
+    in flight at drain time still get their terminal result line."""
+    import threading
+
+    eng = Engine(EngineConfig(precision="float64", window_ms=200.0,
+                              cache_dir=str(tmp_path)))
+    transport = serve_http(eng)
+    client = WireClient("127.0.0.1", transport.port)
+    docs = []
+    t = threading.Thread(
+        target=lambda: docs.append(client.solve({"design": _spar()})))
+    t.start()
+    # wait until the request is inside the engine, then drain
+    import time
+    t0 = time.monotonic()
+    while eng.probe()["in_flight"] == 0 and time.monotonic() - t0 < 30:
+        time.sleep(0.01)
+    report = transport.drain(drain_queue=True, timeout=400)
+    t.join(timeout=60)
+    assert not t.is_alive()
+    assert len(docs) == 1
+    from raft_tpu.serve import TERMINAL_STATUSES
+    assert docs[0]["status"] in TERMINAL_STATUSES
+    assert report["active_at_close"] == 0
+    code = None
+    try:
+        client.get("/healthz", timeout=5)
+    except Exception as e:  # noqa: BLE001 — any refusal proves closed
+        code = type(e).__name__
+    assert code is not None
