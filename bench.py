@@ -73,7 +73,8 @@ _COMPACT_KEYS = (
     "sweep_vs_baseline", "sweep_rao_linf_err", "sweep_converged_frac",
     "sweep_iters_p50", "sweep_wasted_lane_iters_frac",
     "waterfall_vs_legacy", "waterfall_bit_identical",
-    "waterfall_wasted_lane_iters_frac_legacy",
+    # (the legacy-vs-waterfall wasted-fraction pair stays in
+    # BENCH_FULL.json + PERF.md; dropped from the line for length)
     "waterfall_wasted_lane_iters_frac",
     "sweep_rotor_stage_s", "sweep_overlap_saved_s",
     "sweep_overlap_cross_backend_s", "sweep_host_devices",
@@ -96,6 +97,10 @@ _COMPACT_KEYS = (
     "serve_http_p50_s", "serve_http_p95_s", "serve_http_inproc_p50_s",
     "serve_http_overhead_ms", "serve_http_2rep_speedup",
     "smoke_http_overhead_ms", "smoke_http_bits",
+    "sweep_fixed_point_mode",
+    "serve_sweep_engine_vs_direct", "serve_sweep_p95_ratio_off",
+    "serve_sweep_p95_ratio_on", "serve_sweep_preemptions",
+    "serve_sweep_bits_identical", "smoke_sweep_bits",
     "kernel_backend_mode", "kernel_gj6_speedup",
     "kernel_gj6_max_abs_diff", "kernel_gjstage_speedup",
     "kernel_gjstage_max_abs_diff",
@@ -104,6 +109,7 @@ _COMPACT_KEYS = (
     "bem_sharded_error", "grad_error", "serve_error",
     "chaos_smoke_error", "kernel_error", "sweep_warm_error",
     "serve_http_error", "serve_http_smoke_error",
+    "serve_sweep_error", "serve_sweep_smoke_error",
     "sweep_waterfall_error",
     "perf_docs_error", "sweep_scaling_error", "sweep1024_error",
     "sweep4096_error", "serve_multichip_error", "multichip_smoke_error",
@@ -379,6 +385,7 @@ def main(argv=None):
         sections = [("smoke", bench_smoke),
                     ("serve_smoke", bench_serve_smoke),
                     ("serve_http_smoke", bench_serve_http_smoke),
+                    ("serve_sweep_smoke", bench_serve_sweep_smoke),
                     ("chaos_smoke", bench_chaos_smoke),
                     ("multichip_smoke", bench_multichip_smoke),
                     ("kernel", lambda: bench_kernels(
@@ -397,8 +404,7 @@ def main(argv=None):
         cpu_round = jax.default_backend() == "cpu"
         run_scaling = (
             (lambda: {"sweep_scaling_error":
-                      "skipped: 1024/4096-design scaling is a TPU-scale "
-                      "figure (CPU round)"})
+                      "skipped: TPU-scale figure (CPU round)"})
             if cpu_round
             else (lambda: bench_sweep.run_scaling(verbose=False)))
 
@@ -439,6 +445,7 @@ def main(argv=None):
             ("grad", bench_gradients, 0.5),
             ("serve", bench_serve, 5.0),
             ("serve_http", bench_serve_http, 6.0),
+            ("serve_sweep", bench_serve_sweep, 8.0),
             ("serve_multichip", bench_serve_multichip, 0.5),
             ("kernel", bench_kernels, 0.5),
             ("sweep_warm", bench_sweep_warm, 4.0),
@@ -627,7 +634,7 @@ def bench_rao():
             round(rao_flops / t_per_solve / 1e9, 2) if rao_flops else 0.0
         ),
         "rao_mfu_vs_bf16_peak": (
-            round(rao_flops / t_per_solve / PEAK_FLOPS_BF16, 6)
+            rao_flops / t_per_solve / PEAK_FLOPS_BF16
             if rao_flops else 0.0
         ),
         "baseline_numpy_s": round(t_np, 3),
@@ -1114,6 +1121,189 @@ def bench_serve_http_smoke():
     }
 
 
+def _serve_sweep_designs(n_designs):
+    """One ballast family (identical physics key, varying rho_fill): the
+    sweep shape the router's ballast-excluding routing_key keeps on one
+    replica's hot executables."""
+    import copy
+
+    from raft_tpu.designs import deep_spar
+
+    base = deep_spar(n_cases=2, nw_settings=(0.05, 0.5))
+    points = [{"rho": float(r)}
+              for r in np.linspace(800.0, 1900.0, n_designs)]
+
+    def apply_point(d, p):
+        d["platform"]["members"][0]["rho_fill"] = [p["rho"], 0.0, 0.0]
+        return d
+
+    designs = [apply_point(copy.deepcopy(base), p) for p in points]
+    return base, points, apply_point, designs
+
+
+def bench_serve_sweep(n_designs=256, n_probe=12, max_probes=200):
+    """Continuous lane-level batching figures (docs/serving.md "Sweep
+    requests & priority preemption"): sweeps as first-class served
+    requests.  Records (a) the sweep-THROUGH-the-engine wall vs the
+    direct ``run_sweep`` driver on the same ballast family (acceptance:
+    within 1.15x), and (b) interactive request p50/p95 under a
+    concurrent sweep with preemption OFF vs ON against the unloaded
+    baseline (acceptance: preempt-on loaded p95 within 3x unloaded p95)
+    — plus the bit-identity of the preempted-and-resumed sweep against
+    the uninterrupted one."""
+    import tempfile
+
+    from raft_tpu.serve import Engine, EngineConfig
+    from raft_tpu.sweep import run_sweep
+
+    base, points, apply_point, designs = _serve_sweep_designs(n_designs)
+
+    # direct driver under the same fixed-point family the engine
+    # dispatches (waterfall); first run compiles, hot second run timed
+    pinned = os.environ.get("RAFT_TPU_FIXED_POINT")
+    os.environ["RAFT_TPU_FIXED_POINT"] = "waterfall"
+    try:
+        run_sweep(base, points, apply_point, verbose=False)
+        t0 = time.perf_counter()
+        run_sweep(base, points, apply_point, verbose=False)
+        t_direct = time.perf_counter() - t0
+    finally:
+        if pinned is None:
+            os.environ.pop("RAFT_TPU_FIXED_POINT", None)
+        else:
+            os.environ["RAFT_TPU_FIXED_POINT"] = pinned
+
+    def _loaded_phase(eng):
+        """Interactive probes stream while the sweep runs; latencies are
+        loaded-engine figures by construction."""
+        h = eng.submit_sweep(designs)
+        lats = []
+        while not h.done() and len(lats) < max_probes:
+            t0 = time.perf_counter()
+            r = eng.evaluate(base, timeout=560)
+            assert r.status == "ok", r.error
+            lats.append(time.perf_counter() - t0)
+        res = h.result(560)
+        assert res.status == "ok", res.error
+        return res, np.asarray(lats if lats else [0.0])
+
+    with tempfile.TemporaryDirectory() as tmp:
+        # ---- preemption OFF --------------------------------------
+        eng = Engine(EngineConfig(window_ms=10.0, cache_dir=tmp))
+        try:
+            warm = eng.evaluate(base, timeout=560)
+            assert warm.status == "ok", warm.error
+            unloaded = []
+            for _ in range(n_probe):
+                t0 = time.perf_counter()
+                r = eng.evaluate(base, timeout=560)
+                assert r.status == "ok", r.error
+                unloaded.append(time.perf_counter() - t0)
+            first = eng.submit_sweep(designs).result(560)  # compiles
+            assert first.status == "ok", first.error
+            t0 = time.perf_counter()
+            res_ref = eng.submit_sweep(designs).result(560)  # hot wall
+            t_engine = time.perf_counter() - t0
+            assert res_ref.status == "ok", res_ref.error
+            res_off, lat_off = _loaded_phase(eng)
+        finally:
+            eng.shutdown()
+        # ---- preemption ON ---------------------------------------
+        eng = Engine(EngineConfig(window_ms=10.0, cache_dir=tmp,
+                                  preempt=True))
+        try:
+            warm = eng.evaluate(base, timeout=560)
+            assert warm.status == "ok", warm.error
+            pre = eng.submit_sweep(designs).result(560)  # re-warm rungs
+            assert pre.status == "ok", pre.error
+            res_on, lat_on = _loaded_phase(eng)
+        finally:
+            eng.shutdown()
+
+    bits = (np.array_equal(res_on.Xi_r, res_ref.Xi_r)
+            and np.array_equal(res_on.Xi_i, res_ref.Xi_i)
+            and all(np.array_equal(res_on.report[k], res_ref.report[k])
+                    for k in res_ref.report))
+    un_p95 = float(np.percentile(unloaded, 95))
+    return {
+        "serve_sweep_n_designs": n_designs,
+        "serve_sweep_n_chunks": res_ref.n_chunks,
+        "serve_sweep_mode": res_ref.mode,
+        "serve_sweep_direct_wall_s": round(t_direct, 3),
+        "serve_sweep_engine_wall_s": round(t_engine, 3),
+        "serve_sweep_engine_vs_direct": round(
+            t_engine / max(t_direct, 1e-9), 3),
+        "serve_sweep_unloaded_p50_ms": round(
+            1e3 * float(np.percentile(unloaded, 50)), 2),
+        "serve_sweep_unloaded_p95_ms": round(1e3 * un_p95, 2),
+        "serve_sweep_p50_off_ms": round(
+            1e3 * float(np.percentile(lat_off, 50)), 2),
+        "serve_sweep_p95_off_ms": round(
+            1e3 * float(np.percentile(lat_off, 95)), 2),
+        "serve_sweep_p50_on_ms": round(
+            1e3 * float(np.percentile(lat_on, 50)), 2),
+        "serve_sweep_p95_on_ms": round(
+            1e3 * float(np.percentile(lat_on, 95)), 2),
+        "serve_sweep_p95_ratio_off": round(
+            float(np.percentile(lat_off, 95)) / max(un_p95, 1e-9), 2),
+        "serve_sweep_p95_ratio_on": round(
+            float(np.percentile(lat_on, 95)) / max(un_p95, 1e-9), 2),
+        "serve_sweep_probes_off": int(lat_off.size),
+        "serve_sweep_probes_on": int(lat_on.size),
+        "serve_sweep_preemptions": res_on.preemptions,
+        "serve_sweep_suspend_s": round(res_on.suspend_s, 3),
+        "serve_sweep_bits_identical": bool(bits),
+    }
+
+
+def bench_serve_sweep_smoke(n_designs=4):
+    """Tier-1-safe continuous-batching smoke: a chunked sweep through a
+    preemption-enabled engine under interactive load, pinned
+    bit-identical to the same sweep run uninterrupted — a broken
+    suspend/resume path is caught by ``--smoke`` in CI, not by a lost
+    driver round."""
+    import tempfile
+
+    from raft_tpu.serve import Engine, EngineConfig
+
+    t_start = time.perf_counter()
+    base, _, _, designs = _serve_sweep_designs(n_designs)
+    with tempfile.TemporaryDirectory() as tmp:
+        eng = Engine(EngineConfig(precision="float64", window_ms=5.0,
+                                  cache_dir=tmp, preempt=True))
+        try:
+            warm = eng.evaluate(base, timeout=400)
+            assert warm.status == "ok", warm.error
+            ref = eng.submit_sweep(designs, chunk=2).result(400)
+            assert ref.status == "ok", ref.error
+            assert ref.n_chunks == 2
+            h = eng.submit_sweep(designs, chunk=2)
+            probes = 0
+            while not h.done():
+                r = eng.evaluate(base, timeout=400)
+                assert r.status == "ok", r.error
+                probes += 1
+            res = h.result(400)
+            assert res.status == "ok", res.error
+            snap = eng.snapshot()
+        finally:
+            eng.shutdown()
+    bits = (np.array_equal(res.Xi_r, ref.Xi_r)
+            and np.array_equal(res.Xi_i, ref.Xi_i)
+            and all(np.array_equal(res.report[k], ref.report[k])
+                    for k in ref.report))
+    assert bits, "preempted sweep diverged from the uninterrupted run"
+    return {
+        "smoke_sweep_designs": n_designs,
+        "smoke_sweep_chunks": res.n_chunks,
+        "smoke_sweep_probes": probes,
+        "smoke_sweep_preemptions": res.preemptions,
+        "smoke_sweep_engine_preemptions": snap["sweep_preemptions"],
+        "smoke_sweep_bits": "identical",
+        "smoke_serve_sweep_s": round(time.perf_counter() - t_start, 3),
+    }
+
+
 def bench_chaos_smoke():
     """Tier-1-safe chaos smoke: one injected fault (a host-prep raiser on
     request 2) end-to-end through the serving engine — the victim fails
@@ -1185,8 +1375,7 @@ def bench_serve_multichip(n_cases=4):
     devs = list(jax.local_devices())
     if len(devs) < 2:
         return {"serve_multichip_error":
-                "skipped: single-device process (multi-chip backend or "
-                "RAFT_TPU_HOST_DEVICES>=2 required)"}
+                "skipped: single-device process"}
     widths = [w for w in (1, 2, 4, 8, 16) if w <= len(devs)]
     block = lane_block()
 
@@ -1525,8 +1714,15 @@ def bench_sweep_warm():
 
 def compact_results(out):
     """The driver-facing subset of the results (kept short enough that the
-    recorded artifact tail stays a parseable JSON line)."""
-    return {k: out[k] for k in _COMPACT_KEYS if k in out}
+    recorded artifact tail stays a parseable JSON line).  Floats are
+    trimmed to 4 significant digits on the line only — the full-precision
+    values stay in BENCH_FULL.json."""
+    def shrink(v):
+        if isinstance(v, float) and v and len(repr(v)) > 8:
+            return float(f"{v:.4g}")
+        return v
+
+    return {k: shrink(out[k]) for k in _COMPACT_KEYS if k in out}
 
 
 def _fmt(x, nd=2):
@@ -1555,23 +1751,48 @@ def perf_md_text(d):
         row("sweep RAO L∞ parity vs the serial path",
             _fmt(d.get("sweep_rao_linf_err", float("nan"))))
     if "sweep_rotor_stage_s" in d:
-        cell = (
-            f"rotor stage {_fmt(d['sweep_rotor_stage_s'])} s on "
-            f"{d.get('sweep_host_devices', '?')} host device(s), "
-            f"{_fmt(d.get('sweep_overlap_saved_s', 0.0))} s hidden by "
-            f"overlap across {d.get('sweep_overlap_chunks', '?')} "
-            "case chunk(s)"
-        )
-        if "sweep_overlap_cross_backend_s" in d:
-            cell += (
-                f" ({_fmt(d['sweep_overlap_cross_backend_s'])} s "
-                "genuinely CPU∥device, "
-                f"{_fmt(d.get('sweep_overlap_within_backend_s', 0.0))} s "
-                "among same-backend async chunks)"
+        chunks = int(d.get("sweep_overlap_chunks", 0) or 0)
+        hostdev = int(d.get("sweep_host_devices", 0) or 0)
+        if chunks <= 1 or hostdev < 1:
+            # the overlap machinery never engaged this round: say so
+            # structurally instead of publishing an all-zeros cell that
+            # reads like a measured (and catastrophic) result
+            why = " / ".join(
+                ([] if chunks > 1 else ["single case chunk"])
+                + ([] if hostdev >= 1 else ["no host mesh"]))
+            cell = (
+                f"inactive ({why}): nothing to hide — rotor ran inline "
+                f"on {hostdev} host device(s) across {chunks} case "
+                "chunk(s)"
             )
+        else:
+            cell = (
+                f"rotor stage {_fmt(d['sweep_rotor_stage_s'])} s on "
+                f"{hostdev} host device(s), "
+                f"{_fmt(d.get('sweep_overlap_saved_s', 0.0))} s hidden "
+                f"by overlap across {chunks} case chunk(s)"
+            )
+            if "sweep_overlap_cross_backend_s" in d:
+                cell += (
+                    f" ({_fmt(d['sweep_overlap_cross_backend_s'])} s "
+                    "genuinely CPU∥device, "
+                    f"{_fmt(d.get('sweep_overlap_within_backend_s', 0.0))} s "
+                    "among same-backend async chunks)"
+                )
         row(
             "heterogeneous overlap: host-sharded rotor ∥ async device "
             "dynamics", cell,
+        )
+    if "sweep_dynamics_gflops" in d:
+        row(
+            "sweep dynamics-stage utilization",
+            f"{_fmt(d.get('sweep_dynamics_achieved_gflops_s', 0.0))} "
+            f"GFLOP/s achieved over "
+            f"{_fmt(d['sweep_dynamics_gflops'])} GFLOP — MFU "
+            f"{d.get('sweep_dynamics_mfu_vs_bf16_peak', 0.0):.2e} of "
+            "bf16 peak"
+            + (f" ({d.get('sweep_fixed_point_mode')} fixed-point mode)"
+               if d.get("sweep_fixed_point_mode") else ""),
         )
     if "sweep_rotor_telemetry" in d:
         t = d["sweep_rotor_telemetry"]
@@ -1689,6 +1910,29 @@ def perf_md_text(d):
             "request "
             f"{_fmt(d.get('serve_warm_first_vs_steady', 0.0))}× its "
             "steady-state latency)",
+        )
+    if "serve_sweep_p95_ratio_on" in d:
+        row(
+            f"**continuous batching: {d.get('serve_sweep_n_designs')}-"
+            "design sweep as a served request "
+            f"({d.get('serve_sweep_n_chunks')} chunks, "
+            f"{d.get('serve_sweep_mode', '?')} mode)**",
+            f"**engine {_fmt(d.get('serve_sweep_engine_wall_s'))} s vs "
+            f"direct driver {_fmt(d.get('serve_sweep_direct_wall_s'))} s "
+            f"({_fmt(d.get('serve_sweep_engine_vs_direct', 0.0))}×)**; "
+            "resumed-after-preemption bits identical: "
+            f"{d.get('serve_sweep_bits_identical')}",
+        )
+        row(
+            "interactive p95 under a concurrent sweep (vs unloaded "
+            f"{_fmt(d.get('serve_sweep_unloaded_p95_ms'), 1)} ms)",
+            f"preempt off {_fmt(d.get('serve_sweep_p95_off_ms'), 1)} ms "
+            f"({_fmt(d.get('serve_sweep_p95_ratio_off', 0.0), 1)}×) → "
+            f"**on {_fmt(d.get('serve_sweep_p95_on_ms'), 1)} ms "
+            f"({_fmt(d.get('serve_sweep_p95_ratio_on', 0.0), 1)}×)** "
+            f"over {d.get('serve_sweep_preemptions', 0)} block-boundary "
+            "preemption(s), "
+            f"{_fmt(d.get('serve_sweep_suspend_s', 0.0))} s suspended",
         )
     if "kernel_gj6_speedup" in d:
         row(
@@ -1843,8 +2087,7 @@ def bench_bem(nw=8, nw_large=4, dz=2.5, dz_large=1.25, backend=None,
         fl = float(out_dev.get("flops", 0.0))
         if fl:
             res["bem_achieved_gflops_s"] = round(fl / t_dev / 1e9, 2)
-            res["bem_mfu_vs_bf16_peak"] = round(
-                fl / t_dev / PEAK_FLOPS_BF16, 6)
+            res["bem_mfu_vs_bf16_peak"] = fl / t_dev / PEAK_FLOPS_BF16
 
     panels_l = mesh_platform(m.members, dz_max=dz_large, da_max=dz_large)
     w_l = np.linspace(0.2, 0.8, nw_large)

@@ -95,7 +95,9 @@ def _serve_main(argv):
     p = _serve_parser(
         "raft_tpu serve",
         "Long-lived serving engine: JSON-line requests on stdin "
-        '({"design": "path.yaml", "cases": [...], "deadline_s": 10}), '
+        '({"design": "path.yaml", "cases": [...], "deadline_s": 10}, '
+        'or {"sweep": {"designs": [...], "chunk": N}} for a chunked '
+        "design sweep streamed as per-chunk result lines), "
         "JSON-line results on stdout.  With --http, an HTTP/1.1 JSON "
         "server (and optionally an N-replica router) instead of the "
         "stdin loop.  SIGTERM/SIGINT shut down gracefully: in-flight "
@@ -165,6 +167,12 @@ def _serve_main(argv):
                 continue
             try:
                 req = json.loads(line)
+                if "sweep" in req:
+                    # inline blocking emission: chunk lines stream as
+                    # they finish, then the terminal sweep_result line
+                    _emit_sweep(eng, req["sweep"], load_design, pending,
+                                args.xi)
+                    continue
                 design = req["design"]
                 if isinstance(design, str):
                     design = load_design(design)
@@ -265,6 +273,30 @@ def _emit_result(res, include_xi=False):
     from raft_tpu.serve import wire
 
     print(json.dumps(wire.result_doc(res, include_xi=include_xi)),
+          flush=True)
+
+
+def _emit_sweep(eng, doc, load_design, pending, include_xi):
+    """Inline sweep emission for the stdin JSONL loop: an accepted line,
+    one line per finished chunk (the PR 2 checkpoint schema as wire
+    format), then the terminal ``sweep_result`` line (meta only — the
+    arrays ride the chunk lines).  Interactive results that finish while
+    the sweep streams (preemption keeps them flowing) are drained
+    between chunk lines so they are not held to the end."""
+    from raft_tpu.serve import wire
+
+    designs, cases, chunk = wire.parse_sweep_request(doc)
+    designs = [load_design(d) if isinstance(d, str) else d
+               for d in designs]
+    handle = eng.submit_sweep(designs, cases=cases, chunk=chunk)
+    print(json.dumps({"event": "sweep_accepted", "rid": handle.rid,
+                      "n_designs": handle.n_designs,
+                      "n_chunks": handle.n_chunks}), flush=True)
+    for ch in handle.chunks():
+        print(json.dumps(wire.sweep_chunk_doc(ch)), flush=True)
+        while pending and pending[0].done():
+            _emit_result(pending.pop(0).result(0), include_xi)
+    print(json.dumps(wire.sweep_result_doc(handle.result(600))),
           flush=True)
 
 

@@ -692,10 +692,21 @@ class Model:
         return args, aux
 
     def analyze_cases(self, display=0, runPyHAMS=False, meshDir=None,
-                      tracer=None):
+                      tracer=None, solver=None):
         """Run all load cases: per-case statics (aero means + mooring
         equilibrium), batched dynamics solve, and response metrics
         (reference raft/raft_model.py:149-309).
+
+        ``solver``: optional replacement for the batched dynamics
+        dispatch — a callable ``(model, args, aux) -> (xr, xi, report)``
+        returning host arrays ([ncase,6,nw] response halves and a
+        ``SolveReport`` over [ncase]).  Used by the OpenMDAO component's
+        engine mode to route the solve through a running serve engine
+        (local or HTTP) while keeping every host-side metric stage here;
+        the served solve is bit-identical to the same design dispatched
+        through ``Model(..., slots=bucket)`` (the engine's canonical
+        fixed-shape program) and agrees with the unslotted in-process
+        dispatch to float64 round-off.
 
         runPyHAMS=True triggers the potential-flow solve on potMod members
         before the case batch, like the reference's calcBEM call
@@ -747,7 +758,13 @@ class Model:
         nLines = T_moor.shape[-1] // 2
 
         # ---- the batched device solve ----
-        if self.slots is not None:
+        if solver is not None:
+            # delegated solve (e.g. through a serve engine): the caller
+            # owns dispatch; statics above and metrics below stay local
+            with timer("rao_solve"), tracer.span(
+                    "dynamics", backend="engine"):
+                xr, xi, report = solver(self, args, aux)
+        elif self.slots is not None:
             # serving-bucket mode: the dispatch runs the canonical
             # fixed-shape slot executable of this bucket, shared with the
             # raft_tpu.serve engine — results bit-identical to the same

@@ -203,6 +203,17 @@ class RAFT_OMDAO(_ComponentBase):
     precision default following that backend), ``precision``
     ('float32' | 'float64'), and ``run_native_BEM`` to use the in-package
     panel solver where the reference shells out to HAMS.
+
+    Engine mode: modeling option ``engine`` (a live Engine/Router object)
+    or ``engine_endpoint`` (a ``host:port`` string for a serve HTTP tier)
+    routes the batched dynamics solve of every compute() through a
+    RUNNING serve engine instead of compiling a pipeline in this process
+    — an optimization driver then shares the engine's warmed executables
+    (and its continuous-batching lane packing) with every other client.
+    Statics, BEM and response metrics stay local; the served solve runs
+    the engine's canonical fixed-shape bucket program — bit-identical to
+    the same design served interactively (tests/test_serve_sweep.py) and
+    equal to the in-process dispatch to float64 round-off.
     """
 
     def initialize(self):
@@ -758,6 +769,52 @@ class RAFT_OMDAO(_ComponentBase):
         return design, np.array(case_mask)
 
     # ----------------------------------------------------------- compute
+    def _engine_solver(self, engine, endpoint, modeling_opt):
+        """Dynamics-dispatch closure for ``Model.analyze_cases(solver=)``
+        that submits the design to a running serve engine (``engine`` —
+        any object with the Engine/Router ``evaluate`` surface) or to a
+        serve HTTP tier (``endpoint`` — ``host:port``) instead of owning
+        the dispatch in this process."""
+        if modeling_opt.get("run_native_BEM"):
+            raise NotImplementedError(
+                "modeling options 'engine'/'engine_endpoint' cannot be "
+                "combined with 'run_native_BEM': the serve engine preps "
+                "designs without a potential-flow stage, so the served "
+                "solve would not see the BEM coefficients"
+            )
+        if modeling_opt.get("trim_ballast", 0):
+            raise NotImplementedError(
+                "modeling options 'engine'/'engine_endpoint' cannot be "
+                "combined with trim_ballast != 0: the serve engine preps "
+                "the design exactly as submitted (no ballast trim), so "
+                "the served solve would run an untrimmed design"
+            )
+        from raft_tpu.health import report_from_dict
+
+        timeout = float(modeling_opt.get("engine_timeout_s", 600.0))
+
+        def solve(model, args, aux):
+            if engine is not None:
+                res = engine.evaluate(model.design, timeout=timeout)
+            else:
+                from raft_tpu.serve import wire
+                from raft_tpu.serve.transport import WireClient
+
+                host, _, port = str(endpoint).rpartition(":")
+                client = WireClient(host or "127.0.0.1", int(port))
+                doc = client.solve({"design": model.design, "xi": True})
+                res = wire.result_from_doc(doc)
+            if res.status != "ok":
+                raise RuntimeError(
+                    f"RAFT_OMDAO engine solve failed "
+                    f"(status={res.status}): {res.error}"
+                )
+            xr = np.ascontiguousarray(res.Xi.real)
+            xi = np.ascontiguousarray(res.Xi.imag)
+            return xr, xi, report_from_dict(res.solve_report)
+
+        return solve
+
     def _scale_theta(self, inputs):
         """Current design-scale vector from the derivative inputs."""
         return np.array([
@@ -796,7 +853,13 @@ class RAFT_OMDAO(_ComponentBase):
         )
         if modeling_opt.get("run_native_BEM"):
             model.run_bem()
-        model.analyze_cases()
+        solver = None
+        if (modeling_opt.get("engine") is not None
+                or modeling_opt.get("engine_endpoint")):
+            solver = self._engine_solver(
+                modeling_opt.get("engine"),
+                modeling_opt.get("engine_endpoint"), modeling_opt)
+        model.analyze_cases(solver=solver)
         results = model.calc_outputs()
 
         for name, _ in self.list_outputs(out_stream=None, all_procs=True):

@@ -40,6 +40,15 @@ keeps per-bucket executables hot per replica and shares one on-disk
 warm-up/XLA cache between replicas.  Wire schema:
 :mod:`raft_tpu.serve.wire`.
 
+Continuous batching (PR 11): sweeps are first-class served requests —
+``Engine.submit_sweep`` / ``POST /v1/sweep`` chunk a design sweep into
+megabatch-sized jobs interleaved with interactive traffic, streaming
+per-chunk results (the PR 2 checkpoint schema as wire format), with
+optional priority preemption at waterfall block boundaries
+(``RAFT_TPU_SERVE_PREEMPT``) — suspended sweep state resumes
+bit-identically (docs/serving.md, "Sweep requests & priority
+preemption").
+
 Entry points: ``python -m raft_tpu serve [--http PORT [--replicas N]]``
 / ``warmup`` (CLI) and the in-process :class:`Engine` API used by
 tests and ``bench.py``.  Design document: docs/serving.md.
@@ -68,6 +77,8 @@ from raft_tpu.serve.engine import (  # noqa: F401
     EngineConfig,
     Request,
     RequestResult,
+    SweepHandle,
+    SweepResult,
 )
 from raft_tpu.serve.router import (  # noqa: F401
     HashRing,

@@ -57,6 +57,7 @@ Fault isolation, per request:
 
 import dataclasses
 import os
+import queue
 import threading
 import time
 from collections import OrderedDict
@@ -144,6 +145,24 @@ class EngineConfig:
         the lane-mesh width / per-device block explicitly (width 1 = a
         1-device mesh running the same fixed-block program, the
         bit-identity baseline of the sharded path).
+    sweep_chunk : designs per sweep chunk (``submit_sweep``); 0 = auto
+        (sized so one chunk's lanes fill the top waterfall rung —
+        sweep_buckets.chunk_designs).
+    preempt : enable priority preemption — sweep chunks run as a
+        sequence of waterfall K-iteration blocks and yield the device to
+        queued interactive requests at block boundaries.  Off by default:
+        a sweep chunk then runs to completion like any dispatch.
+    preempt_age_s : aging rule — once a chunk has spent this much
+        cumulative wall-clock suspended, it stops yielding and runs to
+        completion, so sweeps cannot starve under sustained interactive
+        load.
+    preempt_block : waterfall block size (K iterations) for PREEMPTIBLE
+        sweep dispatches only — a finer K means more block boundaries,
+        so interactive requests wait less before the sweep yields.
+        Convergence freezing is per-iteration in-graph, so K never
+        changes bits (waterfall_dispatch's contract); 0 defers to the
+        global ``RAFT_TPU_FIXED_POINT_BLOCK``.  Ignored when ``preempt``
+        is off.
     """
 
     precision: str = None
@@ -179,6 +198,18 @@ class EngineConfig:
         default_factory=lambda: _env_float(
             "RAFT_TPU_BREAKER_COOLDOWN_S", 30.0))
     degrade_to_cpu: bool = True
+    sweep_chunk: int = dataclasses.field(
+        default_factory=lambda: _env_int("RAFT_TPU_SERVE_SWEEP_CHUNK", 0))
+    preempt: bool = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "RAFT_TPU_SERVE_PREEMPT", "").strip().lower()
+        in ("1", "true", "on", "yes"))
+    preempt_age_s: float = dataclasses.field(
+        default_factory=lambda: _env_float(
+            "RAFT_TPU_SERVE_PREEMPT_AGE_S", 2.0))
+    preempt_block: int = dataclasses.field(
+        default_factory=lambda: _env_int(
+            "RAFT_TPU_SERVE_PREEMPT_BLOCK", 1))
 
     def __post_init__(self):
         if self.low_water <= 0:
@@ -263,6 +294,138 @@ class _Pending:
         return self._result
 
 
+#: per-design health arrays in sweep chunk docs and SweepResult.report —
+#: the PR 2 checkpoint schema's report fields (sweep._REPORT_FILLS).
+SWEEP_REPORT_KEYS = ("converged", "iters", "nonfinite", "recovery_tier",
+                     "residual", "cond")
+
+#: fill values for sweep designs that failed host-side prep (matches
+#: sweep._REPORT_FILLS so a sweep-through-engine artifact reads like a
+#: checkpoint written by run_sweep).
+_SWEEP_FILLS = {"converged": False, "iters": 0, "nonfinite": False,
+                "recovery_tier": 0, "residual": np.nan, "cond": np.nan}
+
+
+@dataclasses.dataclass
+class SweepResult:
+    """Terminal outcome of a ``submit_sweep`` request: aggregated
+    per-design arrays plus scheduling telemetry.  ``status``:
+    'ok' — every chunk dispatched (individual designs may still have
+        failed prep: ``failed_idx``/``failed_msg``, rows hold the sweep
+        quarantine fills);
+    'failed' — a chunk raised past quarantine (``error``);
+    'shutdown' — the engine stopped before the sweep finished.
+    """
+
+    rid: int
+    status: str
+    n_designs: int = 0
+    n_chunks: int = 0
+    chunks_done: int = 0
+    error: str = None
+    Xi_r: np.ndarray = None          # [nd, nc, 6, nw]
+    Xi_i: np.ndarray = None
+    report: dict = None              # SWEEP_REPORT_KEYS -> [nd, nc]
+    failed_idx: list = dataclasses.field(default_factory=list)
+    failed_msg: list = dataclasses.field(default_factory=list)
+    preemptions: int = 0             # block-boundary yields to interactive
+    mode: str = None                 # 'waterfall' | 'fused'
+    latency_s: float = 0.0           # submit -> terminal
+    suspend_s: float = 0.0           # cumulative preempted wall clock
+    replica: str = None              # replica id when routed (router.py)
+
+    @property
+    def ok(self):
+        return self.status == "ok"
+
+    @property
+    def Xi(self):
+        if self.Xi_r is None:
+            return None
+        return np.asarray(self.Xi_r) + 1j * np.asarray(self.Xi_i)
+
+
+class SweepHandle:
+    """Handle of a submitted sweep.  Two delivery surfaces with the same
+    exactly-once contract as interactive requests:
+
+    * ``chunks()`` — generator of per-chunk partial-result docs (numpy
+      arrays under the PR 2 checkpoint schema keys) in chunk order,
+      ending when the terminal result resolves;
+    * ``result(timeout)`` — blocks for the terminal ``SweepResult``
+      (aggregate of every chunk; at latest ``status="shutdown"``).
+    """
+
+    def __init__(self, rid, n_designs, n_chunks):
+        self.rid = rid
+        self.n_designs = n_designs
+        self.n_chunks = n_chunks
+        self._q = queue.Queue()
+        self._pend = _Pending(rid)
+
+    def _push(self, doc):
+        self._q.put(doc)
+
+    def _close(self):
+        self._q.put(None)
+
+    def chunks(self, timeout=600.0):
+        """Yield per-chunk partial docs until the sweep is terminal.
+        ``timeout`` bounds the wait for EACH chunk, not the whole
+        sweep."""
+        while True:
+            doc = self._q.get(timeout=timeout)
+            if doc is None:
+                return
+            yield doc
+
+    def done(self):
+        return self._pend.done()
+
+    def result(self, timeout=None):
+        return self._pend.result(timeout)
+
+
+class _SweepJob:
+    """Batcher-side state of one sweep: chunk plan, per-design prep
+    futures (lookahead 1 chunk on the dedicated sweep prep worker),
+    the current chunk's segment queue, the suspended waterfall (when
+    preempted at a block boundary), and the aggregate output arrays.
+
+    All mutation happens on the batcher thread; ``futs``/``chunk_idx``
+    are additionally read under ``self._lock`` by the wake predicate."""
+
+    __slots__ = ("rid", "designs", "cases", "handle", "chunks",
+                 "chunk_idx", "futs", "t_submit", "suspended",
+                 "t_suspend", "suspend_wall", "suspend_total",
+                 "seg_queue", "chunk_t0", "chunk_failed", "failed",
+                 "out", "preemptions")
+
+    def __init__(self, rid, designs, cases, handle, chunks, t_submit):
+        self.rid = rid
+        self.designs = designs
+        self.cases = cases
+        self.handle = handle
+        self.chunks = chunks         # [[design idx, ...], ...]
+        self.chunk_idx = 0
+        self.futs = {}               # design idx -> prep Future
+        self.t_submit = t_submit
+        self.suspended = None        # (segment, SuspendedWaterfall)
+        self.t_suspend = 0.0
+        self.suspend_wall = 0.0      # current chunk's suspended wall
+        self.suspend_total = 0.0
+        self.seg_queue = None        # None = no chunk started
+        self.chunk_t0 = 0.0
+        self.chunk_failed = []       # [(design idx, msg)] this chunk
+        self.failed = []             # [(design idx, msg)] whole sweep
+        self.out = None              # aggregate arrays, lazily allocated
+        self.preemptions = 0
+
+    @property
+    def pend(self):
+        return self.handle._pend
+
+
 class _Prepped:
     """Host-side preparation of one design: everything a dispatch lane
     needs (nodes in working dtype, the 7 case-input arrays, physics key,
@@ -327,6 +490,11 @@ class Engine:
         self._prep_pool = ThreadPoolExecutor(
             max_workers=max(1, self.config.prep_workers),
             thread_name_prefix="raft-serve-prep")
+        # sweeps prep on their own single worker so a 256-design sweep
+        # never queues ahead of an interactive request's cold prep
+        self._sweep_jobs = []                  # [_SweepJob] FIFO
+        self._sweep_prep_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="raft-sweep-prep")
         self._prep_cache = (PrepCache(self.config.cache_dir)
                             if self.config.use_prep_cache else None)
         self._manifest = (WarmupManifest(cache_dir=self.config.cache_dir)
@@ -360,6 +528,8 @@ class Engine:
             "prep_deferred": 0, "prep_retries": 0,
             "late_resolutions": 0,
             "shutdown_resolved": 0, "degraded_dispatches": 0,
+            "sweeps": 0, "sweep_designs": 0, "sweep_chunks": 0,
+            "sweep_preemptions": 0,
             "latency_s": [], "occupancy": [],
             "batch_requests": [], "prep_cache_hits": 0,
             "prep_memo_hits": 0, "bucket_compiles": [],
@@ -438,6 +608,58 @@ class Engine:
             self._wake.notify()
         return pend
 
+    def submit_sweep(self, designs, cases=None, chunk=None):
+        """Enqueue a design sweep as ONE streamed request; returns a
+        ``SweepHandle`` (``chunks()`` partial stream + terminal
+        ``result()``).
+
+        The sweep is split into megabatch-sized chunks
+        (``sweep_buckets.chunk_designs``; ``chunk`` overrides
+        ``config.sweep_chunk``); chunks dispatch through the iteration
+        waterfall at BACKGROUND priority: the batcher runs one chunk
+        quantum between interactive batches, and with ``config.preempt``
+        on, a queued interactive request preempts the chunk at the next
+        K-iteration block boundary (suspended lane state held host-side,
+        resumed bit-identically later — waterfall.SuspendedWaterfall).
+        """
+        from raft_tpu.sweep_buckets import chunk_designs
+
+        designs = list(designs)
+        if not designs:
+            raise ValueError("submit_sweep needs at least one design")
+        now = time.perf_counter()
+        if cases:
+            n_cases = len(cases)
+        else:   # the design's own cases table sizes the auto chunk
+            n_cases = len((designs[0].get("cases") or {}).get("data")
+                          or []) or None
+        rung = None
+        if self.config.preempt:
+            # preemptible chunks target a lower rung: interactive wait
+            # at a yield is one block wall, and block wall scales with
+            # lanes.  Explicit chunk / env knob still wins below.
+            from raft_tpu.waterfall import LANE_LADDER
+            rung = max(LANE_LADDER[0], LANE_LADDER[-1] // 4)
+        chunks = chunk_designs(
+            len(designs), n_cases=n_cases,
+            chunk=chunk if chunk is not None
+            else (self.config.sweep_chunk or None), rung=rung)
+        with self._lock:
+            if self._stop:
+                raise RuntimeError("engine is shut down")
+            self._rid += 1
+            rid = self._rid
+            self.stats["sweeps"] += 1
+            self.stats["sweep_designs"] += len(designs)
+            handle = SweepHandle(rid, len(designs), len(chunks))
+            job = _SweepJob(rid, designs, cases, handle, chunks, now)
+            handle._pend.sweep_job = job
+            self._sweep_jobs.append(job)
+            self._outstanding[rid] = handle._pend
+            self._sweep_prep_ahead_locked(job)
+            self._wake.notify()
+        return handle
+
     def evaluate(self, design, cases=None, timeout=600.0):
         """Synchronous convenience: submit + wait."""
         return self.submit(design, cases).result(timeout)
@@ -463,6 +685,7 @@ class Engine:
             self._wake.notify_all()
         # without drain, queued-but-unstarted preps are pointless work
         self._prep_pool.shutdown(wait=False, cancel_futures=not drain)
+        self._sweep_prep_pool.shutdown(wait=False, cancel_futures=True)
         if wait:
             self._thread.join(timeout)
             if self._thread.is_alive():
@@ -494,11 +717,27 @@ class Engine:
 
     def _finalize_outstanding(self):
         """Resolve every still-pending handle with ``shutdown`` — the
-        no-handle-blocks-forever guarantee."""
+        no-handle-blocks-forever guarantee.  Sweep handles get a
+        terminal SweepResult and their chunk stream is closed, so
+        ``chunks()`` consumers unblock too."""
         with self._lock:
             leftovers = list(self._outstanding.values())
             self._queue = []
+            self._sweep_jobs = []
         for pend in leftovers:
+            job = getattr(pend, "sweep_job", None)
+            if job is not None:
+                if self._resolve(pend, SweepResult(
+                        rid=pend.rid, status="shutdown",
+                        n_designs=len(job.designs),
+                        n_chunks=len(job.chunks),
+                        chunks_done=job.chunk_idx,
+                        preemptions=job.preemptions,
+                        error="engine stopped before the sweep "
+                              "finished")):
+                    self.stats["shutdown_resolved"] += 1
+                job.handle._close()
+                continue
             if self._resolve(pend, RequestResult(
                     rid=pend.rid, status="shutdown",
                     error="engine stopped before this request was "
@@ -643,20 +882,28 @@ class Engine:
             while True:
                 with self._lock:
                     # wait for actionable work: a ready prep, a fresh
-                    # (never-windowed) entry, or stop
+                    # (never-windowed) entry, a runnable sweep quantum,
+                    # or stop
                     while not self._stop and not any(
                             e.fut.done() or not e.windowed
-                            for e in self._queue):
-                        self._wake.wait(0.25 if self._queue else None)
+                            for e in self._queue) \
+                            and self._next_sweep_locked() is None:
+                        self._wake.wait(
+                            0.25 if (self._queue or self._sweep_jobs)
+                            else None)
                     if self._stop:
                         break
+                    has_queue = bool(self._queue)
                     t_first = min(
                         (e.req.t_submit for e in self._queue
                          if not e.windowed),
                         default=time.perf_counter())
                     for e in self._queue:
                         e.windowed = True
-                self._window_wait(t_first)
+                if has_queue:
+                    # sweep-only iterations skip the batching window:
+                    # background quanta must not add interactive latency
+                    self._window_wait(t_first)
                 if self._stop_requested():
                     break
                 batch = self._collect_batch()
@@ -669,6 +916,9 @@ class Engine:
                             self._resolve(entry.pend, RequestResult(
                                 rid=entry.req.rid, status="failed",
                                 error="internal batcher error"))
+                # interactive work first, then ONE background quantum —
+                # strict alternation under load, full speed when idle
+                self._sweep_quantum()
             if self._drain:
                 self._drain_queue()
         except Exception:  # pragma: no cover — last-ditch guard
@@ -765,6 +1015,269 @@ class Engine:
                             error="internal batcher error"))
             else:
                 time.sleep(0.02)
+
+    # ------------------------------------------------------------- sweeps
+
+    def _sweep_prep_ahead_locked(self, job):
+        """Schedule prep for the current chunk plus ONE lookahead chunk
+        on the dedicated sweep prep worker, so host prep overlaps the
+        device solving the previous chunk.  Called under self._lock."""
+        for chunk in job.chunks[job.chunk_idx:job.chunk_idx + 2]:
+            for di in chunk:
+                if di in job.futs:
+                    continue
+                req = Request(design=job.designs[di], cases=job.cases,
+                              rid=job.rid)
+                fut = self._sweep_prep_pool.submit(self._prepare, req)
+                fut.add_done_callback(self._on_prep_done)
+                job.futs[di] = fut
+
+    def _next_sweep_locked(self):
+        """First sweep job with work the batcher can run NOW: a
+        suspended or mid-chunk segment to continue, or a chunk whose
+        preps have all landed."""
+        for job in self._sweep_jobs:
+            if job.suspended is not None or job.seg_queue:
+                return job
+            if job.chunk_idx < len(job.chunks) and all(
+                    job.futs[di].done()
+                    for di in job.chunks[job.chunk_idx]):
+                return job
+        return None
+
+    def _sweep_quantum(self):
+        """Run ONE background quantum: resume the first runnable sweep's
+        suspended chunk or start its next prepped one, advancing until
+        the chunk completes or — with preemption on — ``should_yield``
+        fires at a waterfall block boundary.  Returns True if any sweep
+        work ran."""
+        with self._lock:
+            if self._stop:
+                return False
+            job = self._next_sweep_locked()
+        if job is None:
+            return False
+        try:
+            self._advance_sweep(job)
+        except Exception as e:  # noqa: BLE001 — fail sweep, keep serving
+            logger.exception("sweep rid=%d failed", job.rid)
+            self._fail_sweep(job, f"{type(e).__name__}: {e}")
+        return True
+
+    def _sweep_should_yield(self, job):
+        """Block-boundary preemption predicate for one chunk, or None
+        when preemption is off (the chunk then runs to completion like
+        any dispatch).  Aging rule: once the chunk has spent
+        ``preempt_age_s`` cumulative wall suspended, it stops yielding
+        and finishes — sustained interactive load can delay one chunk by
+        at most the age bound plus one interactive batch tail, so sweeps
+        never starve."""
+        if not self.config.preempt:
+            return None
+        age = max(float(self.config.preempt_age_s), 0.0)
+
+        def should_yield():
+            if job.suspend_wall >= age:
+                return False
+            # lock-free peek (GIL-atomic list read): a stale-by-one
+            # view only shifts the yield to the next block boundary
+            return any(e.fut.done() for e in self._queue)
+
+        return should_yield
+
+    def _advance_sweep(self, job):
+        from raft_tpu.waterfall import waterfall_dispatch
+
+        sy = self._sweep_should_yield(job)
+        # finer K only while preemptible: more block boundaries = less
+        # interactive wait; K never changes bits (per-iteration in-graph
+        # convergence freezing), so preempted-vs-uninterrupted identity
+        # and the slotted-parity pin both survive the override
+        blk = (int(self.config.preempt_block) or None) if sy else None
+        if job.suspended is not None:
+            seg, sus = job.suspended
+            job.suspended = None
+            job.suspend_wall += time.perf_counter() - job.t_suspend
+            out = waterfall_dispatch(None, None, None, resume=sus,
+                                     should_yield=sy)
+            if self._note_segment(job, seg, out):
+                return
+        if job.seg_queue is None:
+            self._start_chunk(job)
+        while job.seg_queue:
+            seg = job.seg_queue[0]
+            physics, _members, nodes_s, args_s, _ranges, lanes = seg
+            out = waterfall_dispatch(
+                physics, nodes_s, args_s, block=blk,
+                slab=len(args_s[0]), should_yield=sy)
+            if self._note_segment(job, seg, out):
+                return
+        self._finish_chunk(job)
+
+    def _start_chunk(self, job):
+        """Materialize the current chunk: harvest its prep futures (a
+        prep failure quarantines that design alone — chunk-mates
+        proceed; the sweep drivers' contract), group by (physics,
+        bucket) and pack each group as one slab-sized segment."""
+        from raft_tpu.waterfall import ladder_lanes
+
+        chunk = job.chunks[job.chunk_idx]
+        job.chunk_failed = []
+        job.chunk_t0 = time.perf_counter()
+        job.suspend_wall = 0.0
+        members = []
+        for di in chunk:
+            try:
+                p = job.futs[di].result(timeout=0)
+            except Exception as e:  # noqa: BLE001 — quarantine the design
+                job.chunk_failed.append((di, f"{type(e).__name__}: {e}"))
+                logger.warning(
+                    "sweep rid=%d design %d quarantined: prep raised "
+                    "(%s: %s)", job.rid, di, type(e).__name__, e)
+                continue
+            members.append((di, p))
+        groups = OrderedDict()
+        for di, p in members:
+            groups.setdefault((p.physics, p.spec), []).append((di, p))
+        segs = []
+        for (physics, spec), mem in groups.items():
+            entries = [(p.nodes, p.args) for _di, p in mem]
+            lanes = sum(p.nc for _di, p in mem)
+            capacity = max(spec.n_slots, ladder_lanes(lanes))
+            nodes_s, args_s, ranges = pack_slots(entries, spec,
+                                                 capacity=capacity)
+            segs.append((physics, mem, nodes_s, args_s, ranges, lanes))
+        job.seg_queue = segs
+
+    def _note_segment(self, job, seg, out):
+        """Record one segment outcome.  Returns True when the segment
+        suspended at a block boundary (quantum over — the SuspendedWaterfall
+        holds the survivors' lane state host-side); otherwise scatters
+        the per-design slices into the aggregate arrays and pops the
+        segment."""
+        from raft_tpu.waterfall import SuspendedWaterfall
+
+        if isinstance(out, SuspendedWaterfall):
+            job.suspended = (seg, out)
+            job.t_suspend = time.perf_counter()
+            job.preemptions += 1
+            self.stats["sweep_preemptions"] += 1
+            return True
+        _physics, members, _nodes, _args, ranges, _lanes = seg
+        xr, xi, rep = out
+        xr = np.asarray(xr)
+        xi = np.asarray(xi)
+        self._sweep_alloc_out(job, members[0][1].nc, xr)
+        for (di, p), (a, b) in zip(members, ranges):
+            if xr[a:b].shape != job.out["Xi_r"][di].shape:
+                job.chunk_failed.append(
+                    (di, f"shape mismatch vs sweep aggregate: "
+                         f"{xr[a:b].shape} != "
+                         f"{job.out['Xi_r'][di].shape}"))
+                continue
+            job.out["Xi_r"][di] = xr[a:b]
+            job.out["Xi_i"][di] = xi[a:b]
+            for name in SWEEP_REPORT_KEYS:
+                job.out[name][di] = np.asarray(getattr(rep, name))[a:b]
+        job.seg_queue.pop(0)
+        return False
+
+    def _sweep_alloc_out(self, job, nc, xr):
+        """Lazily allocate the aggregate arrays from the first served
+        segment's shapes.  Rows prefill with the sweep quarantine fills
+        (_SWEEP_FILLS / NaN Xi), so failed-prep designs read exactly
+        like run_sweep's checkpoint rows."""
+        if job.out is not None:
+            return
+        nd = len(job.designs)
+        nw = xr.shape[-1]
+        job.out = {
+            "Xi_r": np.full((nd, nc, 6, nw), np.nan, xr.dtype),
+            "Xi_i": np.full((nd, nc, 6, nw), np.nan, xr.dtype),
+            "converged": np.zeros((nd, nc), bool),
+            "iters": np.zeros((nd, nc), np.int64),
+            "nonfinite": np.zeros((nd, nc), bool),
+            "recovery_tier": np.zeros((nd, nc), np.int64),
+            "residual": np.full((nd, nc), np.nan, np.float64),
+            "cond": np.full((nd, nc), np.nan, np.float64),
+        }
+
+    def _finish_chunk(self, job):
+        """Emit the chunk's partial-result doc (PR 2 checkpoint schema
+        keys), advance the chunk cursor, kick lookahead prep — or, on
+        the last chunk, resolve the terminal SweepResult."""
+        from raft_tpu.waterfall import fixed_point_mode
+
+        chunk = job.chunks[job.chunk_idx]
+        wall = time.perf_counter() - job.chunk_t0
+        job.suspend_total += job.suspend_wall
+        job.failed.extend(job.chunk_failed)
+        mode = "fused" if fixed_point_mode() == "fused" else "waterfall"
+        doc = {
+            "event": "sweep_chunk", "rid": job.rid,
+            "chunk": job.chunk_idx, "n_chunks": len(job.chunks),
+            "designs": [int(di) for di in chunk],
+            "wall_s": wall, "suspend_s": job.suspend_wall,
+            "preemptions": job.preemptions, "mode": mode,
+            "failed_idx": [int(di) for di, _m in job.chunk_failed],
+            "failed_msg": [m for _di, m in job.chunk_failed],
+        }
+        if job.out is not None:
+            sel = np.asarray(chunk, int)
+            doc["Xi_r"] = job.out["Xi_r"][sel]
+            doc["Xi_i"] = job.out["Xi_i"][sel]
+            for name in SWEEP_REPORT_KEYS:
+                doc[name] = job.out[name][sel]
+        job.handle._push(doc)
+        self.stats["sweep_chunks"] += 1
+        with self._lock:
+            job.seg_queue = None
+            for di in chunk:
+                job.futs.pop(di, None)
+            job.chunk_idx += 1
+            if job.chunk_idx < len(job.chunks):
+                self._sweep_prep_ahead_locked(job)
+                self._wake.notify_all()
+                return
+            if job in self._sweep_jobs:
+                self._sweep_jobs.remove(job)
+        self._finish_sweep(job, mode)
+
+    def _finish_sweep(self, job, mode):
+        report = None
+        if job.out is not None:
+            report = {name: job.out[name] for name in SWEEP_REPORT_KEYS}
+        status = "ok" if job.out is not None else "failed"
+        self._resolve(job.pend, SweepResult(
+            rid=job.rid, status=status,
+            n_designs=len(job.designs), n_chunks=len(job.chunks),
+            chunks_done=job.chunk_idx,
+            error=(None if status == "ok" else
+                   "every design in the sweep failed host-side prep"),
+            Xi_r=None if job.out is None else job.out["Xi_r"],
+            Xi_i=None if job.out is None else job.out["Xi_i"],
+            report=report,
+            failed_idx=[int(di) for di, _m in job.failed],
+            failed_msg=[m for _di, m in job.failed],
+            preemptions=job.preemptions, mode=mode,
+            latency_s=time.perf_counter() - job.t_submit,
+            suspend_s=job.suspend_total))
+        job.handle._close()
+
+    def _fail_sweep(self, job, msg):
+        """A chunk raised past per-design quarantine: terminal-fail the
+        whole sweep (exactly-once; the chunk stream closes so consumers
+        unblock) and drop the job."""
+        with self._lock:
+            if job in self._sweep_jobs:
+                self._sweep_jobs.remove(job)
+        self.stats["failed"] += 1
+        self._resolve(job.pend, SweepResult(
+            rid=job.rid, status="failed",
+            n_designs=len(job.designs), n_chunks=len(job.chunks),
+            chunks_done=job.chunk_idx, preemptions=job.preemptions,
+            error=msg))
+        job.handle._close()
 
     # ----------------------------------------------------------- dispatch
 
@@ -1099,6 +1612,7 @@ class Engine:
         return {
             "queue_depth": len(self._queue),
             "in_flight": len(self._outstanding),
+            "sweep_jobs": len(self._sweep_jobs),
             "shedding": shedding,
             "stopped": stopped,
             "accepting": not (stopped or shedding),
@@ -1128,6 +1642,11 @@ class Engine:
             "late_resolutions": self.stats["late_resolutions"],
             "shutdown_resolved": self.stats["shutdown_resolved"],
             "degraded_dispatches": self.stats["degraded_dispatches"],
+            "sweeps": self.stats["sweeps"],
+            "sweep_designs": self.stats["sweep_designs"],
+            "sweep_chunks": self.stats["sweep_chunks"],
+            "sweep_preemptions": self.stats["sweep_preemptions"],
+            "sweep_jobs": len(self._sweep_jobs),
             "outstanding": len(self._outstanding),
             "queue_depth": len(self._queue),
             "in_flight": len(self._outstanding),

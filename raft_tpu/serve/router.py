@@ -255,6 +255,41 @@ def spawn_replica(replica_id, cache_dir=None, precision=None, device=None,
                            proc=proc, stderr_path=stderr_path)
 
 
+class _RouterSweepHandle:
+    """Router-side sweep handle: the engine ``SweepHandle`` surface
+    (``chunks()`` stream + terminal ``result()``), fed by the forwarding
+    thread relaying the placed replica's ``/v1/sweep`` NDJSON stream."""
+
+    def __init__(self, rid, n_designs):
+        self.rid = rid
+        self.n_designs = n_designs
+        self.n_chunks = 0            # learned from the first chunk line
+        self._q = queue.Queue()
+        self._pend = _Pending(rid)
+
+    def _push(self, doc):
+        self.n_chunks = int(doc.get("n_chunks", self.n_chunks))
+        self._q.put(doc)
+
+    def _close(self):
+        self._q.put(None)
+
+    def chunks(self, timeout=600.0):
+        """Yield relayed per-chunk docs (numpy-backed) until terminal;
+        ``timeout`` bounds the wait for EACH chunk."""
+        while True:
+            doc = self._q.get(timeout=timeout)
+            if doc is None:
+                return
+            yield doc
+
+    def done(self):
+        return self._pend.done()
+
+    def result(self, timeout=None):
+        return self._pend.result(timeout)
+
+
 class Router:
     """See module docstring.  Engine-compatible front surface."""
 
@@ -272,7 +307,7 @@ class Router:
             "requests": 0, "forwarded": 0, "replica_retries": 0,
             "dead_replica_skips": 0, "rejected_deadline": 0,
             "failed": 0, "ok": 0, "shutdown_resolved": 0,
-            "chaos_replica_kills": 0,
+            "chaos_replica_kills": 0, "sweeps": 0,
         }
         if endpoints is not None:          # attach mode
             self.replicas = {
@@ -337,6 +372,32 @@ class Router:
         return self.submit(design, cases=cases,
                            deadline_s=deadline_s).result(timeout)
 
+    def submit_sweep(self, designs, cases=None, chunk=None):
+        """Forward a sweep to the replica owning its design family.
+
+        Placement hashes ``routing_key(designs[0], cases)`` — the
+        ballast-excluding physics key — so every chunk of a family sweep
+        lands on the replica whose executables are already hot for that
+        family.  Returns a handle with the engine ``SweepHandle``
+        surface (``chunks()``/``result()``); chunk docs are relayed as
+        they stream off the replica."""
+        designs = list(designs)
+        if not designs:
+            raise ValueError("submit_sweep needs at least one design")
+        with self._lock:
+            if self._stop:
+                raise RuntimeError("router is shut down")
+            self._rid += 1
+            rid = self._rid
+            self.stats["requests"] += 1
+            self.stats["sweeps"] += 1
+            handle = _RouterSweepHandle(rid, len(designs))
+            handle._pend.router_sweep = handle
+            self._outstanding[rid] = handle._pend
+        self._pool.submit(self._forward_sweep, rid, handle, designs,
+                          cases, chunk, time.perf_counter())
+        return handle
+
     def probe(self):
         alive = sum(1 for r in self.replicas.values() if not r.dead())
         stopped = self._stop
@@ -373,6 +434,15 @@ class Router:
             leftovers = list(self._outstanding.items())
             self._outstanding.clear()
         for rid, pend in leftovers:
+            handle = getattr(pend, "router_sweep", None)
+            if handle is not None:
+                if pend._set(wire.sweep_result_from_doc({
+                        "rid": rid, "status": "shutdown",
+                        "n_designs": handle.n_designs,
+                        "error": "router stopped"})):
+                    self.stats["shutdown_resolved"] += 1
+                handle._close()
+                continue
             if pend._set(wire.result_from_doc({
                     "rid": rid, "status": "shutdown",
                     "error": "router stopped"})):
@@ -482,3 +552,75 @@ class Router:
             "rid": rid, "status": status,
             "error": f"no replica served the request "
                      f"(tried {len(order)}; last: {last_err})"}))
+
+    def _forward_sweep(self, rid, handle, designs, cases, chunk, t0):
+        key = routing_key(designs[0], cases)
+        order = self._ring.preference(key)
+        last_err = None
+        attempted = breaker_skips = 0
+        req = {"designs": designs, "cases": cases}
+        if chunk is not None:
+            req["chunk"] = int(chunk)
+        for replica_id in order:
+            rep = self.replicas[replica_id]
+            if rep.dead():
+                self.stats["dead_replica_skips"] += 1
+                self._breakers.get(replica_id).record_failure(
+                    "replica process dead")
+                last_err = f"{replica_id} dead"
+                continue
+            breaker = self._breakers.get(replica_id)
+            if not breaker.allow():
+                breaker_skips += 1
+                last_err = f"{replica_id} breaker open"
+                continue
+            streamed = []
+
+            def on_chunk(ch, replica_id=replica_id, streamed=streamed):
+                streamed.append(True)
+                ch["replica"] = replica_id
+                handle._push(ch)
+
+            try:
+                self.stats["forwarded"] += 1
+                attempted += 1
+                terminal, chunks = rep.client.sweep(req, on_chunk=on_chunk)
+            except (ConnectionDropped, TransientError) as e:
+                breaker.record_failure(str(e))
+                last_err = str(e)
+                if streamed:
+                    # mid-stream loss: retrying on another replica would
+                    # re-run and re-emit chunks the consumer already saw,
+                    # so fail the sweep instead of replaying it
+                    last_err = (f"stream from {replica_id} dropped after "
+                                f"{len(streamed)} chunk(s): {e}")
+                    break
+                self.stats["replica_retries"] += 1
+                logger.warning("sweep rid=%d to %s failed (%s); retrying "
+                               "on next replica", rid, replica_id, e)
+                continue
+            if terminal.get("status") == "shutdown" and not self._stop \
+                    and not streamed:
+                breaker.record_failure("replica draining")
+                self.stats["replica_retries"] += 1
+                last_err = f"{replica_id} draining"
+                continue
+            breaker.record_success()
+            rep.served += 1
+            self.stats["ok" if terminal.get("status") == "ok"
+                       else "failed"] += 1
+            res = wire.sweep_result_from_doc(terminal, chunks=chunks,
+                                             rid=rid)
+            res.replica = replica_id
+            res.latency_s = time.perf_counter() - t0
+            self._resolve(rid, handle._pend, res)
+            handle._close()
+            return
+        status = ("rejected_circuit"
+                  if not attempted and breaker_skips else "failed")
+        self.stats["failed"] += 1
+        self._resolve(rid, handle._pend, wire.sweep_result_from_doc({
+            "rid": rid, "status": status, "n_designs": len(designs),
+            "error": f"no replica served the sweep "
+                     f"(tried {len(order)}; last: {last_err})"}))
+        handle._close()

@@ -12,6 +12,10 @@
   committed at the accepted chunk (200); the terminal status rides in
   the body.  ``?stream=0`` buffers instead and maps the terminal
   status to an HTTP code (wire.HTTP_STATUS).
+* ``POST /v1/sweep`` — body is a sweep request document
+  (``wire.parse_sweep_request``).  Always streamed NDJSON: ``accepted``,
+  one ``sweep_chunk`` line per finished chunk (PR 2 checkpoint schema),
+  then exactly one terminal ``sweep_result`` line (see ``_post_sweep``).
 * ``GET /healthz`` — liveness: 200 whenever the process can answer.
 * ``GET /readyz`` — readiness from ``backend.probe()`` (the cheap
   lock-free gauge): 503 while draining, stopped, or shedding
@@ -38,6 +42,7 @@ lint tests/test_no_fixed_ports.py keeps it that way.
 
 import http.client
 import json
+import queue
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -111,6 +116,8 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):
         path, _, query = self.path.partition("?")
+        if path == "/v1/sweep":
+            return self._post_sweep()
         if path != "/v1/solve":
             return self._send_json(404, {"error": f"no route {path}"})
         if self.transport.draining:
@@ -166,6 +173,68 @@ class _Handler(BaseHTTPRequestHandler):
             # client went away mid-wait; the engine still resolves the
             # handle (terminal-status guarantee is server-side).
             self.close_connection = True
+
+    def _post_sweep(self):
+        """``POST /v1/sweep`` — always streamed NDJSON: an ``accepted``
+        line (rid, n_designs, n_chunks) as soon as admission takes the
+        sweep, one ``sweep_chunk`` line per chunk as the continuous
+        batcher finishes it (the PR 2 checkpoint schema slices), then
+        exactly one terminal ``sweep_result`` line — WITHOUT the
+        aggregate arrays (the chunks carried them;
+        wire.sweep_result_from_doc reassembles client-side)."""
+        if self.transport.draining:
+            return self._send_json(503, {"error": "draining"})
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            if length > MAX_BODY_BYTES:
+                return self._send_json(413, {"error": "body too large"})
+            doc = json.loads(self.rfile.read(length))
+            designs, cases, chunk = wire.parse_sweep_request(doc)
+            if any(isinstance(d, str) for d in designs):
+                from raft_tpu.io.schema import load_design
+                designs = [load_design(d) if isinstance(d, str) else d
+                           for d in designs]
+        except wire.WireError as e:
+            return self._send_json(400, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 — bad body, keep serving
+            return self._send_json(
+                400, {"error": f"{type(e).__name__}: {e}"})
+        try:
+            handle = self.transport.backend.submit_sweep(
+                designs, cases=cases, chunk=chunk)
+        except (RuntimeError, ValueError) as e:   # stopped / empty sweep
+            return self._send_json(503, {"error": str(e)})
+        self.transport.note_accept(handle.rid)
+        with self.transport._lock:
+            self.transport._active += 1
+        try:
+            self.send_response(200)
+            self.send_header("Content-Type", "application/x-ndjson")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            self._chunk({"event": "accepted", "rid": handle.rid,
+                         "n_designs": handle.n_designs,
+                         "n_chunks": handle.n_chunks})
+            wait = self.transport.result_wait_s
+            try:
+                for ch in handle.chunks(timeout=wait):
+                    self._chunk(wire.sweep_chunk_doc(ch))
+                res = handle.result(timeout=wait)
+                self._chunk(wire.sweep_result_doc(res))
+            except (queue.Empty, TimeoutError):
+                self._chunk({"event": "sweep_result", "rid": handle.rid,
+                             "status": "failed",
+                             "error": f"transport result wait exceeded "
+                                      f"{wait:.0f}s"})
+            self._end_chunks()
+        except (BrokenPipeError, ConnectionResetError):
+            # client went away mid-stream; the engine still resolves the
+            # handle (terminal-status guarantee is server-side).
+            self.close_connection = True
+        finally:
+            with self.transport._idle:
+                self.transport._active -= 1
+                self.transport._idle.notify_all()
 
 
 class _Server(ThreadingHTTPServer):
@@ -330,6 +399,66 @@ class WireClient:
                         f"stream from {self.host}:{self.port} ended "
                         f"before a terminal result line")
                 return terminal
+            except (ConnectionError, http.client.HTTPException,
+                    TimeoutError, OSError) as e:
+                raise ConnectionDropped(
+                    f"{self.host}:{self.port}: "
+                    f"{type(e).__name__}: {e}") from e
+        finally:
+            conn.close()
+
+    def sweep(self, doc, on_chunk=None, on_sent=None):
+        """POST a sweep request document to ``/v1/sweep`` and stream the
+        response.  Returns ``(terminal_doc, chunk_docs)`` — the raw
+        terminal ``sweep_result`` line plus the decoded numpy-backed
+        chunk docs (wire.sweep_chunk_from_doc), ready for
+        ``wire.sweep_result_from_doc(terminal, chunks=chunk_docs)``.
+        ``on_chunk`` fires per decoded chunk (streaming consumers /
+        router progress forwarding); transport-level failures raise
+        ``ConnectionDropped``."""
+        body = wire.dumps(doc).encode()
+        conn = self._conn()
+        try:
+            try:
+                conn.request("POST", "/v1/sweep", body=body, headers={
+                    "Content-Type": "application/json"})
+                if on_sent is not None:
+                    on_sent()
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    err = {}
+                    try:
+                        err = json.loads(resp.read())
+                    except (ValueError, OSError,
+                            http.client.HTTPException):
+                        err = {"error": f"HTTP {resp.status} "
+                                        f"(unparseable error body)"}
+                    return ({"event": "sweep_result",
+                             "rid": err.get("rid", -1),
+                             "status": err.get("status", "failed"),
+                             "http_status": resp.status,
+                             "error": err.get("error",
+                                              f"HTTP {resp.status}")},
+                            [])
+                terminal, chunks = None, []
+                while True:
+                    line = resp.readline()
+                    if not line:
+                        break
+                    event = json.loads(line)
+                    kind = event.get("event")
+                    if kind == "sweep_chunk":
+                        ch = wire.sweep_chunk_from_doc(event)
+                        chunks.append(ch)
+                        if on_chunk is not None:
+                            on_chunk(ch)
+                    elif kind == "sweep_result":
+                        terminal = event
+                if terminal is None:
+                    raise ConnectionDropped(
+                        f"sweep stream from {self.host}:{self.port} "
+                        f"ended before a terminal sweep_result line")
+                return terminal, chunks
             except (ConnectionError, http.client.HTTPException,
                     TimeoutError, OSError) as e:
                 raise ConnectionDropped(

@@ -31,7 +31,7 @@ import json
 import numpy as np
 
 from raft_tpu.serve.buckets import BucketSpec
-from raft_tpu.serve.engine import RequestResult
+from raft_tpu.serve.engine import RequestResult, SweepResult
 
 WIRE_VERSION = 1
 
@@ -116,7 +116,8 @@ def result_doc(res, include_xi=False):
         doc["std"] = std.tolist()
         doc["std_dtype"] = str(std.dtype)
         rep = res.solve_report or {}
-        for key in ("converged", "nonfinite"):
+        for key in ("converged", "nonfinite", "iters", "recovery_tier",
+                    "residual", "cond"):
             if key in rep:
                 doc[key] = np.asarray(rep[key]).tolist()
         if include_xi and res.Xi is not None:
@@ -141,8 +142,10 @@ def result_from_doc(doc, rid=None):
     if "std" in doc:
         std = np.asarray(doc["std"],
                          dtype=np.dtype(doc.get("std_dtype", "float64")))
-    report = {k: np.asarray(doc[k]) for k in ("converged", "nonfinite")
-              if k in doc}
+    report = {k: np.asarray(doc[k], dtype=dt) for k, dt in (
+        ("converged", np.bool_), ("nonfinite", np.bool_),
+        ("iters", None), ("recovery_tier", None),
+        ("residual", np.float64), ("cond", np.float64)) if k in doc}
     bucket = BucketSpec(**doc["bucket"]) if doc.get("bucket") else None
     return RequestResult(
         rid=doc["rid"] if rid is None else rid,
@@ -155,6 +158,152 @@ def result_from_doc(doc, rid=None):
         batch_requests=int(doc.get("batch_requests", 0)),
         batch_occupancy=float(doc.get("batch_occupancy", 0.0)),
         backend=doc.get("backend"),
+        replica=doc.get("replica"),
+    )
+
+
+# ------------------------------------------------------------- sweeps
+
+#: scalar metadata keys of a sweep chunk line (engine._finish_chunk)
+SWEEP_CHUNK_META = ("event", "rid", "chunk", "n_chunks", "designs",
+                    "wall_s", "suspend_s", "preemptions", "mode",
+                    "failed_idx", "failed_msg")
+
+#: per-design report arrays riding each chunk (PR 2 checkpoint schema)
+#: with the exact dtypes the engine aggregates under
+_SWEEP_ARRAY_DTYPES = (
+    ("converged", np.bool_), ("iters", np.int64),
+    ("nonfinite", np.bool_), ("recovery_tier", np.int64),
+    ("residual", np.float64), ("cond", np.float64),
+)
+
+
+def parse_sweep_request(doc):
+    """Validate a sweep request document -> (designs, cases, chunk).
+
+    Request::
+
+        {"designs": [<design dict | path str>, ...],  # required
+         "cases":  [...],                             # optional rows
+         "chunk": 8}                                  # optional override
+    """
+    if not isinstance(doc, dict):
+        raise WireError("sweep request must be a JSON object")
+    designs = doc.get("designs")
+    if not isinstance(designs, list) or not designs:
+        raise WireError("sweep request needs a non-empty 'designs' list")
+    for d in designs:
+        if not isinstance(d, (dict, str)):
+            raise WireError(
+                "every sweep design must be a design dict or a path "
+                "string")
+    cases = doc.get("cases")
+    if cases is not None and not isinstance(cases, list):
+        raise WireError("'cases' must be a list of case rows")
+    chunk = doc.get("chunk")
+    if chunk is not None:
+        try:
+            chunk = int(chunk)
+        except (TypeError, ValueError):
+            raise WireError("'chunk' must be an integer") from None
+    return designs, cases, chunk
+
+
+def sweep_chunk_doc(chunk):
+    """Engine chunk doc (numpy-backed, ``SweepHandle.chunks()``) -> wire
+    line.  Same bit-exactness contract as ``result_doc``: float repr
+    round-trips f64, so the decoded arrays are np.array_equal."""
+    doc = {k: chunk[k] for k in SWEEP_CHUNK_META if k in chunk}
+    if "Xi_r" in chunk:
+        Xi_r = np.asarray(chunk["Xi_r"])
+        doc["Xi_r"] = Xi_r.tolist()
+        doc["Xi_i"] = np.asarray(chunk["Xi_i"]).tolist()
+        doc["xi_dtype"] = str(Xi_r.dtype)
+        for key, _dt in _SWEEP_ARRAY_DTYPES:
+            doc[key] = np.asarray(chunk[key]).tolist()
+    return doc
+
+
+def sweep_chunk_from_doc(doc):
+    """Wire chunk line -> numpy-backed chunk doc (the engine's local
+    ``SweepHandle.chunks()`` shape, exact dtypes restored)."""
+    out = {k: doc[k] for k in SWEEP_CHUNK_META if k in doc}
+    if "Xi_r" in doc:
+        fdt = np.dtype(doc.get("xi_dtype", "float64"))
+        out["Xi_r"] = np.asarray(doc["Xi_r"], dtype=fdt)
+        out["Xi_i"] = np.asarray(doc["Xi_i"], dtype=fdt)
+        for key, dt in _SWEEP_ARRAY_DTYPES:
+            out[key] = np.asarray(doc[key], dtype=dt)
+    return out
+
+
+def sweep_result_doc(res):
+    """Terminal SweepResult -> wire line, deliberately WITHOUT the
+    aggregate arrays: on the streamed ``/v1/sweep`` route every chunk
+    already carried its slice, so the client reassembles
+    (``sweep_result_from_doc(doc, chunks=...)``) instead of paying the
+    payload twice."""
+    doc = {
+        "event": "sweep_result", "rid": res.rid, "status": res.status,
+        "n_designs": res.n_designs, "n_chunks": res.n_chunks,
+        "chunks_done": res.chunks_done,
+        "preemptions": res.preemptions,
+        "latency_s": round(res.latency_s, 4),
+        "suspend_s": round(res.suspend_s, 4),
+        "failed_idx": list(res.failed_idx),
+        "failed_msg": list(res.failed_msg),
+    }
+    if res.mode:
+        doc["mode"] = res.mode
+    if res.error:
+        doc["error"] = res.error
+    if res.replica is not None:
+        doc["replica"] = res.replica
+    return doc
+
+
+def sweep_result_from_doc(doc, chunks=None, rid=None):
+    """Terminal sweep line (+ the streamed, already-decoded chunk docs)
+    -> SweepResult, rebuilding the aggregate arrays bit-identically by
+    scattering each chunk's slice back into design order (rows no chunk
+    covered keep the sweep quarantine fills)."""
+    Xi_r = Xi_i = report = None
+    nd = int(doc.get("n_designs", 0))
+    for ch in chunks or []:
+        if "Xi_r" not in ch:
+            continue
+        arr_r = np.asarray(ch["Xi_r"])
+        if Xi_r is None:
+            shape = (nd,) + arr_r.shape[1:]
+            Xi_r = np.full(shape, np.nan, arr_r.dtype)
+            Xi_i = np.full(shape, np.nan, arr_r.dtype)
+            report = {
+                "converged": np.zeros(shape[:2], bool),
+                "iters": np.zeros(shape[:2], np.int64),
+                "nonfinite": np.zeros(shape[:2], bool),
+                "recovery_tier": np.zeros(shape[:2], np.int64),
+                "residual": np.full(shape[:2], np.nan, np.float64),
+                "cond": np.full(shape[:2], np.nan, np.float64),
+            }
+        sel = np.asarray(ch["designs"], int)
+        Xi_r[sel] = arr_r
+        Xi_i[sel] = np.asarray(ch["Xi_i"])
+        for key in report:
+            report[key][sel] = np.asarray(ch[key])
+    return SweepResult(
+        rid=doc["rid"] if rid is None else rid,
+        status=doc["status"],
+        n_designs=nd,
+        n_chunks=int(doc.get("n_chunks", 0)),
+        chunks_done=int(doc.get("chunks_done", 0)),
+        error=doc.get("error"),
+        Xi_r=Xi_r, Xi_i=Xi_i, report=report,
+        failed_idx=list(doc.get("failed_idx", [])),
+        failed_msg=list(doc.get("failed_msg", [])),
+        preemptions=int(doc.get("preemptions", 0)),
+        mode=doc.get("mode"),
+        latency_s=float(doc.get("latency_s", 0.0)),
+        suspend_s=float(doc.get("suspend_s", 0.0)),
         replica=doc.get("replica"),
     )
 

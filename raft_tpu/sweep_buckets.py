@@ -71,6 +71,40 @@ def sweep_buckets_enabled(explicit=None):
     ).strip().lower() in _TRUTHY
 
 
+def chunk_designs(n_designs, n_cases=None, chunk=None, rung=None):
+    """Split a sweep's design indices into megabatch-sized chunks for the
+    serve tier's continuous batcher (engine.submit_sweep).
+
+    ``chunk`` (explicit designs-per-chunk) wins; else the
+    ``RAFT_TPU_SERVE_SWEEP_CHUNK`` env knob (0 = auto); the auto rule
+    sizes a chunk so its flattened (design x case) lanes fill ``rung``
+    lanes (default: the top waterfall rung, waterfall.LANE_LADDER[-1])
+    — one chunk = one slab-resident fixed-shape program, the preemption
+    granularity.  A preemption-enabled engine passes a smaller ``rung``
+    so block walls (the interactive wait at a yield) stay short; chunk
+    size never changes bits (per-lane identity across rungs is the
+    waterfall ladder's contract).
+
+    Returns a list of contiguous design-index lists covering
+    ``range(n_designs)``."""
+    from raft_tpu.waterfall import LANE_LADDER
+
+    n_designs = int(n_designs)
+    if n_designs <= 0:
+        return []
+    if chunk is None:
+        try:
+            chunk = int(os.environ.get("RAFT_TPU_SERVE_SWEEP_CHUNK", 0))
+        except ValueError:
+            chunk = 0
+    chunk = int(chunk)
+    if chunk <= 0:
+        nc = max(int(n_cases), 1) if n_cases else 1
+        chunk = max(1, int(rung or LANE_LADDER[-1]) // nc)
+    return [list(range(s, min(s + chunk, n_designs)))
+            for s in range(0, n_designs, chunk)]
+
+
 def _record_bucket(physics, spec):
     """Record a dispatched bucket in the serve warm-up manifest (and
     drop the persistent-cache size/time thresholds so its executable

@@ -556,6 +556,7 @@ def _chunked_aero_dynamics(model0, cases, wind, aero_on, pitch_mean,
 
     nc = len(cases)
     nw = model0.nw
+    waterfall_flops = None     # set when the waterfall pipeline is live
     chunks = _overlap_case_chunks(wind, aero_on, overlap, nd_aero)
     barrier = chunks is None
     if barrier:
@@ -584,6 +585,7 @@ def _chunked_aero_dynamics(model0, cases, wind, aero_on, pitch_mean,
         from raft_tpu.waterfall import fused_waterfall_pipeline
 
         pipeline = fused_waterfall_pipeline(model0, return_xi)
+        waterfall_flops = 0.0
     else:
         pipeline = _dynamics_pipeline(model0, return_xi)
     backend = jax.default_backend()
@@ -608,6 +610,13 @@ def _chunked_aero_dynamics(model0, cases, wind, aero_on, pitch_mean,
         h = tracer.begin("dynamics", backend=backend, chunk=k,
                          cases=len(ci))
         dyn = pipeline(*dev_args)      # async dispatch: host continues
+        if waterfall_flops is not None:
+            # the waterfall pipeline is a synchronous host loop over
+            # jitted phase programs: harvest its executed-flops ledger
+            # per call (a single compiled cost model does not exist)
+            from raft_tpu.waterfall import last_dispatch_stats
+            waterfall_flops += float(
+                last_dispatch_stats().get("flops_executed", 0.0))
         inflight.append((ci, dev_args, dyn, h))
 
     parts = []
@@ -617,10 +626,9 @@ def _chunked_aero_dynamics(model0, cases, wind, aero_on, pitch_mean,
         parts.append((ci, _unpack_dyn(dyn, nd_flat, len(ci), return_xi,
                                       nw)))
     t_engine = time.perf_counter() - t_engine0
-    dyn_flops = sum(
-        compiled_flops(pipeline, dev_args)
-        for _, dev_args, _, _ in inflight
-    )
+    dyn_flops = (waterfall_flops if waterfall_flops is not None else
+                 sum(compiled_flops(pipeline, dev_args)
+                     for _, dev_args, _, _ in inflight))
 
     # merge chunk columns back into [nd_flat, nc] order
     sol = {}

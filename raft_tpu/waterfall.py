@@ -42,8 +42,23 @@ The health-ladder retry tiers (sweep.SolveRetryPolicy) and the
 The mode is part of the serve cache's executable flags
 (raft_tpu/serve/cache.py), so executables compiled under a different
 fixed-point mode are refused, never silently mixed.
+
+**Preemption (PR 11):** the block boundaries double as preemption
+points for the serve tier's two-level scheduler.  ``waterfall_dispatch``
+accepts a ``should_yield`` callable, polled after every block: when it
+returns True while lanes survive, the dispatch suspends — the survivors'
+loop state (XiLast, iteration counters, done mask), lane ids, operands
+and the per-lane retirement store are pulled to host NumPy and returned
+as a :class:`SuspendedWaterfall`, and a later
+``waterfall_dispatch(resume=...)`` re-injects them and continues.  The
+host round-trip is exact (f64 copies, no arithmetic) and every resumed
+block is the same canonical fixed-shape program the uninterrupted run
+would have executed with the same scheduler state, so a
+preempted-and-resumed dispatch is ``np.array_equal``-identical to an
+uninterrupted one (pinned in tests/test_serve_sweep.py).
 """
 
+import dataclasses
 import os
 from functools import lru_cache
 
@@ -187,9 +202,67 @@ def _phase_pipelines(physics, relax, block, kernel, shared_nodes=False):
             jax.jit(vmap8(finalize_one)))
 
 
+@dataclasses.dataclass
+class SuspendedWaterfall:
+    """A waterfall dispatch parked at a block boundary (``should_yield``
+    fired with survivors remaining).  Everything is host NumPy — exact
+    f64 copies of the device state, so resuming reproduces the
+    uninterrupted run's bits.  Pass back to
+    ``waterfall_dispatch(resume=...)``; a suspended object is consumed by
+    that call (its retirement store is shared, not copied) and must not
+    be resumed twice."""
+
+    physics: object                 # SlotPhysics of the phase programs
+    relax: float
+    block: int                      # K iterations per block
+    kernel: bool
+    shared_nodes: bool
+    L: int                          # real lane count
+    Lq: int                         # original padded rung
+    nodes_p: object                 # host node bundle at the original rung
+    operands_full: tuple            # host operands at the original rung
+    nodes_cur: object               # host node bundle at the current rung
+    operands: tuple                 # host operands at the current rung
+    state: tuple                    # host loop-state leaves, current rung
+    ids: np.ndarray                 # row -> original lane id (-1 padding)
+    state_store: list               # per-lane retired states (shared ref)
+    trips: int
+    blocks: int
+    lane_iters: int
+    rungs: list
+    yields: int = 1
+    flops: float = 0.0              # executed-flops ledger so far
+
+    @property
+    def survivors(self):
+        """Lanes still iterating (what a resume pays for)."""
+        return int((self.ids >= 0).sum())
+
+
 # engine stats of the most recent dispatch (bench/test introspection):
 # populated by waterfall_dispatch, read via last_dispatch_stats()
 _LAST_STATS = {}
+
+# XLA cost-model flops per (phase program, operand shapes) — the
+# executed-flops ledger behind ``flops_executed`` in the dispatch stats.
+# The waterfall is a host loop over jitted phase programs, so the
+# monolithic pipeline's single compiled cost model does not exist here;
+# summing the per-block program costs as blocks execute replaces it.
+_FLOPS_CACHE = {}
+
+
+def _fn_flops(fn, args):
+    """Memoized cost-model flops of one jitted phase program at these
+    operand shapes (0.0 when the backend reports no costs — an
+    utilization estimate, same contract as ``compiled_flops``)."""
+    from raft_tpu.utils.profiling import compiled_flops
+
+    key = (id(fn),) + tuple(
+        (tuple(np.shape(leaf)), str(getattr(leaf, "dtype", "")))
+        for leaf in jax.tree.leaves(args))
+    if key not in _FLOPS_CACHE:
+        _FLOPS_CACHE[key] = compiled_flops(fn, args)
+    return _FLOPS_CACHE[key]
 
 
 def last_dispatch_stats():
@@ -203,7 +276,8 @@ def last_dispatch_stats():
 
 def waterfall_dispatch(physics, nodes_slots, args_slots, relax=0.8,
                        block=None, kernel=None, slab=None,
-                       shared_nodes=False):
+                       shared_nodes=False, should_yield=None,
+                       resume=None):
     """Run flattened (design x case) lanes through the iteration
     waterfall.
 
@@ -224,17 +298,33 @@ def waterfall_dispatch(physics, nodes_slots, args_slots, relax=0.8,
         lanes (vmapped with in_axes None) — bit-identical to the Model's
         closed-over-nodes case pipeline; the default per-lane node axis
         matches the serve slot executables and the sweep pipelines
+    should_yield : zero-arg callable polled after every K-iteration
+        block; returning True while lanes survive suspends the dispatch
+        and returns a :class:`SuspendedWaterfall` instead of results
+        (the serve tier's preemption point).  Requires the megabatch to
+        fit one slab (``L <= slab``).
+    resume : a :class:`SuspendedWaterfall` to continue instead of
+        starting fresh (``physics``/``nodes_slots``/``args_slots`` are
+        ignored — the suspended object carries everything).
 
     Returns ``(xr [L, 6, nw], xi, report)`` numpy-backed outputs in the
     caller's lane order, per-lane bit-identical to the legacy monolithic
-    dispatch of the same lanes.
+    dispatch of the same lanes — whether or not the dispatch was
+    suspended and resumed along the way.
     """
+    if resume is not None:
+        return _waterfall_resume(resume, should_yield)
     if kernel is None:
         kernel = fixed_point_mode() == "fused"
     K = int(block) if block else block_iters()
     S = int(slab) if slab else LANE_LADDER[-1]
     L = int(args_slots[0].shape[0])
     if L > S:
+        if should_yield is not None:
+            raise ValueError(
+                f"should_yield requires the megabatch to fit one slab "
+                f"({L} lanes > slab {S}); size sweep chunks within a "
+                "slab or raise `slab`")
         outs, agg = [], None
         for s0 in range(0, L, S):
             sl = slice(s0, min(s0 + S, L))
@@ -250,7 +340,7 @@ def waterfall_dispatch(physics, nodes_slots, args_slots, relax=0.8,
                 agg["rungs"] = list(st["rungs"])
             else:
                 for key in ("n_lanes", "blocks", "lane_iters_executed",
-                            "lane_iters_monolithic"):
+                            "lane_iters_monolithic", "flops_executed"):
                     agg[key] += st[key]
                 agg["rungs"] += st["rungs"]
         _LAST_STATS.clear()
@@ -269,21 +359,50 @@ def waterfall_dispatch(physics, nodes_slots, args_slots, relax=0.8,
     args_p = tuple(_pad_rows(jnp.asarray(a), Lq) for a in args_slots)
 
     u, Fr, Fi, state = prelude_fn(nodes_p, *args_p)
+    flops = _fn_flops(prelude_fn, (nodes_p,) + args_p)
     C_p, M_p, B_p = args_p[2:5]
-    nodes_cur = nodes_p
     operands = (u, C_p, M_p, B_p, Fr, Fi)
-    operands_full = operands                 # original order, for finalize
 
-    max_trips = int(physics.nIter) + 1
     # host-side waterfall bookkeeping: row -> original lane id (-1 = inert
     # padding), per-lane final-state store filled as lanes retire
     ids = np.concatenate(
         [np.arange(L), np.full(Lq - L, -1, np.int64)])
-    state_store = None
-    trips = 0
-    blocks = 0
-    lane_iters = 0
-    rungs = []
+    return _waterfall_loop(
+        physics, float(relax), K, bool(kernel), bool(shared_nodes),
+        L, Lq, nodes_p, operands, nodes_p, operands, state, ids,
+        None, 0, 0, 0, [], 0, block_fn, finalize_fn, should_yield,
+        flops)
+
+
+def _waterfall_resume(sus, should_yield=None):
+    """Re-enter the waterfall loop from a :class:`SuspendedWaterfall`.
+    The host -> device round-trip is exact, so the continued trajectory
+    is bit-identical to never having suspended."""
+    _prelude_fn, block_fn, finalize_fn = _phase_pipelines(
+        sus.physics, sus.relax, sus.block, sus.kernel, sus.shared_nodes)
+    nodes_p = jax.tree.map(jnp.asarray, sus.nodes_p)
+    operands_full = tuple(jnp.asarray(a) for a in sus.operands_full)
+    nodes_cur = nodes_p if sus.shared_nodes \
+        else jax.tree.map(jnp.asarray, sus.nodes_cur)
+    operands = tuple(jnp.asarray(a) for a in sus.operands)
+    state = tuple(jnp.asarray(a) for a in sus.state)
+    return _waterfall_loop(
+        sus.physics, sus.relax, sus.block, sus.kernel, sus.shared_nodes,
+        sus.L, sus.Lq, nodes_p, operands_full, nodes_cur, operands,
+        state, np.array(sus.ids), sus.state_store, sus.trips,
+        sus.blocks, sus.lane_iters, list(sus.rungs), sus.yields,
+        block_fn, finalize_fn, should_yield, sus.flops)
+
+
+def _waterfall_loop(physics, relax, K, kernel, shared_nodes, L, Lq,
+                    nodes_p, operands_full, nodes_cur, operands, state,
+                    ids, state_store, trips, blocks, lane_iters, rungs,
+                    yields, block_fn, finalize_fn, should_yield,
+                    flops=0.0):
+    """The block/retire/compact loop shared by fresh and resumed
+    dispatches — one code path, so suspension cannot change the
+    scheduler's decisions (same rung sequence, same retire trips)."""
+    max_trips = int(physics.nIter) + 1
 
     def _store(state_dev, rows, lanes):
         nonlocal state_store
@@ -298,6 +417,7 @@ def waterfall_dispatch(physics, nodes_slots, args_slots, relax=0.8,
     while True:
         rungs.append(len(ids))
         state = block_fn(nodes_cur, *operands, state)
+        flops += _fn_flops(block_fn, (nodes_cur,) + operands + (state,))
         blocks += 1
         trips += K
         lane_iters += len(ids) * K
@@ -311,21 +431,37 @@ def waterfall_dispatch(physics, nodes_slots, args_slots, relax=0.8,
         if survivors.size == 0:
             break
         Ln = ladder_lanes(survivors.size)
-        if Ln >= len(ids):
-            # no smaller rung to compact into: keep riding the current
-            # fixed-shape program (converged lanes freeze via cond)
-            continue
-        rows = np.concatenate(
-            [survivors,
-             np.full(Ln - survivors.size, survivors[0], np.int64)])
-        idx = jnp.asarray(rows)
-        take = lambda a: jnp.take(a, idx, axis=0)  # noqa: E731
-        operands = tuple(jax.tree.map(take, op) for op in operands)
-        if not shared_nodes:
-            nodes_cur = jax.tree.map(take, nodes_cur)
-        state = jax.tree.map(take, state)
-        ids = np.concatenate(
-            [ids[survivors], np.full(Ln - survivors.size, -1, np.int64)])
+        if Ln < len(ids):
+            rows = np.concatenate(
+                [survivors,
+                 np.full(Ln - survivors.size, survivors[0], np.int64)])
+            idx = jnp.asarray(rows)
+            take = lambda a: jnp.take(a, idx, axis=0)  # noqa: E731
+            operands = tuple(jax.tree.map(take, op) for op in operands)
+            if not shared_nodes:
+                nodes_cur = jax.tree.map(take, nodes_cur)
+            state = jax.tree.map(take, state)
+            ids = np.concatenate(
+                [ids[survivors],
+                 np.full(Ln - survivors.size, -1, np.int64)])
+        # else: no smaller rung to compact into — keep riding the current
+        # fixed-shape program (converged lanes freeze via cond)
+        if should_yield is not None and should_yield():
+            # preemption point: park the survivors' state host-side
+            # (exact copies; resuming continues the identical trajectory)
+            return SuspendedWaterfall(
+                physics=physics, relax=relax, block=K, kernel=kernel,
+                shared_nodes=shared_nodes, L=L, Lq=Lq,
+                nodes_p=jax.tree.map(np.asarray, nodes_p),
+                operands_full=tuple(
+                    np.asarray(a) for a in operands_full),
+                nodes_cur=(None if shared_nodes
+                           else jax.tree.map(np.asarray, nodes_cur)),
+                operands=tuple(np.asarray(a) for a in operands),
+                state=tuple(np.asarray(leaf) for leaf in state),
+                ids=np.array(ids), state_store=state_store,
+                trips=trips, blocks=blocks, lane_iters=lane_iters,
+                rungs=list(rungs), yields=yields + 1, flops=flops)
 
     # scatter the retired per-lane loop states back into original lane
     # order (exact: no arithmetic touches a state after its lane's last
@@ -335,13 +471,16 @@ def waterfall_dispatch(physics, nodes_slots, args_slots, relax=0.8,
         jnp.asarray(_pad_rows(jnp.asarray(buf), Lq))
         for buf in state_store)
     xr, xi, report = finalize_fn(nodes_p, *operands_full, state_full)
+    flops += _fn_flops(finalize_fn,
+                       (nodes_p,) + tuple(operands_full) + (state_full,))
 
     _LAST_STATS.clear()
     _LAST_STATS.update(
         n_lanes=L, blocks=blocks, rungs=rungs,
         lane_iters_executed=lane_iters,
         lane_iters_monolithic=trips * Lq,
-        block_iters=K, kernel=bool(kernel),
+        block_iters=K, kernel=bool(kernel), yields=yields,
+        flops_executed=float(flops),
     )
 
     take = lambda a: np.asarray(a)[:L]  # noqa: E731

@@ -153,6 +153,22 @@ def test_serve_http_section_pinned_in_compact_schema():
         assert key in bench._COMPACT_KEYS, key
 
 
+def test_serve_sweep_section_pinned_in_compact_schema():
+    """The continuous-batching bench section (PR 11) stays wired: both
+    entry points exist and the headline keys — the engine-vs-direct
+    wall ratio, the preempt-on/off loaded p95 ratios, and the
+    preempted-sweep bit-identity verdict — ride the compact driver
+    line."""
+    assert callable(bench.bench_serve_sweep)
+    assert callable(bench.bench_serve_sweep_smoke)
+    for key in ("serve_sweep_engine_vs_direct",
+                "serve_sweep_p95_ratio_off", "serve_sweep_p95_ratio_on",
+                "serve_sweep_preemptions", "serve_sweep_bits_identical",
+                "smoke_sweep_bits", "sweep_fixed_point_mode",
+                "serve_sweep_error", "serve_sweep_smoke_error"):
+        assert key in bench._COMPACT_KEYS, key
+
+
 def test_sanitizer_covers_serve_http_values():
     out = {
         "serve_http_overhead_ms": 1.66,

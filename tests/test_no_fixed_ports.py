@@ -9,6 +9,9 @@ Python sources for the three ways a fixed port sneaks in:
 * an address tuple with a nonzero literal port: ``("127.0.0.1", 8080)``
 * a keyword/default: ``port=8080`` (``port=0`` is the sanctioned idiom)
 * the CLI flag with a nonzero literal: ``"--http", "8080"``
+* an endpoint string with a nonzero literal port:
+  ``"127.0.0.1:8080"`` (the ``engine_endpoint`` / router replica
+  address form — build it from a transport's read-back ``port``)
 
 A line may opt out with ``# port-lint: allow`` (none currently do).
 """
@@ -24,6 +27,8 @@ _PATTERNS = [
                r"""["']\s*,\s*(\d+)\s*\)"""),
     re.compile(r"""\b(?:port|http_port)\s*=\s*(\d+)"""),
     re.compile(r"""["']--http["']\s*,\s*["'](\d+)["']"""),
+    re.compile(r"""["'](?:127\.0\.0\.1|0\.0\.0\.0|localhost|\[::1?\])"""
+               r""":(\d+)["']"""),
 ]
 
 _ALLOW = "# port-lint: allow"

@@ -1,0 +1,276 @@
+"""Continuous lane-level batching (engine.submit_sweep): sweeps as
+first-class served requests with priority preemption.
+
+The contract under test is PR 11's acceptance criteria: a sweep
+preempted at waterfall block boundaries and resumed later is
+``np.array_equal``-identical to the same sweep run uninterrupted
+(including an in-graph NaN-quarantined lane); the aging rule bounds how
+long interactive load can delay a chunk, so sweeps never starve; the
+streamed ``/v1/sweep`` wire chunks reassemble to the in-process bits;
+and one design whose prep raises is quarantined alone — its sweep-mates
+still serve.
+
+Every server here binds port 0 and reads the assigned port back
+(tests/test_no_fixed_ports.py keeps it that way).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from raft_tpu.designs import deep_spar
+from raft_tpu.serve import Engine, EngineConfig, WireClient, serve_http, wire
+from raft_tpu.sweep_buckets import chunk_designs
+
+NW = (0.05, 0.5)    # small frequency grid keeps compiles cheap
+
+
+def _spar(rho_fill=1800.0):
+    d = deep_spar(n_cases=2, nw_settings=NW)
+    d["platform"]["members"][0]["rho_fill"] = [float(rho_fill), 0.0, 0.0]
+    return d
+
+
+def _bits_equal(a, b):
+    return (np.array_equal(a.Xi_r, b.Xi_r)
+            and np.array_equal(a.Xi_i, b.Xi_i)
+            and all(np.array_equal(a.report[k], b.report[k])
+                    for k in a.report))
+
+
+# ------------------------------------------------------------- chunking
+
+def test_chunk_designs_auto_explicit_and_rung(monkeypatch):
+    from raft_tpu.waterfall import LANE_LADDER
+
+    monkeypatch.delenv("RAFT_TPU_SERVE_SWEEP_CHUNK", raising=False)
+    assert chunk_designs(0) == []
+    assert chunk_designs(5, chunk=2) == [[0, 1], [2, 3], [4]]
+    # auto fills the top rung with (design x case) lanes
+    top = LANE_LADDER[-1]
+    assert chunk_designs(3 * top, n_cases=2)[0] == list(range(top // 2))
+    # a preemption-enabled engine passes a smaller target rung
+    assert chunk_designs(64, n_cases=2, rung=32)[0] == list(range(16))
+    # the env knob beats auto, an explicit chunk beats the env knob
+    monkeypatch.setenv("RAFT_TPU_SERVE_SWEEP_CHUNK", "3")
+    assert chunk_designs(7)[:2] == [[0, 1, 2], [3, 4, 5]]
+    assert chunk_designs(7, chunk=4)[0] == [0, 1, 2, 3]
+
+
+# ----------------------------------------------------------- wire schema
+
+def test_sweep_wire_chunk_and_result_roundtrip():
+    from raft_tpu.serve.engine import SweepResult
+
+    rng = np.random.default_rng(7)
+    chunk = {
+        "event": "sweep_chunk", "rid": 3, "chunk": 0, "n_chunks": 2,
+        "designs": [0, 1], "wall_s": 0.5, "suspend_s": 0.1,
+        "preemptions": 2, "mode": "waterfall",
+        "failed_idx": [], "failed_msg": [],
+        "Xi_r": rng.standard_normal((2, 2, 6, 4)),
+        "Xi_i": rng.standard_normal((2, 2, 6, 4)),
+        "converged": np.array([[True, False], [True, True]]),
+        "iters": np.array([[4, 9], [5, 5]], np.int64),
+        "nonfinite": np.zeros((2, 2), bool),
+        "recovery_tier": np.zeros((2, 2), np.int64),
+        "residual": rng.standard_normal((2, 2)),
+        "cond": rng.standard_normal((2, 2)),
+    }
+    line = wire.dumps(wire.sweep_chunk_doc(chunk))
+    back = wire.sweep_chunk_from_doc(json.loads(line))
+    for k in ("Xi_r", "Xi_i", "converged", "iters", "nonfinite",
+              "recovery_tier", "residual", "cond"):
+        assert np.array_equal(back[k], chunk[k]), k
+        assert back[k].dtype == np.asarray(chunk[k]).dtype, k
+    assert back["designs"] == [0, 1] and back["mode"] == "waterfall"
+
+    res = SweepResult(rid=3, status="ok", n_designs=2, n_chunks=2,
+                      chunks_done=2, preemptions=2, mode="waterfall",
+                      latency_s=1.25, suspend_s=0.1)
+    tdoc = json.loads(wire.dumps(wire.sweep_result_doc(res)))
+    assert "Xi_r" not in tdoc     # chunks carry the payload, not the tail
+    rebuilt = wire.sweep_result_from_doc(tdoc, chunks=[chunk, chunk])
+    assert rebuilt.status == "ok" and rebuilt.preemptions == 2
+    assert rebuilt.Xi_r.shape == (2, 2, 6, 4)
+
+
+def test_parse_sweep_request_validation():
+    with pytest.raises(wire.WireError, match="non-empty 'designs'"):
+        wire.parse_sweep_request({"designs": []})
+    with pytest.raises(wire.WireError, match="design dict or a path"):
+        wire.parse_sweep_request({"designs": [7]})
+    with pytest.raises(wire.WireError, match="'chunk' must be"):
+        wire.parse_sweep_request({"designs": [{}], "chunk": "soon"})
+    designs, cases, chunk = wire.parse_sweep_request(
+        {"designs": [{}, "d.yaml"], "chunk": "4"})
+    assert len(designs) == 2 and cases is None and chunk == 4
+
+
+# ---------------------------------------------------------------- engine
+
+@pytest.fixture(scope="module")
+def swept(tmp_path_factory):
+    """One preemption-enabled engine shared by the module (compiles
+    once): an uninterrupted reference sweep — one design carries an
+    in-graph NaN (poisoned wave height, the quarantine path) — then the
+    same sweep under sustained interactive load, the streamed chunk
+    docs, and the /v1/sweep wire reassembly of the reference."""
+    designs = [_spar(1800.0), _spar(1500.0), _spar(1200.0),
+               _spar(1000.0)]
+    designs[2]["cases"]["data"][0][7] = float("nan")   # wave_height NaN
+    base = _spar(1700.0)
+    tmp = tmp_path_factory.mktemp("serve_sweep")
+    out = {"designs": designs}
+    with Engine(EngineConfig(precision="float64", window_ms=5.0,
+                             cache_dir=str(tmp),
+                             preempt=True)) as eng:
+        out["warm"] = eng.evaluate(base, timeout=600)
+        # no interactive load -> the yield predicate never fires: this
+        # IS the uninterrupted reference
+        out["ref"] = eng.submit_sweep(designs, chunk=2).result(600)
+
+        h = eng.submit_sweep(designs, chunk=2)
+        out["stream"] = list(h.chunks(timeout=600))
+        out["stream_result"] = h.result(600)
+
+        h = eng.submit_sweep(designs, chunk=2)
+        probes = []
+        while not h.done():
+            probes.append(eng.evaluate(base, timeout=600))
+        out["loaded"] = h.result(600)
+        out["probes"] = probes
+        out["snap"] = eng.snapshot()
+
+        transport = serve_http(eng, port=0)
+        try:
+            client = WireClient("127.0.0.1", transport.port)
+            streamed = []
+            terminal, chunks = client.sweep(
+                {"designs": designs, "chunk": 2},
+                on_chunk=lambda ch: streamed.append(ch["chunk"]))
+            out["http"] = (terminal, chunks, streamed)
+        finally:
+            transport.close()
+    return out
+
+
+def test_sweep_reference_serves_with_nan_lane_quarantined(swept):
+    ref = swept["ref"]
+    assert ref.status == "ok" and ref.n_chunks == 2
+    assert ref.preemptions == 0          # nothing queued -> no yields
+    # the poisoned design's lane is flagged, frozen finite, and its
+    # sweep-mates converge untouched
+    assert ref.report["nonfinite"][2].any()
+    assert np.isfinite(ref.Xi_r).all() and np.isfinite(ref.Xi_i).all()
+    assert ref.report["converged"][[0, 1, 3]].all()
+    assert not ref.failed_idx
+
+
+def test_chunk_stream_schema_and_order(swept):
+    stream = swept["stream"]
+    assert [ch["chunk"] for ch in stream] == [0, 1]
+    assert all(ch["n_chunks"] == 2 for ch in stream)
+    assert stream[0]["designs"] == [0, 1]
+    assert stream[1]["designs"] == [2, 3]
+    ref = swept["ref"]
+    for ch in stream:
+        sel = np.asarray(ch["designs"], int)
+        assert np.array_equal(ch["Xi_r"], ref.Xi_r[sel])
+        assert np.array_equal(ch["Xi_i"], ref.Xi_i[sel])
+    assert _bits_equal(swept["stream_result"], ref)
+
+
+def test_preempted_sweep_bit_identical_to_uninterrupted(swept):
+    """PR 11 acceptance: preempt at block boundaries, suspend lane
+    state host-side, resume later — and the result (NaN-quarantined
+    lane included) is np.array_equal-identical to the uninterrupted
+    run."""
+    loaded = swept["loaded"]
+    assert loaded.status == "ok"
+    assert loaded.preemptions >= 1
+    assert swept["snap"]["sweep_preemptions"] >= loaded.preemptions
+    assert _bits_equal(loaded, swept["ref"])
+    # the interactive probes that preempted it all served, bit-equal to
+    # the unloaded warm-up of the same design
+    for p in swept["probes"]:
+        assert p.status == "ok"
+        assert np.array_equal(p.Xi, swept["warm"].Xi)
+
+
+def test_http_sweep_stream_reassembles_to_engine_bits(swept):
+    terminal, chunks, streamed = swept["http"]
+    assert terminal["status"] == "ok" and streamed == [0, 1]
+    res = wire.sweep_result_from_doc(terminal, chunks=chunks)
+    assert _bits_equal(res, swept["ref"])
+
+
+def test_prep_raiser_quarantined_without_failing_sweep_mates(tmp_path):
+    healthy = _spar(1600.0)
+    raiser = _spar(1400.0)
+    del raiser["mooring"]                            # prep KeyError
+    with Engine(EngineConfig(precision="float64", window_ms=5.0,
+                             cache_dir=str(tmp_path))) as eng:
+        res = eng.submit_sweep([healthy, raiser], chunk=2).result(600)
+        solo = eng.evaluate(healthy, timeout=600)
+    assert res.status == "ok"
+    assert res.failed_idx == [1] and "KeyError" in res.failed_msg[0]
+    # quarantine fill on the failed row, served bits on its mate
+    assert np.isnan(res.Xi_r[1]).all()
+    assert np.array_equal(res.Xi_r[0] + 1j * res.Xi_i[0], solo.Xi)
+
+
+def test_aging_rule_stops_yielding_after_age_budget(swept,
+                                                    tmp_path_factory):
+    """preempt_age_s = 0: the chunk's suspension budget is exhausted
+    from the start, so sustained interactive load never preempts —
+    sweeps cannot starve — and the bits still match the reference."""
+    tmp = tmp_path_factory.mktemp("serve_sweep_age")
+    base = _spar(1700.0)
+    with Engine(EngineConfig(precision="float64", window_ms=5.0,
+                             cache_dir=str(tmp), preempt=True,
+                             preempt_age_s=0.0)) as eng:
+        eng.evaluate(base, timeout=600)
+        h = eng.submit_sweep(swept["designs"], chunk=2)
+        while not h.done():
+            assert eng.evaluate(base, timeout=600).status == "ok"
+        res = h.result(600)
+    assert res.status == "ok"
+    assert res.preemptions == 0
+    assert res.suspend_s == 0.0
+    assert _bits_equal(res, swept["ref"])
+
+
+# ----------------------------------------------------- omdao engine mode
+
+def test_omdao_engine_mode_solver_matches_slotted_dispatch(swept,
+                                                           tmp_path):
+    """The OpenMDAO component's engine mode delegates the batched
+    device solve to a running engine; the metrics must be bit-identical
+    to the engine's canonical slotted program dispatched locally."""
+    from raft_tpu.model import Model
+    from raft_tpu.omdao import RAFT_OMDAO
+
+    d = swept["designs"][0]
+    with Engine(EngineConfig(precision="float64", window_ms=5.0,
+                             cache_dir=str(tmp_path))) as eng:
+        solver = RAFT_OMDAO._engine_solver(None, eng, None, {})
+        m_eng = Model(d, precision="float64")
+        m_eng.analyze_unloaded()
+        m_eng.analyze_cases(solver=solver)
+
+        m_loc = Model(d, precision="float64",
+                      slots=eng.bucket_for(d))
+        m_loc.analyze_unloaded()
+        m_loc.analyze_cases()
+
+        # engine modes refuse what they cannot delegate
+        with pytest.raises(NotImplementedError):
+            RAFT_OMDAO._engine_solver(None, eng, None,
+                                      {"trim_ballast": True})
+    assert np.array_equal(m_eng.Xi, m_loc.Xi)
+    for name in ("converged", "iters", "residual"):
+        assert np.array_equal(
+            np.asarray(getattr(m_eng.solve_report, name)),
+            np.asarray(getattr(m_loc.solve_report, name))), name
