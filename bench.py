@@ -105,6 +105,10 @@ _COMPACT_KEYS = (
     "kernel_gj6_max_abs_diff", "kernel_gjstage_speedup",
     "kernel_gjstage_max_abs_diff",
     "sweep_cold_start_s", "sweep_warm_start_s", "sweep_warm_vs_cold",
+    "sweep_prep_wall_s", "sweep_prep_solo_wall_s", "sweep_prep_batched",
+    "sweep_prep_speedup", "sweep_prep_bits_identical",
+    "serve_cold_prep_p50_ms", "serve_cold_prep_solo_p50_ms",
+    "smoke_prep_ratio", "smoke_prep_bits",
     "rao_error", "sweep_error", "sweep243_error", "bem_error",
     "bem_sharded_error", "grad_error", "serve_error",
     "chaos_smoke_error", "kernel_error", "sweep_warm_error",
@@ -113,6 +117,7 @@ _COMPACT_KEYS = (
     "sweep_waterfall_error",
     "perf_docs_error", "sweep_scaling_error", "sweep1024_error",
     "sweep4096_error", "serve_multichip_error", "multichip_smoke_error",
+    "prep_error", "prep_smoke_error",
 )
 
 
@@ -387,6 +392,7 @@ def main(argv=None):
                     ("serve_http_smoke", bench_serve_http_smoke),
                     ("serve_sweep_smoke", bench_serve_sweep_smoke),
                     ("chaos_smoke", bench_chaos_smoke),
+                    ("prep_smoke", bench_batched_prep_smoke),
                     ("multichip_smoke", bench_multichip_smoke),
                     ("kernel", lambda: bench_kernels(
                         gj6_batch=128, stage_n=128, stage_block=64,
@@ -449,6 +455,7 @@ def main(argv=None):
             ("serve_multichip", bench_serve_multichip, 0.5),
             ("kernel", bench_kernels, 0.5),
             ("sweep_warm", bench_sweep_warm, 4.0),
+            ("prep", bench_batched_prep, 3.0),
         ]
 
     out = {}
@@ -1707,6 +1714,138 @@ def bench_sweep_warm():
         "sweep_warm_cache_hits": warm["cache_hits"],
         "sweep_warm_vs_cold": round(
             cold["sweep_s"] / max(t_warm, 1e-9), 2),
+    }
+
+
+# ----------------------------------------------------------- batched prep
+
+def _prep_family_designs(n, nw=(0.05, 0.5), n_cases=2):
+    """One rho_fill family of n deep-spar variants (same branch
+    signatures -> one traced prep program covers all of them)."""
+    import copy
+
+    from raft_tpu.designs import deep_spar
+
+    base = deep_spar(n_cases=n_cases, nw_settings=nw)
+    designs = []
+    for i in range(n):
+        d = copy.deepcopy(base)
+        d["platform"]["members"][0]["rho_fill"] = [
+            1000.0 + 800.0 * i / max(n - 1, 1), 0.0, 0.0]
+        designs.append(d)
+    return designs
+
+
+def _prep_bits_identical(family, lanes):
+    """Solo == batched bits: lane 0 through a batch of 1 must equal lane
+    0 inside the full batch, array for array (the PR's house recipe —
+    same fixed-block program, composition-independent lanes)."""
+    solo = family.prepare([lanes[0]])[0]
+    both = family.prepare(list(lanes))[0]
+    if not np.array_equal(np.asarray(solo[1].r), np.asarray(both[1].r)):
+        return False
+    return all(np.array_equal(np.asarray(a), np.asarray(b))
+               for a, b in zip(solo[2], both[2]))
+
+
+def bench_batched_prep(n_designs=256, n_serve=16, solo_limit=32):
+    """A/B the sweep prep wall: the legacy per-design host loop (Model
+    build + prepare_case_inputs per point, what run_sweep pays flag-off)
+    against the batched traced path (RAFT_TPU_BATCHED_PREP) on one
+    rho_fill family — the production units themselves
+    (sweep._prepare_chunk solo vs family) — plus the served
+    cold-request prep p50 through the engine's own ``_prepare`` with
+    the flag on vs off.  The solo baseline is timed over ``solo_limit``
+    designs and scaled linearly (per-design cost is constant), like the
+    sweep section's serial-NumPy baseline."""
+    from raft_tpu.batched_prep import PrepFamily
+    from raft_tpu.serve.engine import Engine, EngineConfig, Request
+    from raft_tpu.sweep import _prepare_chunk
+
+    designs = _prep_family_designs(n_designs)
+    apply_pt = lambda d, pt: pt   # noqa: E731 — points ARE designs
+    base = designs[0]
+
+    # legacy loop: the exact solo unit, timed subset scaled to n_designs
+    n_timed = min(n_designs, solo_limit)
+    t0 = time.perf_counter()
+    _, failed, _ = _prepare_chunk(base, designs[:n_timed], apply_pt,
+                                  "float64", 0, None)
+    solo_wall = (time.perf_counter() - t0) * n_designs / n_timed
+    assert not failed, f"solo prep quarantined {len(failed)} designs"
+
+    # batched: family build + trace warm once (off the steady-state
+    # path), then the same designs through the traced program
+    family = PrepFamily(base, precision="float64")
+    family.prepare([family.extract(base)] * family.block)   # warm
+    t0 = time.perf_counter()
+    _, failed, n_batched = _prepare_chunk(base, designs, apply_pt,
+                                          "float64", 0, family)
+    bp_wall = time.perf_counter() - t0
+    assert not failed, f"batched prep quarantined {len(failed)} designs"
+
+    bits = _prep_bits_identical(
+        family, [family.extract(d) for d in designs[:family.block]])
+
+    # served cold prep: per-request prep latency through Engine._prepare
+    # (fresh designs, no disk cache), flag off vs on
+    def cold_ms(flag):
+        saved = os.environ.get("RAFT_TPU_BATCHED_PREP")
+        os.environ["RAFT_TPU_BATCHED_PREP"] = flag
+        try:
+            times = []
+            with Engine(EngineConfig(precision="float64",
+                                     use_prep_cache=False)) as eng:
+                for i, d in enumerate(_prep_family_designs(n_serve)):
+                    t0 = time.perf_counter()
+                    eng._prepare(Request(design=d, rid=i))
+                    times.append(time.perf_counter() - t0)
+            return 1e3 * float(np.percentile(times, 50))
+        finally:
+            if saved is None:
+                os.environ.pop("RAFT_TPU_BATCHED_PREP", None)
+            else:
+                os.environ["RAFT_TPU_BATCHED_PREP"] = saved
+
+    serve_solo_ms = cold_ms("0")
+    serve_bp_ms = cold_ms("1")
+
+    return {
+        "sweep_prep_n_designs": n_designs,
+        "sweep_prep_solo_designs_timed": n_timed,
+        "sweep_prep_wall_s": round(bp_wall, 3),
+        "sweep_prep_solo_wall_s": round(solo_wall, 3),
+        "sweep_prep_batched": int(n_batched),
+        "sweep_prep_speedup": round(solo_wall / max(bp_wall, 1e-9), 2),
+        "sweep_prep_bits_identical": bool(bits),
+        "serve_cold_prep_p50_ms": round(serve_bp_ms, 2),
+        "serve_cold_prep_solo_p50_ms": round(serve_solo_ms, 2),
+    }
+
+
+def bench_batched_prep_smoke(n_designs=8):
+    """Tiny-family tier-1 guard for the batched-prep A/B driver."""
+    from raft_tpu.batched_prep import PrepFamily
+    from raft_tpu.sweep import _prepare_chunk
+
+    designs = _prep_family_designs(n_designs, nw=(0.1, 0.4))
+    apply_pt = lambda d, pt: pt   # noqa: E731
+    t0 = time.perf_counter()
+    _, failed, _ = _prepare_chunk(designs[0], designs, apply_pt,
+                                  "float64", 0, None)
+    solo_wall = time.perf_counter() - t0
+    assert not failed
+    family = PrepFamily(designs[0], precision="float64")
+    lanes = [family.extract(d) for d in designs]
+    family.prepare(lanes[:family.block])   # warm the trace
+    t0 = time.perf_counter()
+    _, failed, n_batched = _prepare_chunk(designs[0], designs, apply_pt,
+                                          "float64", 0, family)
+    bp_wall = time.perf_counter() - t0
+    assert not failed and n_batched == n_designs
+    return {
+        "smoke_prep_ratio": round(solo_wall / max(bp_wall, 1e-9), 2),
+        "smoke_prep_bits": bool(_prep_bits_identical(family, lanes)),
     }
 
 

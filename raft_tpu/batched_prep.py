@@ -1,0 +1,602 @@
+"""Batched, traced design preparation — the per-design host prep loop as
+one ``jit(vmap)`` program (ISSUE 12 tentpole).
+
+Solo prep (``sweep.py:_prepare_design``, the serve engine's prep workers)
+builds a full :class:`~raft_tpu.model.Model` per design and walks
+geometry/statics/mooring in Python — serial host work on the hot path
+while the solve side is already vmapped and sharded.  This module maps a
+``[n_designs]`` stacked design batch straight to the packed
+:class:`~raft_tpu.geometry.HydroNodes` + case-args bundles the bucket
+executables consume:
+
+- **Host, per design** (cannot be traced — shapes depend on values): the
+  *real* strip discretization via :func:`geometry.process_members` (dls_max
+  spacing, per-segment ``ceil`` counts), mooring parsing, and knob
+  extraction.  This is what "reproduce compute()'s actual node
+  re-distribution" means: node positions/spacings come from each design's
+  own discretization, not a proportional scaling of a frozen node set.
+- **Device, per fixed lane block**: ONE traced program evaluates
+  statics (:func:`parametric.compute_statics_t`), node packing
+  (:func:`parametric.pack_nodes_t`, value-only waterline masks) and the
+  Morison added-mass matrix for every lane, vmapped over designs; ONE
+  design×case-batched mooring equilibrium
+  (:func:`mooring.case_mooring_design_batch_fn`) linearizes all lanes'
+  mooring at once.
+
+Bit-identity (the PR 3/PR 8 house recipe): the program shape is a fixed
+lane block (``RAFT_TPU_PREP_BLOCK``, default 8; short blocks are padded
+with replicas of lane 0), every traced stage is elementwise in the lane
+axis, and the mooring Newton freezes converged lanes
+(``mooring.solve_equilibrium``), so a design's prepared bits are
+independent of its batch mates — solo prep under the flag IS a batch of
+one, and ``np.array_equal`` holds across compositions.  Legacy (flag-off)
+prep is a *different* program (host NumPy); the two agree to roundoff,
+which is why ``RAFT_TPU_BATCHED_PREP`` defaults off and tier-1 bits stay
+untouched.
+
+Family discipline: lanes share a template whose host branch decisions
+(degenerate-frustum flags, cap classifications, waterplane-crossing
+segments, strip counts — everything the traced twins read from
+``tpl.*``) are frozen into the program.  :func:`PrepFamily.extract`
+recomputes every one of those predicates for the candidate design and
+raises :class:`PrepFamilyError` on any mismatch — the callers fall back
+to solo prep for that design (a *fallback*, not a quarantine).
+"""
+
+import json
+import os
+from types import SimpleNamespace
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.geometry import HydroNodes, process_members
+from raft_tpu.hydro import added_mass_morison
+from raft_tpu.io.schema import cases_as_dicts, get_from_dict
+from raft_tpu.mooring import case_mooring_design_batch_fn, parse_mooring
+from raft_tpu.parametric import (
+    _lateral_norm_zero,
+    _segment_strip_counts,
+    compute_statics_t,
+    pack_nodes_t,
+)
+from raft_tpu.utils.placement import put_cpu
+
+
+def batched_prep_enabled(flag=None):
+    """Whether batched traced prep is on (``RAFT_TPU_BATCHED_PREP``,
+    default off so tier-1 bits stay untouched).  ``flag`` overrides."""
+    if flag is not None:
+        return bool(flag)
+    return os.environ.get("RAFT_TPU_BATCHED_PREP", "0").lower() in (
+        "1", "true", "yes", "on")
+
+
+def prep_block_size():
+    """Fixed lane-block size of the traced prep program
+    (``RAFT_TPU_PREP_BLOCK``, default 8)."""
+    return max(1, int(os.environ.get("RAFT_TPU_PREP_BLOCK", "8")))
+
+
+class PrepFamilyError(RuntimeError):
+    """The design cannot join this prep family (branch signature, shape,
+    or configuration mismatch) — callers fall back to solo prep."""
+
+
+# knob leaves consumed as tm[...] by the traced twins
+_TM_KEYS = (
+    "rA", "q", "p1", "p2", "R", "stations", "dorsl", "t", "l_fill",
+    "rho_fill", "cap_stations", "cap_t", "cap_d_in", "r", "ls", "dls",
+    "ds", "drs",
+)
+# knob leaves the traced twins read off the template object — served
+# traced through the _TplView overlay
+_VIEW_KEYS = (
+    "rho_shell", "Ca_p1", "Ca_p2", "Ca_End", "Cd_q", "Cd_p1", "Cd_p2",
+    "Cd_End",
+)
+
+
+class _TplView:
+    """Template proxy for the traced twins: attribute reads used in
+    *arithmetic* (shell density, drag/added-mass coefficients) resolve to
+    traced per-lane values, while every branch-deciding read falls
+    through to the host template member."""
+
+    __slots__ = ("_tpl", "_over")
+
+    def __init__(self, tpl, over):
+        object.__setattr__(self, "_tpl", tpl)
+        object.__setattr__(self, "_over", over)
+
+    def __getattr__(self, name):
+        over = object.__getattribute__(self, "_over")
+        if name in over:
+            return over[name]
+        return getattr(object.__getattribute__(self, "_tpl"), name)
+
+
+def _scalarish(x):
+    return np.isscalar(x) or np.ndim(x) == 0
+
+
+def _member_signature(m):
+    """Every host branch decision the traced twins freeze from the
+    template, recomputed for member ``m`` — two members with equal
+    signatures take identical branches through
+    member_inertia_t / member_hydrostatics_t / pack_nodes_t.
+
+    Includes the waterplane heading constant ``arctan2(q1, q0)`` (a host
+    float embedded in crossing-segment hydrostatics) so program reuse
+    across equal-signature families is value-safe.
+    """
+    st = np.asarray(m.stations, float)
+    n = len(st)
+    circ = bool(m.circular)
+    cap_st = np.atleast_1d(np.asarray(m.cap_stations, float))
+    ncap = len(cap_st)
+    sig = [(
+        "struct", circ, bool(m.potMod), int(m.ns), n, ncap,
+        _scalarish(m.l_fill), _scalarish(m.rho_fill),
+        tuple(_segment_strip_counts(m)), _lateral_norm_zero(m),
+    )]
+
+    # hydrostatics: per-segment crossing classification + the embedded
+    # waterplane heading for crossing segments of non-vertical members
+    for i in range(1, n):
+        zA = float(m.rA[2] + m.q[2] * st[i - 1])
+        zB = float(m.rA[2] + m.q[2] * st[i])
+        crossing = zA * zB <= 0 and not (zA <= 0 and zB <= 0)
+        sig.append(("hyd", i, crossing, zA <= 0 and zB <= 0))
+        if crossing and not _lateral_norm_zero(m):
+            sig.append(("beta", float(np.arctan2(m.q[1], m.q[0]))))
+
+    def lf_at(i):
+        return float(m.l_fill if _scalarish(m.l_fill)
+                     else np.asarray(m.l_fill)[i - 1])
+
+    # inertia: per-segment degenerate-frustum / uniform / fill flags
+    for i in range(1, n):
+        l_t = float(st[i] - st[i - 1])
+        sig.append(("seg", i, l_t == 0.0))
+        if l_t == 0.0:
+            continue
+        lf = lf_at(i)
+        if circ:
+            dA, dB = float(m.d[i - 1]), float(m.d[i])
+            dAi = dA - 2 * float(m.t[i - 1])
+            dBi = dB - 2 * float(m.t[i])
+            dBf = (dBi - dAi) * (lf / l_t) + dAi
+            sig.append((dA == 0 and dB == 0, dAi == 0 and dBi == 0,
+                        dA == dB, dAi == dBi,
+                        dAi == 0 and dBf == 0, dAi == dBf, lf == 0.0))
+        else:
+            def deg(a, b):
+                A1, A2 = a[0] * a[1], b[0] * b[1]
+                return (A1 + A2 + np.sqrt(max(A1 * A2, 0.0))) == 0
+
+            slA, slB = np.asarray(m.sl[i - 1]), np.asarray(m.sl[i])
+            slAi = slA - 2 * float(m.t[i - 1])
+            slBi = slB - 2 * float(m.t[i])
+            sig.append((deg(slA, slB), deg(slAi, slBi), lf == 0.0))
+
+    # end caps (circular only — the traced path rejects rectangular caps)
+    if ncap and not circ:
+        sig.append(("rect-caps",))
+        return tuple(sig)
+    if ncap:
+        d_in_t = np.asarray(m.d, float) - 2 * np.asarray(m.t, float)
+        cap_d = np.atleast_1d(np.asarray(m.cap_d_in, float))
+        cap_t = np.atleast_1d(np.asarray(m.cap_t, float))
+        for i in range(ncap):
+            L_t, h_t = float(cap_st[i]), float(cap_t[i])
+            if L_t == st[0]:
+                cls = 0
+                dA_t = float(d_in_t[0])
+                dB_t = float(np.interp(L_t + h_t, st, d_in_t))
+                dAi_t = float(cap_d[i])
+                dBi_t = dB_t * (dAi_t / dA_t)
+            elif L_t == st[-1]:
+                cls = 1
+                dA_t = float(np.interp(L_t - h_t, st, d_in_t))
+                dB_t = float(d_in_t[-1])
+                dBi_t = float(cap_d[i])
+                dAi_t = dA_t * (dBi_t / dB_t)
+            elif i < ncap - 1 and L_t == float(cap_st[i + 1]):
+                cls = 2
+                dA_t = float(np.interp(L_t - h_t, st, d_in_t))
+                dB_t = float(d_in_t[i])
+                dBi_t = float(cap_d[i])
+                dAi_t = dA_t * (dBi_t / dB_t)
+            elif i > 0 and L_t == float(cap_st[i - 1]):
+                cls = 3
+                dA_t = float(d_in_t[i])
+                dB_t = float(np.interp(L_t + h_t, st, d_in_t))
+                dAi_t = float(cap_d[i])
+                dBi_t = dB_t * (dAi_t / dA_t)
+            else:
+                cls = 4
+                dA_t = float(np.interp(L_t - h_t / 2, st, d_in_t))
+                dB_t = float(np.interp(L_t + h_t / 2, st, d_in_t))
+                dM_t = float(np.interp(L_t, st, d_in_t))
+                dAi_t = dA_t * (float(cap_d[i]) / dM_t)
+                dBi_t = dB_t * (float(cap_d[i]) / dM_t)
+            sig.append(("cap", i, cls,
+                        dA_t == 0 and dB_t == 0, dAi_t == 0 and dBi_t == 0,
+                        dA_t == dB_t, dAi_t == dBi_t, h_t == 0.0))
+    return tuple(sig)
+
+
+def _member_knobs(m):
+    """The traced per-lane leaves for one host member — its own real
+    discretization and geometry, as f64 NumPy (stacked over lanes by the
+    caller)."""
+    circ = m.circular
+    ncap = len(np.atleast_1d(m.cap_stations))
+    if circ:
+        cap_d_in = (np.zeros(0) if ncap == 0
+                    else np.atleast_1d(np.asarray(m.cap_d_in, float)))
+    else:
+        cap_d_in = (np.atleast_2d(np.asarray(m.cap_d_in, float))
+                    if ncap else np.zeros((0, 2)))
+    kn = dict(
+        rA=np.asarray(m.rA, float),
+        q=np.asarray(m.q, float),
+        p1=np.asarray(m.p1, float),
+        p2=np.asarray(m.p2, float),
+        R=np.asarray(m.R, float),
+        stations=np.asarray(m.stations, float),
+        dorsl=np.asarray(m.dorsl(), float),
+        t=np.asarray(m.t, float),
+        l_fill=np.asarray(m.l_fill, float),
+        rho_fill=np.asarray(m.rho_fill, float),
+        cap_stations=np.atleast_1d(np.asarray(m.cap_stations, float)),
+        cap_t=np.atleast_1d(np.asarray(m.cap_t, float)),
+        cap_d_in=cap_d_in,
+        r=np.asarray(m.r, float),
+        ls=np.asarray(m.ls, float),
+        dls=np.asarray(m.dls, float),
+        ds=np.asarray(m.ds, float),
+        drs=np.asarray(m.drs, float),
+        rho_shell=np.asarray(float(m.rho_shell)),
+    )
+    for key in ("Ca_p1", "Ca_p2", "Ca_End", "Cd_q", "Cd_p1", "Cd_p2",
+                "Cd_End"):
+        kn[key] = np.asarray(getattr(m, key), float)
+    return kn
+
+
+def _turbine_vector(design):
+    turb = design["turbine"]
+    return np.array([float(turb["mRNA"]), float(turb["IxRNA"]),
+                     float(turb["IrRNA"]), float(turb["xCG_RNA"]),
+                     float(turb["hHub"])])
+
+
+def _settings_key(design):
+    """The scalars that define the shared frequency grid / solver config
+    (must match across a family — they are baked into the template
+    Model)."""
+    settings = design.get("settings", {})
+    site = design.get("site", {})
+    return (
+        get_from_dict(settings, "min_freq", default=0.01, dtype=float),
+        get_from_dict(settings, "max_freq", default=1.00, dtype=float),
+        get_from_dict(settings, "XiStart", default=0.1, dtype=float),
+        get_from_dict(settings, "nIter", default=15, dtype=int),
+        float(site["water_depth"]),
+        float(site.get("rho_water", 1025.0)),
+        float(site.get("g", 9.81)),
+        float(design["platform"].get("yaw_stiffness", 0.0)),
+    )
+
+
+def family_key(design, cases=None, precision=None):
+    """Grouping key: designs with equal keys are batchable in one
+    :class:`PrepFamily` (equal branch signatures, frequency grid, site
+    scalars, cases table, mooring shape, turbine mode)."""
+    members = process_members(design)
+    sigs = tuple(_member_signature(m) for m in members)
+    if cases is None:
+        cases = cases_as_dicts(design)
+    ms = parse_mooring(design["mooring"], rho_water=_settings_key(design)[5],
+                       g=_settings_key(design)[6])
+    payload = (
+        repr(sigs), _settings_key(design),
+        json.dumps(cases, sort_keys=True, default=float),
+        tuple(np.asarray(ms.L).shape), ms.bridles is None,
+        int(get_from_dict(design["turbine"], "aeroServoMod", default=1)),
+        str(precision),
+    )
+    return repr(payload)
+
+
+# compiled geometry programs shared across equal-signature families (the
+# signature pins every host constant the trace embeds, incl. the
+# waterplane heading floats)
+_GEOM_PROGRAM_CACHE = {}
+
+
+class PreppedDesign:
+    """Model-lite result of batched prep: exactly the attribute surface
+    the sweep/serve consumers read off a prep Model (SlotPhysics.from_model,
+    pipeline builders, default_collect, retry escalation) — no solver
+    state, no per-design jitted executables."""
+
+    def __init__(self, template_model, design, statics, nodes_f64):
+        tm = template_model
+        self.design = design
+        self.w = tm.w
+        self.k = tm.k
+        self.nw = tm.nw
+        self.dw = tm.dw
+        self.depth = tm.depth
+        self.rho_water = tm.rho_water
+        self.g = tm.g
+        self.XiStart = tm.XiStart
+        self.nIter = tm.nIter
+        self.dtype = tm.dtype
+        self.cdtype = tm.cdtype
+        self.precision = tm.precision
+        self.hHub = float(design["turbine"]["hHub"])
+        self.aeroServoMod = tm.aeroServoMod
+        self.yawstiff = float(design["platform"].get("yaw_stiffness", 0.0))
+        self.statics = statics
+        self.nodes = nodes_f64
+
+
+class PrepFamily:
+    """A template design whose frozen branch decisions define one traced
+    prep program; designs that :meth:`extract` cleanly run through
+    :meth:`prepare` in fixed lane blocks."""
+
+    def __init__(self, base_design, precision=None, cases=None,
+                 geometry_only=False):
+        from raft_tpu.model import Model
+
+        self.geometry_only = bool(geometry_only)
+        self.model = Model(base_design, precision=precision)
+        self.precision = precision
+        self.templates = self.model.members
+        self.sigs = [_member_signature(m) for m in self.templates]
+        if any(("rect-caps",) in s for s in self.sigs):
+            raise PrepFamilyError(
+                "rectangular members with end caps have no traced twin")
+        self.rho_water = float(self.model.rho_water)
+        self.g = float(self.model.g)
+        self.yawstiff = float(self.model.yawstiff)
+        self._settings = _settings_key(base_design)
+        self.block = prep_block_size()
+        self._cpu = jax.devices("cpu")[0]
+        self._geom_b = self._build_geom_program()
+        if self.geometry_only:
+            # geometry/statics/added-mass only (sweep_fused stages its
+            # own batched mooring + aero downstream)
+            self.cases = None
+            self.zeta = self.beta = None
+            self.nc = 0
+            self._wind = np.zeros(0)
+            self._moor_shape = None
+            self._moor_fn = None
+        else:
+            self.cases = (list(cases) if cases is not None
+                          else cases_as_dicts(base_design))
+            if not self.cases:
+                raise PrepFamilyError("design has no cases table")
+            spec, height, period, beta, wind = self.model._case_arrays(
+                self.cases)
+            if self.model.aeroServoMod > 0 and np.any(wind > 0.0):
+                raise PrepFamilyError(
+                    "aero-servo cases with wind need the rotor host pass "
+                    "— solo prep only")
+            self._wind = wind
+            self.zeta = self.model._zeta(spec, height, period)  # [nc, nw]
+            self.beta = beta
+            self.nc = len(self.cases)
+            ms = self.model.ms
+            if ms.bridles is not None:
+                raise PrepFamilyError(
+                    "bridled mooring linearization is host-staged — solo "
+                    "prep only")
+            self._moor_shape = tuple(np.asarray(ms.L).shape)
+            self._moor_fn = case_mooring_design_batch_fn(
+                self.rho_water, self.g, self.yawstiff)
+        # engine-facing counters (reset by callers as needed)
+        self.n_batched = 0
+        self.n_blocks = 0
+
+    # -- traced program ------------------------------------------------
+
+    def _build_geom_program(self):
+        key = (repr(tuple(self.sigs)), self.rho_water, self.g, self.block)
+        fn = _GEOM_PROGRAM_CACHE.get(key)
+        if fn is not None:
+            return fn
+        templates = tuple(self.templates)
+        rho, g = self.rho_water, self.g
+
+        def one_lane(kns, turb):
+            tms = []
+            for tpl, kn in zip(templates, kns):
+                tm = {k: kn[k] for k in _TM_KEYS}
+                tm["tpl"] = _TplView(tpl, {k: kn[k] for k in _VIEW_KEYS})
+                tms.append(tm)
+            stt = compute_statics_t(
+                tms, None, rho, g,
+                turbine_t=(turb[0], turb[1], turb[2], turb[3], turb[4]))
+            nodes = pack_nodes_t(tms)
+            A = added_mass_morison(nodes, rho)
+            return nodes, stt, A
+
+        fn = jax.jit(jax.vmap(one_lane))
+        _GEOM_PROGRAM_CACHE[key] = fn
+        return fn
+
+    # -- per-design host stage -----------------------------------------
+
+    def extract(self, design):
+        """Host stage for one design: REAL discretization + knob leaves,
+        guarded by the full branch-signature comparison.  Raises
+        :class:`PrepFamilyError` on any mismatch."""
+        if _settings_key(design) != self._settings:
+            raise PrepFamilyError("settings/site scalars differ from family")
+        aero = get_from_dict(design["turbine"], "aeroServoMod", default=1)
+        if aero != self.model.aeroServoMod:
+            raise PrepFamilyError("aeroServoMod differs from family")
+        if not self.geometry_only and aero > 0 \
+                and np.any(self._wind > 0.0):
+            raise PrepFamilyError("aero-servo cases with wind — solo only")
+        members = process_members(design)
+        if len(members) != len(self.templates):
+            raise PrepFamilyError("member count differs from family")
+        for m, sig in zip(members, self.sigs):
+            if _member_signature(m) != sig:
+                raise PrepFamilyError(
+                    f"member '{m.name}' branch signature differs from "
+                    "family template (topology cell boundary)")
+        ms = parse_mooring(design["mooring"], rho_water=self.rho_water,
+                           g=self.g)
+        if not self.geometry_only:
+            if ms.bridles is not None:
+                raise PrepFamilyError("bridled mooring — solo prep only")
+            if tuple(np.asarray(ms.L).shape) != self._moor_shape:
+                raise PrepFamilyError("mooring line-array shape differs")
+            if float(design["platform"].get("yaw_stiffness", 0.0)) \
+                    != self.yawstiff:
+                raise PrepFamilyError("yaw stiffness differs from family")
+        return {
+            "design": design,
+            "knobs": tuple(_member_knobs(m) for m in members),
+            "turb": _turbine_vector(design),
+            "ms": ms,
+            "moor": tuple(np.asarray(a, float) for a in (
+                ms.anchors, ms.rFair, ms.L, ms.EA, ms.w, ms.Wp, ms.cb)),
+        }
+
+    # -- batched device stage ------------------------------------------
+
+    def prepare(self, lanes):
+        """Run extracted lanes through the traced prep in fixed blocks.
+
+        lanes : list of :meth:`extract` results.
+        Returns a list of ``(PreppedDesign, nodes, args)`` triples in
+        order — the exact contract of ``sweep.py:_prepare_design``.
+        """
+        out = []
+        B = self.block
+        for k0 in range(0, len(lanes), B):
+            out.extend(self._prepare_block(lanes[k0:k0 + B]))
+        return out
+
+    def _geom_block_host(self, padded):
+        """Run one padded block through the traced geometry program and
+        pull everything back to host NumPy."""
+        knobs_b = tuple(
+            {k: np.stack([ln["knobs"][mi][k] for ln in padded])
+             for k in padded[0]["knobs"][mi]}
+            for mi in range(len(self.templates))
+        )
+        turb_b = np.stack([ln["turb"] for ln in padded])
+        with jax.default_device(self._cpu):
+            nodes_b, st_b, A_b = self._geom_b(knobs_b, turb_b)
+        nodes_host = {k: np.asarray(getattr(nodes_b, k))
+                      for k in nodes_b.__dataclass_fields__}
+        st_host = {k: np.asarray(v) for k, v in st_b.items()}
+        return nodes_host, st_host, np.asarray(A_b)
+
+    def _statics_ns(self, st_host, i):
+        return SimpleNamespace(
+            mass=float(st_host["mass"][i]),
+            V=float(st_host["V"][i]),
+            zMeta=float(st_host["zMeta"][i]),
+            rCG_TOT=st_host["rCG"][i],
+            AWP=float(st_host["AWP"][i]),
+            M_struc=st_host["M_struc"][i],
+            C_struc=st_host["C_struc"][i],
+            C_hydro=st_host["C_hydro"][i],
+        )
+
+    def prepare_geometry(self, lanes):
+        """Geometry/statics/added-mass only — no cases, no mooring
+        linearization.  Returns a list of ``(nodes_f64, statics,
+        A_morison)`` triples in lane order, where ``statics`` exposes
+        the attrs ``sweep_fused`` reads off ``compute_statics`` output
+        (mass, V, zMeta, rCG_TOT, AWP, M_struc, C_struc, C_hydro)."""
+        out = []
+        B = self.block
+        for k0 in range(0, len(lanes), B):
+            blk = lanes[k0:k0 + B]
+            padded = list(blk) + [blk[0]] * (B - len(blk))
+            nodes_host, st_host, A_host = self._geom_block_host(padded)
+            for i in range(len(blk)):
+                nodes = HydroNodes(
+                    **{k: v[i] for k, v in nodes_host.items()})
+                out.append((nodes, self._statics_ns(st_host, i),
+                            A_host[i]))
+            self.n_batched += len(blk)
+            self.n_blocks += 1
+        return out
+
+    def _prepare_block(self, lanes):
+        B = self.block
+        n = len(lanes)
+        padded = list(lanes) + [lanes[0]] * (B - n)
+
+        nodes_host, st_host, A_host = self._geom_block_host(padded)
+        moor_b = tuple(
+            np.stack([ln["moor"][i] for ln in padded])
+            for i in range(7)
+        )
+
+        with jax.default_device(self._cpu):
+            # design×case-batched mooring linearization at the traced
+            # statics (f6 = 0: aero-off / windless gate above), one
+            # fixed-shape dispatch per block
+            f6 = np.zeros((B, self.nc, 6))
+            rM = np.stack(
+                [np.zeros(B), np.zeros(B), st_host["zMeta"]], axis=1)
+            moor_dev = tuple(put_cpu(a) for a in moor_b)
+            _, C_moor_b, _, _, _, _ = self._moor_fn(
+                put_cpu(f6), put_cpu(st_host["mass"]),
+                put_cpu(st_host["V"]), put_cpu(st_host["rCG"]),
+                put_cpu(rM), put_cpu(st_host["AWP"]), *moor_dev, None)
+            C_moor_b = np.asarray(C_moor_b)
+
+        dtype = self.model.dtype
+        nw = self.model.nw
+        zeta = self.zeta.astype(dtype)
+        beta = self.beta.astype(dtype)
+        out = []
+        for i in range(len(lanes)):
+            nodes = HydroNodes(**{k: v[i] for k, v in nodes_host.items()})
+            st = self._statics_ns(st_host, i)
+            # args assembly: prepare_case_inputs' aero-off/no-BEM branch
+            M_lin = np.broadcast_to(
+                (st.M_struc + A_host[i])[None, None],
+                (self.nc, nw, 6, 6)).astype(dtype)
+            B_lin = np.zeros((self.nc, nw, 6, 6), dtype)
+            C_lin = (st.C_struc[None] + st.C_hydro[None]
+                     + C_moor_b[i]).astype(dtype)
+            F_add_r = np.zeros((self.nc, nw, 6), dtype)
+            F_add_i = np.zeros((self.nc, nw, 6), dtype)
+            args = (zeta, beta, C_lin, M_lin, B_lin, F_add_r, F_add_i)
+            prepped = PreppedDesign(self.model, lanes[i]["design"], st,
+                                    nodes)
+            out.append((prepped, nodes.astype(dtype), args))
+        self.n_batched += len(lanes)
+        self.n_blocks += 1
+        return out
+
+
+def prepare_designs(designs, precision=None, cases=None, family=None):
+    """Convenience: one family from ``designs[0]``, every design through
+    batched prep.  Raises :class:`PrepFamilyError` if any design cannot
+    join — callers needing per-design fallback should drive
+    :meth:`PrepFamily.extract` themselves."""
+    if not designs:
+        return []
+    if family is None:
+        family = PrepFamily(designs[0], precision=precision, cases=cases)
+    return family.prepare([family.extract(d) for d in designs])

@@ -895,7 +895,15 @@ def solve_equilibrium(
         return (i < iters) & (err > tol)
 
     def body_fn(state):
-        i, r6, _ = state
+        i, r6, err = state
+        # freeze converged state: when this system's own step already met
+        # the tolerance, stop moving it.  Unbatched this is a no-op (the
+        # while_loop's cond has already exited), but under a vmap over
+        # systems the loop runs until the SLOWEST lane converges and the
+        # masked update keeps every fast lane's answer independent of its
+        # batch mates — the property the batched design-prep path
+        # (raft_tpu/batched_prep.py) relies on for solo == batched bits.
+        active = err > tol
         F = total_force(r6)
         J = jac(r6)
         # tiny Tikhonov damping: an all-slack mooring (every line in the
@@ -908,7 +916,9 @@ def solve_equilibrium(
         lam = 1e-8 * jnp.max(jnp.abs(jnp.diag(J))) + 1e-30
         dx = jnp.linalg.solve(J + lam * jnp.eye(6, dtype=J.dtype), -F)
         dx = jnp.clip(dx, -step_cap, step_cap)
-        return i + 1, r6 + dx, jnp.max(jnp.abs(dx))
+        dx = jnp.where(active, dx, jnp.zeros_like(dx))
+        return (i + 1, r6 + dx,
+                jnp.where(active, jnp.max(jnp.abs(dx)), err))
 
     r0 = jnp.zeros_like(L, shape=(6,)) if r6_init is None else jnp.asarray(r6_init)
     _, r6, _ = jax.lax.while_loop(

@@ -774,7 +774,15 @@ class RAFT_OMDAO(_ComponentBase):
         that submits the design to a running serve engine (``engine`` —
         any object with the Engine/Router ``evaluate`` surface) or to a
         serve HTTP tier (``endpoint`` — ``host:port``) instead of owning
-        the dispatch in this process."""
+        the dispatch in this process.
+
+        With ``RAFT_TPU_BATCHED_PREP=1`` on the engine side, the
+        driver-loop submissions this closure makes land in one design
+        family (scale knobs never change branch signatures), so after
+        the first iteration the engine preps each new scale point
+        through the family's traced program instead of a full Model
+        build — the serve-tier analogue of the sweep drivers' batched
+        prep."""
         if modeling_opt.get("run_native_BEM"):
             raise NotImplementedError(
                 "modeling options 'engine'/'engine_endpoint' cannot be "
@@ -949,15 +957,14 @@ class RAFT_OMDAO(_ComponentBase):
         traced twin models neither; _check_derivative_options refuses
         the combination in setup() and here).
 
-        Draft-axis caveat: the twin scales its frozen strip-node set
-        proportionally, while compute() re-discretizes nodes from the
-        scaled design dict (dls_max spacing, waterline re-snap), so the
-        design_scale_draft column is the exact derivative of a slightly
-        different smooth geometry path — measured same-sign and within
-        ~4x of compute()'s one-sided FD (pinned by
-        tests/test_parametric.py::test_omdao_scale_partials).  The
-        ballast, col_diam, and line_length columns match compute() FD
-        to <= 5e-3 / 5e-2.
+        All four design-scale columns (draft, ballast, col_diam,
+        line_length) match in-cell central/one-sided FD of compute()
+        itself (tests/test_parametric.py::test_omdao_scale_partials);
+        the twin's waterline-clip and submergence masks follow the
+        traced geometry, so the draft column is the derivative of
+        compute()'s own smooth in-cell path (strip counts still jump
+        at member-length multiples of dls_max — derivatives are exact
+        within a topology cell).
         """
         import pickle as _pickle
 

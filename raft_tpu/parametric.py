@@ -652,10 +652,16 @@ def member_hydrostatics_t(tm, rho, g):
     return Fvec, Cmat, V_UW, r_centerV, AWP, IWP, xWP, yWP
 
 
-def compute_statics_t(tms, turbine, rho_water, g):
+def compute_statics_t(tms, turbine, rho_water, g, turbine_t=None):
     """Traced twin of statics.compute_statics returning the subset the
     dynamics/mooring consume: M_struc, C_struc, C_hydro, mass, rCG_TOT,
-    V, AWP, zMeta."""
+    V, AWP, zMeta.
+
+    ``turbine_t`` optionally supplies the RNA lumped properties as a
+    traced 5-tuple (mRNA, IxRNA, IrRNA, xCG_RNA, hHub) — the batched
+    design-prep path (raft_tpu/batched_prep.py) traces them per lane;
+    when None (default) the constants come from the ``turbine`` dict
+    exactly as before."""
     M_struc = jnp.zeros((6, 6))
     C_hydro = jnp.zeros((6, 6))
     Sum_M_center = jnp.zeros(3)
@@ -677,12 +683,19 @@ def compute_statics_t(tms, turbine, rho_water, g):
         IWPx_TOT = IWPx_TOT + IWP + AWP * yWP**2
         Sum_V_rCB = Sum_V_rCB + r_centerV
 
-    mRNA = float(turbine["mRNA"])
-    Mmat = jnp.diag(jnp.asarray(
-        [mRNA, mRNA, mRNA, float(turbine["IxRNA"]),
-         float(turbine["IrRNA"]), float(turbine["IrRNA"])]))
-    center = jnp.asarray(
-        [float(turbine["xCG_RNA"]), 0.0, float(turbine["hHub"])])
+    if turbine_t is not None:
+        mRNA, IxRNA, IrRNA, xCG_RNA, hHub = (
+            jnp.asarray(v) for v in turbine_t)
+    else:
+        mRNA = float(turbine["mRNA"])
+        IxRNA = float(turbine["IxRNA"])
+        IrRNA = float(turbine["IrRNA"])
+        xCG_RNA = float(turbine["xCG_RNA"])
+        hHub = float(turbine["hHub"])
+    Mmat = jnp.diag(jnp.stack(
+        [jnp.asarray(v) for v in
+         (mRNA, mRNA, mRNA, IxRNA, IrRNA, IrRNA)]))
+    center = jnp.stack([jnp.asarray(v) for v in (xCG_RNA, 0.0, hHub)])
     M_struc = M_struc + translate_matrix_6to6(Mmat, center)
     Sum_M_center = Sum_M_center + center * mRNA
 
@@ -706,7 +719,8 @@ def compute_statics_t(tms, turbine, rho_water, g):
 def pack_nodes_t(tms):
     """Traced twin of geometry.pack_nodes: the same per-node static
     quantities, vectorized per member and concatenated; waterline-clip and
-    submergence decisions from the template."""
+    submergence decisions follow the traced node z (value-only masks over
+    the template-fixed node set, so shapes stay frozen)."""
     fields = {f.name: [] for f in dataclasses.fields(HydroNodes)}
 
     for tm in tms:
@@ -714,7 +728,6 @@ def pack_nodes_t(tms):
         ns = tpl.ns
         dl = tm["dls"]
         z = tm["r"][:, 2]
-        z_t = tpl.r[:, 2]
 
         fields["r"].append(tm["r"])
         fields["q"].append(jnp.broadcast_to(tm["q"], (ns, 3)))
@@ -746,9 +759,14 @@ def pack_nodes_t(tms):
             ap2 = d1 * dl
             ae_abs = jnp.abs(ae)
 
-        # waterline clip mask from the template (geometry.pack_nodes)
-        clip = (z_t < 0) & (z_t + 0.5 * tpl.dls > 0) & (tpl.dls > 0)
-        v = jnp.where(jnp.asarray(clip),
+        # waterline clip mask from the traced geometry, matching
+        # geometry.pack_nodes exactly: the scaled z decides which strip
+        # straddles the waterline, not the template z (a draft scale
+        # moves the z=0 crossing between strips; freezing the mask at
+        # the template was the pinned draft-axis twin divergence).
+        # Shape-safe: a where() over the same fixed node set.
+        clip = (z < 0) & (z + 0.5 * dl > 0) & (dl > 0)
+        v = jnp.where(clip,
                       v * (0.5 * dl - z) / jnp.where(dl == 0, 1.0, dl), v)
         fields["v_side"].append(v)
         fields["v_end"].append(ve)
@@ -766,9 +784,9 @@ def pack_nodes_t(tms):
                           ("Cd_End", tpl.Cd_End)):
             fields[key].append(jnp.interp(ls, st, jnp.asarray(coef)))
 
-        sub = z_t < 0
-        fields["submerged"].append(jnp.asarray(sub))
-        fields["strip_mask"].append(jnp.asarray(sub & (not tpl.potMod)))
+        sub = z < 0
+        fields["submerged"].append(sub)
+        fields["strip_mask"].append(sub & (not tpl.potMod))
 
     return HydroNodes(**{
         k: jnp.concatenate(vs) for k, vs in fields.items()

@@ -61,12 +61,18 @@ import queue
 import threading
 import time
 from collections import OrderedDict
-from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import Future, ThreadPoolExecutor
 
 import numpy as np
 
 import jax
 
+from raft_tpu.batched_prep import (
+    PrepFamily,
+    PrepFamilyError,
+    batched_prep_enabled,
+    family_key,
+)
 from raft_tpu.chaos import ChaosBackendError, ChaosError, get_injector
 from raft_tpu.health import log_report, report_dict
 from raft_tpu.resilience import (
@@ -497,6 +503,10 @@ class Engine:
             max_workers=1, thread_name_prefix="raft-sweep-prep")
         self._prep_cache = (PrepCache(self.config.cache_dir)
                             if self.config.use_prep_cache else None)
+        # batched traced prep (RAFT_TPU_BATCHED_PREP): family programs
+        # keyed by family_key; False marks a family that failed to build
+        self._bp_families = OrderedDict()
+        self._bp_lock = threading.Lock()
         self._manifest = (WarmupManifest(cache_dir=self.config.cache_dir)
                           if self.config.record_manifest else None)
         self._chaos = get_injector()
@@ -532,7 +542,8 @@ class Engine:
             "sweep_preemptions": 0,
             "latency_s": [], "occupancy": [],
             "batch_requests": [], "prep_cache_hits": 0,
-            "prep_memo_hits": 0, "bucket_compiles": [],
+            "prep_memo_hits": 0, "prep_batched_designs": 0,
+            "prep_batched_groups": 0, "bucket_compiles": [],
             "first_result_s": None, "warmup": None,
         }
         self._t_start = time.perf_counter()
@@ -768,6 +779,13 @@ class Engine:
 
     # --------------------------------------------------------------- prep
 
+    def _prep_key(self, design, cases):
+        """Design prep key, namespaced when batched prep is live: traced
+        prep agrees with the Model build only to roundoff, so its memo /
+        disk-cache entries must never alias the solo path's bits."""
+        key = design_prep_key(design, cases, self.config.precision)
+        return key + "|bp" if batched_prep_enabled() else key
+
     def _submit_prep_locked(self, req):
         """Schedule host-side prep on the worker pool (deduplicated per
         design key); completion wakes the batcher.  Called under
@@ -779,7 +797,7 @@ class Engine:
         owner's rid only — a follower whose shared prep raised gets one
         fresh prep of its own (``_serve_batch``) instead of inheriting
         the owner's failure."""
-        key = design_prep_key(req.design, req.cases, self.config.precision)
+        key = self._prep_key(req.design, req.cases)
         fut = self._prep_futs.get(key)
         if fut is not None and not fut.done():
             return fut
@@ -809,8 +827,7 @@ class Engine:
             self._chaos.raise_if("prep_raise", req.rid, exc=ChaosError)
             self._chaos.stall_if("prep_slow", req.rid)
 
-        key = design_prep_key(req.design, req.cases,
-                              self.config.precision)
+        key = self._prep_key(req.design, req.cases)
         with self._prep_lock:
             memo = self._prep_memo.get(key)
             if memo is not None:
@@ -833,6 +850,11 @@ class Engine:
                 prepped = _Prepped(nodes, args, physics, spec,
                                    float(w[1] - w[0]))
                 self.stats["prep_cache_hits"] += 1
+
+        if prepped is None and batched_prep_enabled():
+            prepped = self._try_batched_prepare(req, key)
+            if prepped is not None:
+                return prepped     # memo/cache writes done by the helper
 
         if prepped is None:
             model = Model(req.design, precision=self.config.precision,
@@ -863,6 +885,157 @@ class Engine:
             while len(self._prep_memo) > self._prep_memo_cap:
                 self._prep_memo.popitem(last=False)
         return prepped
+
+    # -- batched traced prep (RAFT_TPU_BATCHED_PREP) -------------------
+
+    def _bp_family_for(self, design, cases):
+        """PrepFamily for this design's family key, cached; None when
+        the family can't be built (negative result cached too, so a
+        stream of unbatchable designs doesn't re-pay the Model build)."""
+        fk = family_key(design, cases, self.config.precision)
+        with self._bp_lock:
+            fam = self._bp_families.get(fk)
+        if fam is not None:
+            return fam if fam is not False else None
+        try:
+            fam = PrepFamily(design, precision=self.config.precision,
+                             cases=list(cases) if cases else None)
+        except Exception as e:  # noqa: BLE001 — any fault → solo path
+            logger.info("serve: design family not batchable (%s: %s)",
+                        type(e).__name__, e)
+            fam = False
+        with self._bp_lock:
+            while len(self._bp_families) >= 16:
+                self._bp_families.popitem(last=False)
+            self._bp_families[fk] = fam
+        return fam if fam is not False else None
+
+    def _finish_batched(self, key, pd, nodes, args):
+        """Wrap one batched-prep lane as a ``_Prepped`` and run the same
+        memo/disk-cache/manifest bookkeeping as the Model-build path."""
+        physics = SlotPhysics.from_model(pd)
+        spec = choose_bucket(
+            pd.nw, nodes.r.shape[0], args[0].shape[0],
+            node_quantum=self.config.node_quantum,
+            slot_ladder=self.config.slot_ladder,
+            coalesce=self.config.coalesce)
+        prepped = _Prepped(nodes, args, physics, spec, float(pd.dw))
+        if self._prep_cache is not None:
+            try:
+                self._prep_cache.save(key, nodes, args, physics)
+            except OSError as e:
+                logger.warning("serve prep cache write failed: %s", e)
+        if self._manifest is not None:
+            self._manifest.record(physics, prepped.spec,
+                                  flags=self._manifest_flags())
+        with self._prep_lock:
+            self._prep_memo[key] = prepped
+            while len(self._prep_memo) > self._prep_memo_cap:
+                self._prep_memo.popitem(last=False)
+        return prepped
+
+    def _try_batched_prepare(self, req, key):
+        """One design through the family's traced prep; None on any
+        family mismatch or fault (caller falls back to the Model
+        build)."""
+        fam = self._bp_family_for(req.design, req.cases)
+        if fam is None:
+            return None
+        try:
+            lane = fam.extract(req.design)
+            (pd, nodes, args), = fam.prepare([lane])
+        except PrepFamilyError:
+            return None
+        except Exception as e:  # noqa: BLE001 — traced fault → solo
+            logger.warning(
+                "serve request %d: batched prep faulted (%s: %s); "
+                "falling back to the Model build", req.rid,
+                type(e).__name__, e)
+            return None
+        self.stats["prep_batched_designs"] += 1
+        return self._finish_batched(key, pd, nodes, args)
+
+    def _prep_solo_into(self, req, fut):
+        """Resolve a manual prep future via the solo ``_prepare`` path."""
+        try:
+            fut.set_result(self._prepare(req))
+        except Exception as e:  # noqa: BLE001 — per-design quarantine
+            fut.set_exception(e)
+
+    def _prepare_sweep_group(self, job, dis, futs):
+        """Batched twin of the per-design sweep prep-ahead: ONE traced
+        block dispatch per prep-block of coalesced sweep designs,
+        fulfilling each design's manual future.  Designs that miss the
+        family (or whose chaos hook fires) fall back / fail alone —
+        their block mates are unaffected (lanes are elementwise
+        independent in the traced program)."""
+        try:
+            self._prepare_sweep_group_inner(job, dis, futs)
+        except Exception as e:  # noqa: BLE001 — never strand a future
+            logger.exception("sweep %d: batched prep group failed",
+                             job.rid)
+            for fut in futs.values():
+                if not fut.done():
+                    fut.set_exception(e)
+
+    def _prepare_sweep_group_inner(self, job, dis, futs):
+        fam = None
+        try:
+            fam = self._bp_family_for(job.designs[dis[0]], job.cases)
+        except Exception as e:  # noqa: BLE001 — family fault → all solo
+            logger.warning("sweep %d: prep family build raised (%s: %s);"
+                           " solo prep", job.rid, type(e).__name__, e)
+            fam = None
+        lanes = []
+        for di in dis:
+            req = Request(design=job.designs[di], cases=job.cases,
+                          rid=job.rid)
+            key = self._prep_key(req.design, req.cases)
+            with self._prep_lock:
+                memo = self._prep_memo.get(key)
+                if memo is not None:
+                    self._prep_memo.move_to_end(key)
+                    self.stats["prep_memo_hits"] += 1
+            if memo is not None:
+                futs[di].set_result(memo)
+                continue
+            lane = None
+            if fam is not None:
+                try:
+                    if self._chaos is not None:
+                        self._chaos.raise_if("prep_raise", req.rid,
+                                             exc=ChaosError)
+                        self._chaos.stall_if("prep_slow", req.rid)
+                    lane = fam.extract(req.design)
+                except PrepFamilyError:
+                    lane = None
+                except Exception as e:  # noqa: BLE001 — this lane only
+                    futs[di].set_exception(e)
+                    continue
+            if lane is not None:
+                lanes.append((di, req, lane, key))
+            else:
+                self._prep_solo_into(req, futs[di])
+        if not lanes:
+            return
+        try:
+            triples = fam.prepare([ln for _, _, ln, _ in lanes])
+        except Exception as e:  # noqa: BLE001 — block fault → all solo
+            logger.warning(
+                "sweep %d: batched prep block faulted (%s: %s); "
+                "falling back to per-design prep", job.rid,
+                type(e).__name__, e)
+            for di, req, _, _ in lanes:
+                self._prep_solo_into(req, futs[di])
+            return
+        self.stats["prep_batched_groups"] += 1
+        for (di, req, _, key), (pd, nodes, args) in zip(lanes, triples):
+            try:
+                prepped = self._finish_batched(key, pd, nodes, args)
+                self.stats["prep_batched_designs"] += 1
+                futs[di].set_result(prepped)
+            except Exception as e:  # noqa: BLE001 — this lane only
+                futs[di].set_exception(e)
 
     def _manifest_flags(self):
         """Executable-compatibility flags of THIS engine's dispatches:
@@ -1022,10 +1195,26 @@ class Engine:
         """Schedule prep for the current chunk plus ONE lookahead chunk
         on the dedicated sweep prep worker, so host prep overlaps the
         device solving the previous chunk.  Called under self._lock."""
+        use_bp = batched_prep_enabled()
         for chunk in job.chunks[job.chunk_idx:job.chunk_idx + 2]:
-            for di in chunk:
-                if di in job.futs:
-                    continue
+            pend = [di for di in chunk if di not in job.futs]
+            if not pend:
+                continue
+            if use_bp:
+                # one group task per chunk: the whole chunk goes through
+                # the family's traced prep in fixed blocks instead of a
+                # Model build per design
+                futs = {}
+                for di in pend:
+                    fut = Future()
+                    fut.raft_owner_rid = job.rid
+                    fut.add_done_callback(self._on_prep_done)
+                    job.futs[di] = fut
+                    futs[di] = fut
+                self._sweep_prep_pool.submit(
+                    self._prepare_sweep_group, job, pend, futs)
+                continue
+            for di in pend:
                 req = Request(design=job.designs[di], cases=job.cases,
                               rid=job.rid)
                 fut = self._sweep_prep_pool.submit(self._prepare, req)
@@ -1609,8 +1798,16 @@ class Engine:
         """
         stopped = self._stop
         shedding = self._shedding
+        try:
+            prep_queue = sum(1 for f in list(self._prep_futs.values())
+                             if not f.done())
+        except RuntimeError:   # dict resized mid-copy: stale is fine
+            prep_queue = len(self._prep_futs)
         return {
             "queue_depth": len(self._queue),
+            "prep_queue_depth": prep_queue,
+            "prep_batched_designs": self.stats["prep_batched_designs"],
+            "prep_batched_groups": self.stats["prep_batched_groups"],
             "in_flight": len(self._outstanding),
             "sweep_jobs": len(self._sweep_jobs),
             "shedding": shedding,
@@ -1652,6 +1849,8 @@ class Engine:
             "in_flight": len(self._outstanding),
             "prep_cache_hits": self.stats["prep_cache_hits"],
             "prep_memo_hits": self.stats["prep_memo_hits"],
+            "prep_batched_designs": self.stats["prep_batched_designs"],
+            "prep_batched_groups": self.stats["prep_batched_groups"],
             "first_result_s": self.stats["first_result_s"],
             "bucket_compiles": self.stats["bucket_compiles"],
             "warmup": self.stats["warmup"],

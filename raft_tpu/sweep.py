@@ -37,6 +37,7 @@ import copy
 import dataclasses
 import itertools
 import os
+import time
 import zipfile
 
 import numpy as np
@@ -44,6 +45,11 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from raft_tpu.batched_prep import (
+    PrepFamily,
+    PrepFamilyError,
+    batched_prep_enabled,
+)
 from raft_tpu.geometry import HydroNodes
 from raft_tpu.health import FailedPoint
 from raft_tpu.model import Model, make_case_dynamics
@@ -95,6 +101,62 @@ def _prepare_design(base_design, point, apply_point, precision):
     model.analyze_unloaded()
     args, _ = model.prepare_case_inputs(verbose=False)
     return model, model.nodes.astype(model.dtype), args
+
+
+def _prepare_chunk(base_design, chunk_pts, apply_point, precision, k0,
+                   family):
+    """Host prep for one chunk: batched traced prep through ``family``
+    when available (RAFT_TPU_BATCHED_PREP — raft_tpu/batched_prep.py),
+    per-design solo fallback on family mismatch, quarantine on hard
+    failure.  Returns (preps, failed, n_batched)."""
+    n_real = len(chunk_pts)
+    preps = [None] * n_real
+    failed = []
+    n_batched = 0
+    solo = list(range(n_real))
+    if family is not None:
+        lanes, lane_idx, solo = [], [], []
+        for j, pt in enumerate(chunk_pts):
+            try:
+                design = copy.deepcopy(base_design)
+                design = apply_point(design, pt) or design
+                lanes.append(family.extract(design))
+                lane_idx.append(j)
+            except Exception as e:  # family mismatch or bad design dict;
+                # solo prep below decides between fallback and quarantine
+                if not isinstance(e, PrepFamilyError):
+                    logger.warning(
+                        "sweep point %d: batched prep extract raised "
+                        "(%s: %s); solo fallback", k0 + j,
+                        type(e).__name__, e,
+                    )
+                solo.append(j)
+        if lanes:
+            try:
+                for j, triple in zip(lane_idx, family.prepare(lanes)):
+                    preps[j] = triple
+                n_batched = len(lane_idx)
+            except Exception as e:  # noqa: BLE001 — family-level fault:
+                # every batched lane falls back to solo prep
+                logger.warning(
+                    "sweep chunk at %d: batched prep raised (%s: %s); "
+                    "solo fallback for %d design(s)", k0,
+                    type(e).__name__, e, len(lane_idx),
+                )
+                solo = sorted(solo + lane_idx)
+    for j in solo:
+        pt = chunk_pts[j]
+        try:
+            preps[j] = _prepare_design(base_design, pt, apply_point,
+                                       precision)
+        except Exception as e:  # noqa: BLE001 — quarantine any prep fault
+            msg = f"{type(e).__name__}: {e}"
+            failed.append((k0 + j, pt, msg))
+            logger.warning(
+                "sweep point %d quarantined: design prep raised (%s)",
+                k0 + j, msg,
+            )
+    return preps, failed, n_batched
 
 
 def default_collect(model, point, Xi):
@@ -301,6 +363,7 @@ def run_sweep(
     retry_nonconverged=True,
     overlap=True,
     via_buckets=None,
+    tracer=None,
 ):
     """Run the analysis over all design ``points`` with the design axis
     sharded across ``mesh`` and per-chunk checkpointing under ``out_dir``.
@@ -337,6 +400,9 @@ def run_sweep(
         fetch/retry/checkpoint tail runs unchanged, just later).
         Automatically disabled in multi-process runs, where collective
         ordering must follow the chunk order on every host.
+    tracer : raft_tpu.trace.Tracer | None
+        Records per-chunk ``prep`` spans (meta: batched, designs,
+        batched_designs) alongside the existing stage accounting.
 
     Returns
     -------
@@ -372,6 +438,21 @@ def run_sweep(
     retry_policy = SolveRetryPolicy.from_flag(retry_nonconverged)
     if out_dir:
         os.makedirs(out_dir, exist_ok=True)
+
+    # batched traced prep (RAFT_TPU_BATCHED_PREP): one family from the
+    # base design serves every chunk; designs that cannot join fall back
+    # to solo prep per point inside _prepare_chunk
+    prep_family = None
+    if batched_prep_enabled():
+        try:
+            prep_family = PrepFamily(base_design, precision=precision)
+        except Exception as e:  # noqa: BLE001 — batched prep is optional
+            logger.warning(
+                "run_sweep: batched prep unavailable (%s: %s); solo prep",
+                type(e).__name__, e,
+            )
+    prep_wall_s = 0.0
+    prep_batched = 0
 
     sharding = NamedSharding(mesh, P("design"))
 
@@ -507,26 +588,25 @@ def run_sweep(
         # host prep below overlaps the previous chunk's in-flight device
         # solve (dispatches are async; the fetch happens in _finalize)
 
-        # host prep (independent per design; the expensive part is the
-        # vmapped CPU mooring equilibrium inside prepare_case_inputs).
-        # Fault isolation: a raising design point is quarantined — its
-        # batch slot is masked with a healthy design and its result rows
-        # reported as NaN + failed, so one bad design dict cannot kill
-        # the whole sweep.
-        preps = [None] * n_real
-        failed = []
-        for j, pt in enumerate(chunk_pts):
-            try:
-                preps[j] = _prepare_design(
-                    base_design, pt, apply_point, precision
-                )
-            except Exception as e:  # noqa: BLE001 — quarantine any prep fault
-                msg = f"{type(e).__name__}: {e}"
-                failed.append((k0 + j, pt, msg))
-                logger.warning(
-                    "sweep point %d quarantined: design prep raised (%s)",
-                    k0 + j, msg,
-                )
+        # host prep (the expensive part is the mooring equilibrium +
+        # NumPy statics; RAFT_TPU_BATCHED_PREP runs the whole chunk
+        # through one traced lane-block program instead of the per-point
+        # loop).  Fault isolation: a raising design point is quarantined
+        # — its batch slot is masked with a healthy design and its
+        # result rows reported as NaN + failed, so one bad design dict
+        # cannot kill the whole sweep.
+        t_prep = time.perf_counter()
+        span = tracer.begin(
+            "prep", chunk=k, batched=prep_family is not None
+        ) if tracer is not None else None
+        preps, failed, n_batched = _prepare_chunk(
+            base_design, chunk_pts, apply_point, precision, k0,
+            prep_family,
+        )
+        if span is not None:
+            tracer.end(span, designs=n_real, batched_designs=n_batched)
+        prep_wall_s += time.perf_counter() - t_prep
+        prep_batched += n_batched
 
         ok = [j for j in range(n_real) if preps[j] is not None]
         if not ok:
@@ -636,6 +716,11 @@ def run_sweep(
     for i, _, _ in failed_all:
         mask[i] = True
     out["failed_mask"] = mask
+    # prep-stage telemetry (checkpoint-loaded chunks pay no prep):
+    # wall seconds over all freshly-prepped chunks and how many designs
+    # went through the batched traced program (0 = all solo)
+    out["prep_wall_s"] = float(prep_wall_s)
+    out["prep_batched"] = int(prep_batched)
     return out
 
 

@@ -282,7 +282,12 @@ def grouped_sweep_pipeline(model0, checkable=False):
     Fr, Fi)`` with leading [nd] (nodes) / [nd, nc] (args) axes, output
     ``(xr [nd, nc, 6, nw], xi, report)`` exactly like the vmapped
     pipeline — but through the serving buckets, one slab of canonical
-    lanes at a time."""
+    lanes at a time.
+
+    ``model0`` may be a full ``Model`` or a batched-prep
+    ``PreppedDesign`` (raft_tpu/batched_prep.py): both expose the
+    ``SlotPhysics.from_model`` attribute surface, which is all this
+    pipeline reads."""
     physics = SlotPhysics.from_model(model0)
 
     def pipeline(nodes_b, *args_b):

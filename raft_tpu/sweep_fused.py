@@ -47,6 +47,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from raft_tpu.batched_prep import batched_prep_enabled
 from raft_tpu.geometry import pack_nodes, process_members
 from raft_tpu.hydro import added_mass_morison
 from raft_tpu.io.schema import cases_as_dicts
@@ -1188,6 +1189,76 @@ def _prepare_design_point(design, rho_water, g, need_trim):
     return v
 
 
+def _batched_prep_points(designs, model0, precision, solo_prep):
+    """Flag-gated batched twin of the threaded ``_safe_prep`` map: one
+    traced geometry/statics/added-mass dispatch per fixed-size block
+    instead of a host loop per design (RAFT_TPU_BATCHED_PREP).
+
+    Designs that don't fit the family (branch-signature mismatch) or
+    whose batched stage faults fall back to ``solo_prep`` one by one, so
+    the quarantine contract is unchanged.  Returns ``(prepped,
+    n_batched)`` with ``prepped`` shaped exactly like the threaded map's
+    output, or ``None`` when no family can be built (caller runs the
+    threaded path).
+    """
+    from raft_tpu.batched_prep import PrepFamily, PrepFamilyError
+
+    try:
+        family = PrepFamily(designs[0], precision=precision,
+                            geometry_only=True)
+    except Exception as e:  # noqa: BLE001 — any family fault → host path
+        logger.warning(
+            "batched design-prep family rejected (%s: %s); using the "
+            "host prep path", type(e).__name__, e)
+        return None
+    rho_w, grav = float(model0.rho_water), float(model0.g)
+    prepped = [None] * len(designs)
+    lanes, lane_idx = [], []
+    for i, d in enumerate(designs):
+        key = (_design_key(d), rho_w, grav, False)
+        hit = _variant_cache.get(key)
+        if hit is not None:
+            prepped[i] = (hit, None)
+            continue
+        try:
+            lanes.append(family.extract(d))
+            lane_idx.append(i)
+        except PrepFamilyError:
+            prepped[i] = solo_prep(d)
+        except Exception as e:  # noqa: BLE001 — quarantine semantics
+            logger.warning(     # live in solo_prep's own try/except
+                "design %d: batched prep extract raised (%s: %s); "
+                "solo fallback", i, type(e).__name__, e)
+            prepped[i] = solo_prep(d)
+    n_batched = 0
+    if lanes:
+        try:
+            geoms = family.prepare_geometry(lanes)
+        except Exception as e:  # noqa: BLE001 — block fault → solo all
+            logger.warning(
+                "batched design-prep block faulted (%s: %s); falling "
+                "back to per-design host prep", type(e).__name__, e)
+            geoms = None
+        if geoms is None:
+            for i in lane_idx:
+                prepped[i] = solo_prep(designs[i])
+        else:
+            for i, lane, (nodes, S1, A) in zip(lane_idx, lanes, geoms):
+                ms = lane["ms"]
+                v = _GeomVariant(
+                    nodes=nodes,
+                    moor=(ms.anchors, ms.rFair, ms.L, ms.EA, ms.w,
+                          ms.Wp, ms.cb),
+                    bridles=ms.bridles,
+                    A_morison=np.asarray(A), S1=S1,
+                )
+                _variant_cache_put(
+                    (_design_key(designs[i]), rho_w, grav, False), v)
+                prepped[i] = (v, None)
+                n_batched += 1
+    return prepped, n_batched
+
+
 @lru_cache(maxsize=1)
 def _unloaded_forces_batch_fn():
     """Jitted zero-pose line forces vmapped over the design axis (cached
@@ -1288,8 +1359,17 @@ def run_design_sweep(
         except Exception as e:  # noqa: BLE001 — quarantine any prep fault
             return None, f"{type(e).__name__}: {e}"
 
-    with ThreadPoolExecutor(max_workers=8) as ex:
-        prepped = list(ex.map(_safe_prep, designs))
+    n_prep_batched = 0
+    prepped = None
+    if batched_prep_enabled() and not trim_ballast_density:
+        # trim needs S0/Su statics at 0-fill and unit-fill, which only
+        # the host path stages — batched prep covers the no-trim sweep
+        out = _batched_prep_points(designs, model0, precision, _safe_prep)
+        if out is not None:
+            prepped, n_prep_batched = out
+    if prepped is None:
+        with ThreadPoolExecutor(max_workers=8) as ex:
+            prepped = list(ex.map(_safe_prep, designs))
     failed_pts = [(i, msg) for i, (v, msg) in enumerate(prepped)
                   if v is None]
     for i, msg in failed_pts:
@@ -1311,7 +1391,9 @@ def run_design_sweep(
     )
     bridles_all = _stack_bridles(variants)
     t_host = time.perf_counter() - t0
-    tracer.add("host_prep", t_host, backend="cpu")
+    tracer.add("host_prep", t_host, backend="cpu",
+               batched=n_prep_batched > 0,
+               batched_designs=n_prep_batched)
 
     # ---- optional closed-form ballast-density trim ----
     rho_w, grav = model0.rho_water, model0.g

@@ -1,0 +1,280 @@
+"""Batched traced design-prep (RAFT_TPU_BATCHED_PREP,
+raft_tpu/batched_prep.py): the per-design host loop off the hot path.
+
+The contract under test is ISSUE 12's acceptance criteria: batched
+prep is **bit-identical to solo prep** because both run the SAME
+fixed-block traced program (batch sizes 1/3/8 and every cross
+composition agree ``np.array_equal``, array for array); a design whose
+prep raises is quarantined alone — its batch mates' prep bits don't
+move; the flag-gated sweep drivers (``run_sweep``,
+``run_design_sweep``) agree with the flag-off host path to roundoff
+with identical quarantine records; and the serve engine's batched
+counters/probe gauges fire when the flag is on.
+
+Everything here runs on synthetic designs (raft_tpu.designs) — the
+reference YAML tree is not required.
+"""
+
+import copy
+import os
+
+import numpy as np
+import pytest
+
+from raft_tpu.batched_prep import (
+    PrepFamily,
+    PrepFamilyError,
+    batched_prep_enabled,
+    family_key,
+    prep_block_size,
+)
+from raft_tpu.designs import deep_spar
+from raft_tpu.serve.engine import Engine, EngineConfig
+from raft_tpu.sweep import _prepare_chunk, run_sweep
+from raft_tpu.sweep_fused import run_design_sweep
+
+NW = (0.1, 0.4)    # tiny frequency grid keeps compiles cheap
+
+
+def _spar(rho_fill=1800.0, n_cases=2):
+    d = deep_spar(n_cases=n_cases, nw_settings=NW)
+    d["platform"]["members"][0]["rho_fill"] = [float(rho_fill), 0.0, 0.0]
+    return d
+
+
+def _nodes_arrays(nodes):
+    return [np.asarray(getattr(nodes, f))
+            for f in type(nodes).__dataclass_fields__]
+
+
+def _prep_bits_equal(a, b):
+    """(PreppedDesign, nodes, args) triples bitwise equal."""
+    return (
+        all(np.array_equal(x, y) for x, y in
+            zip(_nodes_arrays(a[1]), _nodes_arrays(b[1])))
+        and all(np.array_equal(np.asarray(x), np.asarray(y))
+                for x, y in zip(a[2], b[2]))
+    )
+
+
+@pytest.fixture(scope="module")
+def family():
+    return PrepFamily(_spar(), precision="float64")
+
+
+@pytest.fixture(scope="module")
+def lanes(family):
+    return [family.extract(_spar(1000.0 + 100.0 * i)) for i in range(8)]
+
+
+# ------------------------------------------------------- flag plumbing
+
+def test_flag_gating(monkeypatch):
+    monkeypatch.delenv("RAFT_TPU_BATCHED_PREP", raising=False)
+    assert not batched_prep_enabled()
+    for on in ("1", "true", "YES", "on"):
+        monkeypatch.setenv("RAFT_TPU_BATCHED_PREP", on)
+        assert batched_prep_enabled()
+    monkeypatch.setenv("RAFT_TPU_BATCHED_PREP", "0")
+    assert not batched_prep_enabled()
+    monkeypatch.setenv("RAFT_TPU_PREP_BLOCK", "4")
+    assert prep_block_size() == 4
+
+
+# ----------------------------------------------- batched == solo bits
+
+def test_batched_prep_bit_identity_across_compositions(family, lanes):
+    """Batch sizes 1/3/8 and shifted compositions: every lane's prep is
+    independent of its batch mates, bit for bit."""
+    solo = family.prepare([lanes[0]])
+    b3 = family.prepare(lanes[:3])
+    b8 = family.prepare(lanes[:8])
+    assert _prep_bits_equal(solo[0], b3[0])
+    assert _prep_bits_equal(solo[0], b8[0])
+    for j in range(3):
+        assert _prep_bits_equal(b3[j], b8[j]), f"lane {j}"
+    # a different composition containing lane 2: mates changed, bits not
+    shuffled = family.prepare([lanes[2], lanes[7], lanes[5]])
+    assert _prep_bits_equal(shuffled[0], b8[2])
+
+
+def test_batched_prep_matches_legacy_to_roundoff(family, lanes):
+    """The traced prep agrees with the legacy per-design host prep to
+    roundoff (NOT bitwise — different instruction order; that is why
+    the serve prep cache namespaces batched entries)."""
+    from raft_tpu.sweep import _prepare_design
+
+    d = _spar(1300.0)
+    _, nodes_s, args_s = _prepare_design(d, None, lambda dd, _p: dd,
+                                         "float64")
+    _, nodes_b, args_b = family.prepare([family.extract(d)])[0]
+    for x, y in zip(_nodes_arrays(nodes_s), _nodes_arrays(nodes_b)):
+        assert np.allclose(x, y, rtol=1e-9, atol=1e-9), "nodes drifted"
+    for x, y in zip(args_s, args_b):
+        x, y = np.asarray(x), np.asarray(y)
+        tol = 1e-7 * max(1.0, float(np.abs(x).max()) if x.size else 1.0)
+        assert np.allclose(x, y, rtol=1e-6, atol=tol), "args drifted"
+
+
+def test_family_mismatch_raises(family):
+    other = _spar()
+    other["site"]["water_depth"] = 555.0           # settings scalar
+    with pytest.raises(PrepFamilyError):
+        family.extract(other)
+    taller = _spar()
+    taller["platform"]["members"][0]["rB"] = [0.0, 0.0, 60.0]  # longer
+    with pytest.raises(PrepFamilyError):                # strip counts
+        family.extract(taller)                          # differ
+    assert family_key(_spar(1000.0)) == family_key(_spar(1900.0))
+    assert family_key(_spar()) != family_key(other)
+
+
+# -------------------------------------- mooring composition independence
+
+def test_batched_mooring_composition_independent():
+    """The converged-lane freeze in solve_equilibrium: a mooring
+    equilibrium's bits don't depend on which designs share its batch
+    (slow lanes keep iterating; converged mates stay frozen)."""
+    from raft_tpu.mooring import case_mooring_design_batch_fn, parse_mooring
+
+    d = _spar()
+    ms = parse_mooring(d["mooring"], rho_water=1025.0, g=9.81)
+    moor = tuple(np.asarray(a, float) for a in (
+        ms.anchors, ms.rFair, ms.L, ms.EA, ms.w, ms.Wp, ms.cb))
+    fn = case_mooring_design_batch_fn(1025.0, 9.81, 0.0)
+
+    def run(masses):
+        b = len(masses)
+        f6 = np.zeros((b, 1, 6))
+        m = np.asarray(masses, float)
+        v = m / 1025.0 * 1.02
+        rcg = np.tile([0.0, 0.0, -60.0], (b, 1))
+        rm = np.tile([0.0, 0.0, 10.0], (b, 1))
+        awp = np.full(b, 95.0)
+        mb = tuple(np.stack([a] * b) for a in moor)
+        r6, C, *_ = fn(f6, m, v, rcg, rm, awp, *mb, None)
+        return np.asarray(r6), np.asarray(C)
+
+    # fixed block width (the house recipe: same program, padded lanes),
+    # different mates — lane 0's bits must not move even though the
+    # heavy mate iterates longer
+    r_self, c_self = run([2.0e7, 2.0e7])
+    r_pair, c_pair = run([2.0e7, 3.5e7])
+    assert np.array_equal(r_self[0], r_pair[0])
+    assert np.array_equal(c_self[0], c_pair[0])
+
+
+# ------------------------------------------------- sweep driver wiring
+
+def _rho_points(n):
+    return [{"rho": 1000.0 + 120.0 * i} for i in range(n)]
+
+
+def _apply_rho(design, pt):
+    design["platform"]["members"][0]["rho_fill"] = [
+        float(pt["rho"]), 0.0, 0.0]
+    return design
+
+
+def _apply_rho_or_raise(design, pt):
+    if pt.get("raise"):
+        design["platform"]["members"][0]["stations"] = [0.0]   # malformed
+    return _apply_rho(design, pt)
+
+
+def test_run_sweep_batched_matches_host_path(monkeypatch):
+    base = deep_spar(n_cases=2, nw_settings=NW)
+    pts = _rho_points(4)
+    monkeypatch.setenv("RAFT_TPU_BATCHED_PREP", "0")
+    off = run_sweep(base, pts, _apply_rho, verbose=False)
+    monkeypatch.setenv("RAFT_TPU_BATCHED_PREP", "1")
+    on = run_sweep(base, pts, _apply_rho, verbose=False)
+    assert on["prep_batched"] == len(pts)
+    assert off["prep_batched"] == 0
+    assert "prep_wall_s" in on and "prep_wall_s" in off
+    assert np.allclose(off["Xi"], on["Xi"], rtol=1e-5, atol=1e-8)
+
+
+def test_batched_prep_raiser_quarantined_alone(monkeypatch, family):
+    """One design whose prep raises on BOTH paths is quarantined alone:
+    the flag-on sweep records the same failed slot as the flag-off one,
+    and its batch mates' prep bits equal a run without the raiser."""
+    base = deep_spar(n_cases=2, nw_settings=NW)
+    pts = _rho_points(3) + [{"rho": 1200.0, "raise": True}]
+    monkeypatch.setenv("RAFT_TPU_BATCHED_PREP", "1")
+    on = run_sweep(base, pts, _apply_rho_or_raise, verbose=False)
+    assert [f["index"] for f in on["failed"]] == [3]
+    assert list(np.nonzero(on["failed_mask"])[0]) == [3]
+    # prep-level: mates with and without the raiser, bit for bit
+    with_r, failed, n_b = _prepare_chunk(
+        base, pts, _apply_rho_or_raise, "float64", 0, family)
+    without, failed2, _ = _prepare_chunk(
+        base, pts[:3], _apply_rho_or_raise, "float64", 0, family)
+    assert [f[0] for f in failed] == [3] and not failed2
+    assert n_b == 3
+    for j in range(3):
+        assert _prep_bits_equal(with_r[j], without[j]), f"mate {j}"
+
+
+def test_sweep_fused_batched_prep_matches_host_path(monkeypatch):
+    import raft_tpu.sweep_fused as sf
+
+    designs = [_spar(1000.0 + 150.0 * i) for i in range(4)]
+
+    def run(flag):
+        monkeypatch.setenv("RAFT_TPU_BATCHED_PREP", flag)
+        sf._variant_cache.clear()
+        sf._variant_cache_held[0] = 0
+        return run_design_sweep(copy.deepcopy(designs), verbose=False)
+
+    off = run("0")
+    on = run("1")
+    for k, v in off.items():
+        if isinstance(v, np.ndarray) and v.dtype.kind in "fc":
+            assert np.allclose(v, on[k], rtol=1e-5, atol=1e-7,
+                               equal_nan=True), k
+
+
+# ------------------------------------------------- serve engine wiring
+
+def test_engine_batched_prep_counters_and_probe(monkeypatch, tmp_path):
+    monkeypatch.setenv("RAFT_TPU_BATCHED_PREP", "1")
+    designs = [_spar(v) for v in (1800.0, 1500.0, 1200.0)]
+    with Engine(EngineConfig(precision="float64", window_ms=5.0,
+                             cache_dir=str(tmp_path))) as eng:
+        res = eng.submit_sweep(designs, chunk=2).result(600)
+        probe = eng.probe()
+        snap = eng.snapshot()
+    assert res.status == "ok" and not res.failed_idx
+    assert snap["prep_batched_designs"] >= len(designs)
+    assert snap["prep_batched_groups"] >= 1
+    for key in ("prep_queue_depth", "prep_batched_designs",
+                "prep_batched_groups"):
+        assert key in probe, key
+    assert probe["prep_queue_depth"] == 0     # all preps resolved
+
+
+def test_prepped_design_slot_physics_surface(family, lanes):
+    """PreppedDesign carries the full SlotPhysics.from_model attribute
+    surface and matches the template Model's physics key — the bucket
+    pipelines (sweep_buckets) consume either interchangeably."""
+    from raft_tpu.serve.buckets import SlotPhysics
+
+    pd, _, _ = family.prepare([lanes[0]])[0]
+    assert SlotPhysics.from_model(pd) == SlotPhysics.from_model(
+        family.model)
+    assert float(pd.hHub) == float(lanes[0]["design"]["turbine"]["hHub"])
+
+
+def test_engine_prep_key_namespaced(monkeypatch, tmp_path):
+    """Flag on/off must never alias memo / disk-cache entries: the
+    traced prep agrees with the Model build only to roundoff."""
+    eng = Engine.__new__(Engine)       # key helper needs config only
+    eng.config = EngineConfig(precision="float64",
+                              cache_dir=str(tmp_path))
+    d = _spar()
+    monkeypatch.setenv("RAFT_TPU_BATCHED_PREP", "0")
+    k_off = eng._prep_key(d, None)
+    monkeypatch.setenv("RAFT_TPU_BATCHED_PREP", "1")
+    k_on = eng._prep_key(d, None)
+    assert k_on != k_off and k_on == k_off + "|bp"

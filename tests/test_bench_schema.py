@@ -169,6 +169,22 @@ def test_serve_sweep_section_pinned_in_compact_schema():
         assert key in bench._COMPACT_KEYS, key
 
 
+def test_batched_prep_section_pinned_in_compact_schema():
+    """The batched design-prep bench section (ISSUE 12) stays wired:
+    both entry points exist and the headline keys — the 256-design
+    prep wall A/B, the batched-design count, the bit-identity verdict,
+    and the served cold-prep p50 pair — ride the compact driver
+    line."""
+    assert callable(bench.bench_batched_prep)
+    assert callable(bench.bench_batched_prep_smoke)
+    for key in ("sweep_prep_wall_s", "sweep_prep_solo_wall_s",
+                "sweep_prep_batched", "sweep_prep_speedup",
+                "sweep_prep_bits_identical", "serve_cold_prep_p50_ms",
+                "serve_cold_prep_solo_p50_ms", "smoke_prep_ratio",
+                "smoke_prep_bits", "prep_error", "prep_smoke_error"):
+        assert key in bench._COMPACT_KEYS, key
+
+
 def test_sanitizer_covers_serve_http_values():
     out = {
         "serve_http_overhead_ms": 1.66,

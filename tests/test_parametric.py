@@ -173,18 +173,15 @@ def test_omdao_scale_partials(tmp_path):
             assert abs(ad_val - fd_val) / scale < tol, (
                 k, in_name, ad_val, fd_val)
 
-    # draft: adding this column CAUGHT a real twin-vs-model divergence
-    # (exactly what the advisor predicted).  compute() re-discretizes
-    # strip nodes from the scaled design dict (node counts jump at
-    # member-length multiples of dls_max — +eps crosses one on this
-    # design — and the waterline node is re-snapped), while the traced
-    # twin scales its FROZEN node set proportionally; in-cell the two
-    # parameterizations differ at O(eps), so the draft partial is the
-    # exact derivative of a slightly different (smooth) geometry path.
-    # Measured on this design: same sign, |ad/fd| within ~4x (backward
-    # one-sided FD to stay inside one topology cell).  Pinned here so
-    # the divergence is VISIBLE and bounded instead of silent; the
-    # restriction is documented in omdao.compute_partials.
+    # draft: this column once pinned a real twin-vs-model divergence —
+    # pack_nodes_t froze the waterline-clip and submergence masks at the
+    # template z, while compute() re-evaluates them from the scaled
+    # geometry.  The masks are now traced from the scaled z (value-only,
+    # shapes frozen), so in-cell the twin IS compute()'s smooth path and
+    # the draft partial must agree with FD like every other column.
+    # Backward one-sided FD keeps the probe inside one topology cell
+    # (node counts still jump at member-length multiples of dls_max;
+    # +eps crosses one on this design).
     fdd = {}
     for s in (1.0 - eps, 1.0 - 2 * eps):
         comp.set_val("design_scale_draft", s)
@@ -195,6 +192,5 @@ def test_omdao_scale_partials(tmp_path):
         f0, f1, f2 = base[k], fdd[1.0 - eps][k], fdd[1.0 - 2 * eps][k]
         fd_val = (3 * f0 - 4 * f1 + f2) / (2 * eps)   # 2nd-order backward
         ad_val = float(np.asarray(partials[k, "design_scale_draft"]))
-        assert np.sign(ad_val) == np.sign(fd_val), (k, ad_val, fd_val)
-        ratio = ad_val / fd_val
-        assert 0.2 < ratio < 5.0, (k, ad_val, fd_val, ratio)
+        scale = max(abs(fd_val), 1e-6 * max(abs(base[k]), 1.0))
+        assert abs(ad_val - fd_val) / scale < 5e-2, (k, ad_val, fd_val)
