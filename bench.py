@@ -104,6 +104,9 @@ _COMPACT_KEYS = (
     "kernel_backend_mode", "kernel_gj6_speedup",
     "kernel_gj6_max_abs_diff", "kernel_gjstage_speedup",
     "kernel_gjstage_max_abs_diff",
+    "serve_load_goodput", "serve_load_chaos_goodput",
+    "serve_load_lost", "serve_load_heals",
+    "smoke_load_goodput", "smoke_load_bits",
     "sweep_cold_start_s", "sweep_warm_start_s", "sweep_warm_vs_cold",
     "sweep_prep_wall_s", "sweep_prep_solo_wall_s", "sweep_prep_batched",
     "sweep_prep_speedup", "sweep_prep_bits_identical",
@@ -114,6 +117,7 @@ _COMPACT_KEYS = (
     "chaos_smoke_error", "kernel_error", "sweep_warm_error",
     "serve_http_error", "serve_http_smoke_error",
     "serve_sweep_error", "serve_sweep_smoke_error",
+    "serve_load_error", "serve_load_smoke_error",
     "sweep_waterfall_error",
     "perf_docs_error", "sweep_scaling_error", "sweep1024_error",
     "sweep4096_error", "serve_multichip_error", "multichip_smoke_error",
@@ -391,6 +395,7 @@ def main(argv=None):
                     ("serve_smoke", bench_serve_smoke),
                     ("serve_http_smoke", bench_serve_http_smoke),
                     ("serve_sweep_smoke", bench_serve_sweep_smoke),
+                    ("serve_load_smoke", bench_serve_load_smoke),
                     ("chaos_smoke", bench_chaos_smoke),
                     ("prep_smoke", bench_batched_prep_smoke),
                     ("multichip_smoke", bench_multichip_smoke),
@@ -452,6 +457,7 @@ def main(argv=None):
             ("serve", bench_serve, 5.0),
             ("serve_http", bench_serve_http, 6.0),
             ("serve_sweep", bench_serve_sweep, 8.0),
+            ("serve_load", bench_serve_load, 6.0),
             ("serve_multichip", bench_serve_multichip, 0.5),
             ("kernel", bench_kernels, 0.5),
             ("sweep_warm", bench_sweep_warm, 4.0),
@@ -1360,6 +1366,146 @@ def bench_chaos_smoke():
     }
 
 
+# ------------------------------------------------------ open-loop load
+
+def bench_serve_load_smoke():
+    """Tier-1-safe load-harness smoke: a short open-loop Poisson burst
+    against a 2-replica router with ONE replica SIGKILLed mid-run — the
+    smallest end-to-end proof of the elastic-fleet SLOs: goodput holds
+    (every offered request terminal-ok), nothing is lost, and the
+    canary answers stay bit-identical across the failover."""
+    import tempfile
+
+    from raft_tpu.designs import deep_spar
+    from raft_tpu.loadgen import LoadgenConfig, run_phase, warm_pool
+    from raft_tpu.serve import Router
+
+    t0 = time.perf_counter()
+    design = deep_spar(n_cases=2, nw_settings=(0.05, 0.5))
+    with tempfile.TemporaryDirectory() as tmp:
+        router = Router(n_replicas=2, cache_dir=tmp, precision="float64",
+                        window_ms=10.0)
+        try:
+            warm = router.evaluate(design, timeout=560)
+            assert warm.status == "ok", warm.error
+            cfg = LoadgenConfig(rate_hz=2.5, duration_s=4.0, seed=5,
+                                sweep_n=2, p_sweep=0.2, p_tight=0.0,
+                                canary_every=2, distinct=4)
+            # pre-warm the variant pool: the smoke measures the warm
+            # envelope, not per-arrival cold prep
+            for h in [router.submit(b) for b in warm_pool(cfg, design)]:
+                r = h.result(timeout=560)
+                assert r.status == "ok", r.error
+            rep = run_phase(router, cfg, design, name="smoke",
+                            chaos=("replica_kill*1:7", 0.3))
+            stats = dict(router.stats)
+        finally:
+            router.shutdown()
+    assert rep["lost"] == 0, rep
+    assert rep["goodput"] >= 0.99, rep
+    assert rep["bits_identical"] is True, rep
+    assert stats["chaos_replica_kills"] >= 1, stats
+    return {
+        "smoke_load_offered": rep["offered"],
+        "smoke_load_goodput": rep["goodput"],
+        "smoke_load_lost": rep["lost"],
+        "smoke_load_p95_ms": rep["p95_ms"],
+        "smoke_load_bits": "identical",
+        "smoke_load_retries": stats["replica_retries"],
+        "smoke_load_s": round(time.perf_counter() - t0, 3),
+    }
+
+
+def bench_serve_load():
+    """The elastic-fleet SLO envelope: one autoscale-enabled router
+    driven open-loop (raft_tpu/loadgen.py) through three phases —
+    normal load, sustained overload (the autoscaler's scale-out
+    trigger), and overload-with-chaos (replica_kill + conn_drop +
+    replica_slow all firing mid-run).  Records p50/p95/p99, goodput,
+    the rejection breakdown and the autoscaler's decision log; asserts
+    the SLO floors: goodput >= 0.99 under normal load, >= 0.8 under
+    chaos (min_replicas=2 keeps a retry survivor through the kill, and
+    the heal rule respawns the floor), and ZERO lost (never-terminal)
+    requests in every phase."""
+    import tempfile
+
+    from raft_tpu.designs import deep_spar
+    from raft_tpu.loadgen import LoadgenConfig, run_phase, warm_pool
+    from raft_tpu.serve import AutoscaleConfig, Router
+
+    t0 = time.perf_counter()
+    design = deep_spar(n_cases=2, nw_settings=(0.05, 0.5))
+    with tempfile.TemporaryDirectory() as tmp:
+        router = Router(
+            n_replicas=2, cache_dir=tmp, precision="float64",
+            window_ms=10.0, autoscale=True,
+            autoscale_config=AutoscaleConfig(
+                high_water=3.0, low_water=0.25, min_replicas=2,
+                max_replicas=3, sustain_s=1.0, cooldown_s=4.0,
+                interval_s=0.25))
+        try:
+            warm = router.evaluate(design, timeout=560)
+            assert warm.status == "ok", warm.error
+            base = dict(seed=11, sweep_n=2, p_sweep=0.1, p_tight=0.15,
+                        tight_deadline_s=5.0, distinct=6)
+            # pre-warm every body the phases can submit (bounded
+            # variant pool): the phases measure the WARM serving
+            # envelope; cold-prep cost is the serve section's figure
+            for h in [router.submit(b) for b in warm_pool(
+                    LoadgenConfig(**base), design)]:
+                r = h.result(timeout=560)
+                assert r.status == "ok", r.error
+            normal = run_phase(
+                router, LoadgenConfig(rate_hz=2.0, duration_s=6.0,
+                                      **base),
+                design, name="normal")
+            overload = run_phase(
+                router, LoadgenConfig(rate_hz=20.0, duration_s=6.0,
+                                      **base),
+                design, name="overload")
+            chaos = run_phase(
+                router, LoadgenConfig(rate_hz=3.0, duration_s=6.0,
+                                      **base),
+                design, name="chaos",
+                chaos=("replica_kill*1;conn_drop*1;"
+                       "replica_slow=0.3*1:11", 0.3))
+            stats = dict(router.stats)
+            decisions = (router.autoscaler.snapshot()["decisions"]
+                         if router.autoscaler else [])
+        finally:
+            router.shutdown()
+    phases = {"normal": normal, "overload": overload, "chaos": chaos}
+    lost = sum(p["lost"] for p in phases.values())
+    assert normal["goodput"] >= 0.99, normal
+    assert lost == 0, phases
+    # with min_replicas=2 the chaos kill always leaves a survivor for
+    # retries (and the heal rule respawns the floor), so goodput under
+    # chaos stays near 1.0 instead of collapsing with the fleet
+    assert chaos["goodput"] >= 0.8, chaos
+    rejections = {
+        status: count
+        for p in phases.values()
+        for status, count in p["statuses"].items()
+        if status.startswith("rejected_")
+    }
+    return {
+        "serve_load_phases": phases,
+        "serve_load_goodput": normal["goodput"],
+        "serve_load_p50_ms": normal["p50_ms"],
+        "serve_load_p95_ms": normal["p95_ms"],
+        "serve_load_p99_ms": normal["p99_ms"],
+        "serve_load_overload_goodput": overload["goodput"],
+        "serve_load_overload_rejected": sum(rejections.values()),
+        "serve_load_chaos_goodput": chaos["goodput"],
+        "serve_load_lost": lost,
+        "serve_load_scale_outs": stats["scale_outs"],
+        "serve_load_heals": sum(1 for d in decisions
+                                if d["action"] == "heal"),
+        "serve_load_decisions": decisions,
+        "serve_load_s": round(time.perf_counter() - t0, 3),
+    }
+
+
 # -------------------------------------------------------------- multichip
 
 def bench_serve_multichip(n_cases=4):
@@ -1854,11 +2000,17 @@ def bench_batched_prep_smoke(n_designs=8):
 def compact_results(out):
     """The driver-facing subset of the results (kept short enough that the
     recorded artifact tail stays a parseable JSON line).  Floats are
-    trimmed to 4 significant digits on the line only — the full-precision
-    values stay in BENCH_FULL.json."""
+    trimmed to 4 significant digits and long strings to a short prefix
+    ("skipped: ..." reasons collapse to just "skipped") on the line only
+    — the full-precision values stay in BENCH_FULL.json."""
     def shrink(v):
         if isinstance(v, float) and v and len(repr(v)) > 8:
             return float(f"{v:.4g}")
+        if isinstance(v, str):
+            if v.startswith("skipped"):
+                return "skipped"
+            if len(v) > 32:
+                return v[:31] + "~"
         return v
 
     return {k: shrink(out[k]) for k in _COMPACT_KEYS if k in out}

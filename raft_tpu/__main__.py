@@ -121,6 +121,11 @@ def _serve_main(argv):
                         "processes with the consistent-hash router "
                         "(default RAFT_TPU_SERVE_REPLICAS or 0 = serve "
                         "one in-process engine)")
+    p.add_argument("--autoscale", action="store_true",
+                   help="with --http --replicas: let the router grow/"
+                        "shrink the fleet against per-replica pressure "
+                        "(default RAFT_TPU_AUTOSCALE; thresholds via "
+                        "RAFT_TPU_AUTOSCALE_* — see docs/serving.md)")
     args = p.parse_args(argv)
 
     http_port = args.http
@@ -232,7 +237,8 @@ def _serve_http_main(args, http_port):
         backend = Router(
             n_replicas=n_replicas, cache_dir=args.cache_dir,
             precision=args.precision, device=args.device,
-            window_ms=args.window_ms, warmup=not args.no_warmup)
+            window_ms=args.window_ms, warmup=not args.no_warmup,
+            autoscale=True if args.autoscale else None)
     else:
         cfg = EngineConfig(precision=args.precision, device=args.device,
                            cache_dir=args.cache_dir)

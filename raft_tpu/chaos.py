@@ -51,7 +51,17 @@ Fault classes and their hook points:
                     stream while the engine handle still resolves
 ``replica_kill``    the router SIGKILLs the replica it just forwarded the
                     request to (serve/router.py) — the in-flight request
-                    must be retried on another replica, bit-identically
+                    must be retried on another replica, bit-identically.
+                    On the sweep path the kill fires after the FIRST
+                    streamed chunk, forcing the mid-stream chunk-failover
+                    path (completed chunks checkpointed, only the
+                    remaining designs resubmitted)
+``replica_slow``    the router's wire client stalls ``value`` seconds
+                    (default 0.5) after putting the request on the wire,
+                    then gives up on the reply as a too-slow replica
+                    (serve/transport.py, ``WireClient.solve``) — the
+                    router must retry on the next ring replica,
+                    bit-identically
 ==================  ======================================================
 
 Per-rid targeting caveat: the engine deduplicates prep per design key,
@@ -78,9 +88,11 @@ from raft_tpu.utils.profiling import logger
 CHAOS_ENV = "RAFT_TPU_CHAOS"
 
 FAULTS = ("prep_raise", "prep_slow", "nan_lane", "dispatch_stall",
-          "backend_error", "corrupt_cache", "conn_drop", "replica_kill")
+          "backend_error", "corrupt_cache", "conn_drop", "replica_kill",
+          "replica_slow")
 
-_DEFAULT_VALUES = {"prep_slow": 1.0, "dispatch_stall": 5.0}
+_DEFAULT_VALUES = {"prep_slow": 1.0, "dispatch_stall": 5.0,
+                   "replica_slow": 0.5}
 
 
 class ChaosError(RuntimeError):

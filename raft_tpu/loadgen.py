@@ -1,0 +1,310 @@
+"""Open-loop load generator for the serve tier (SLO measurement).
+
+Closed-loop benchmarks (submit, wait, submit) hide overload: the
+generator slows down with the server, so the measured latency stays
+flat exactly when a real client population would be piling up.  This
+module drives the engine/router **open-loop** — request arrival times
+are a Poisson process drawn up-front from a seeded RNG, and the
+submitter fires each request at its scheduled instant regardless of
+how the previous ones are doing.  Offered load is therefore an input,
+not an emergent property, which is what makes goodput (terminal-ok ÷
+offered) and the rejection breakdown meaningful SLO figures under
+sustained overload and chaos.
+
+Pieces:
+
+* ``poisson_arrivals(rate_hz, duration_s, seed)`` — arrival offsets in
+  seconds, a pure function of its arguments (tests replay it);
+* ``request_mix(n, config)`` — per-arrival kind tags from the same
+  seeded stream: ``solo`` (single design evaluation), ``sweep`` (a
+  small ``submit_sweep`` batch of ballast variants — exercises the
+  chunk path and, under chaos, the mid-stream failover), ``tight``
+  (solo with a deadline that clears warm-path latency but not an
+  overloaded queue — under overload these MUST become
+  ``rejected_deadline``, not slow answers);
+* ``run_phase(backend, config, design, ...)`` — submit the whole
+  schedule open-loop, then collect every handle and report offered,
+  terminal-status breakdown, goodput, p50/p95/p99 latency, and lost
+  (never-terminal) requests.  Every ``canary_every``-th solo request
+  reuses the byte-identical base design; the report's
+  ``bits_identical`` asserts all their ok answers are
+  ``np.array_equal`` — retries/failover under chaos must not change
+  numbers.
+
+The backend just needs the engine surface (``submit``,
+``submit_sweep``); the Router satisfies it, and tests drive a fake.
+Chaos mid-run: ``chaos=(spec, at_frac)`` arms a timer that sets
+``RAFT_TPU_CHAOS`` at ``at_frac`` of the phase duration (env saved and
+restored), so the fault lands while requests are in flight instead of
+at a quiet boundary.
+
+Env knobs (``LoadgenConfig.from_env``):
+
+Request bodies cycle through a BOUNDED variant pool
+(``distinct`` ballast variants for solos, another ``distinct`` for
+sweeps; ``warm_pool(config, design)`` enumerates it) so the harness
+measures the warm serving envelope — steady-state traffic is repeat
+requests over a working set, and the cold-prep cost is a separate
+figure, not a tax on every arrival.
+
+==============================  ======  =============================
+``RAFT_TPU_LOADGEN_RATE``       4.0     offered arrivals per second
+``RAFT_TPU_LOADGEN_DURATION_S`` 5.0     phase length (seconds)
+``RAFT_TPU_LOADGEN_SEED``       0       arrival/mix RNG seed
+``RAFT_TPU_LOADGEN_SWEEP_N``    3       designs per sweep request
+``RAFT_TPU_LOADGEN_TIGHT_S``    2.0     deadline of ``tight`` requests
+``RAFT_TPU_LOADGEN_DISTINCT``   8       variant-pool size per class
+==============================  ======  =============================
+"""
+
+import copy
+import dataclasses
+import os
+import threading
+import time
+
+import numpy as np
+
+from raft_tpu.utils.profiling import logger
+
+
+def _env_float(name, default):
+    raw = os.environ.get(name, "").strip()
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def _env_int(name, default):
+    raw = os.environ.get(name, "").strip()
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        return default
+
+
+@dataclasses.dataclass
+class LoadgenConfig:
+    """One load phase: offered rate, duration and request mix."""
+
+    rate_hz: float = 4.0
+    duration_s: float = 5.0
+    seed: int = 0
+    sweep_n: int = 3
+    tight_deadline_s: float = 2.0
+    p_sweep: float = 0.15          # fraction of arrivals that are sweeps
+    p_tight: float = 0.15          # fraction with the tight deadline
+    canary_every: int = 4          # every k-th solo reuses the base design
+    distinct: int = 8              # variant-pool size (see warm_pool)
+    collect_timeout_s: float = 120.0
+
+    @classmethod
+    def from_env(cls, **overrides):
+        cfg = cls(
+            rate_hz=_env_float("RAFT_TPU_LOADGEN_RATE", 4.0),
+            duration_s=_env_float("RAFT_TPU_LOADGEN_DURATION_S", 5.0),
+            seed=_env_int("RAFT_TPU_LOADGEN_SEED", 0),
+            sweep_n=_env_int("RAFT_TPU_LOADGEN_SWEEP_N", 3),
+            tight_deadline_s=_env_float("RAFT_TPU_LOADGEN_TIGHT_S", 2.0),
+            distinct=_env_int("RAFT_TPU_LOADGEN_DISTINCT", 8),
+        )
+        return dataclasses.replace(cfg, **overrides)
+
+
+def poisson_arrivals(rate_hz, duration_s, seed):
+    """Arrival offsets (seconds, ascending) of a Poisson process at
+    ``rate_hz`` over ``duration_s`` — a pure function of its arguments,
+    so a phase's offered schedule replays exactly per seed."""
+    rng = np.random.default_rng(int(seed))
+    arrivals = []
+    t = 0.0
+    while True:
+        t += float(rng.exponential(1.0 / float(rate_hz)))
+        if t >= float(duration_s):
+            return np.asarray(arrivals, dtype=float)
+        arrivals.append(t)
+
+
+def request_mix(n, config):
+    """Kind tag per arrival (``solo`` / ``sweep`` / ``tight``), drawn
+    from a stream seeded independently of the arrival times so changing
+    the mix never reshuffles the schedule."""
+    rng = np.random.default_rng(int(config.seed) + 0x5EED)
+    u = rng.random(int(n))
+    kinds = []
+    for x in u:
+        if x < config.p_sweep:
+            kinds.append("sweep")
+        elif x < config.p_sweep + config.p_tight:
+            kinds.append("tight")
+        else:
+            kinds.append("solo")
+    return kinds
+
+
+def _ballast_variant(design, i):
+    """The i-th distinct request body: bump the first member's ballast
+    density (a knob ``routing_key`` deliberately ignores, so variants
+    stay one replica family).  Falls back to a tag key when the design
+    lacks the member structure (fake-backend tests)."""
+    d = copy.deepcopy(design)
+    try:
+        mem = d["platform"]["members"][0]
+        fill = list(mem.get("rho_fill") or [1000.0, 0.0, 0.0])
+        fill[0] = float(fill[0]) + 10.0 * (int(i) + 1)
+        mem["rho_fill"] = fill
+    except (KeyError, IndexError, TypeError):
+        d["_loadgen_variant"] = int(i) + 1
+    return d
+
+
+def warm_pool(config, design):
+    """Every distinct request body a phase with this config can submit:
+    the base design (canaries) plus the solo and sweep variant pools.
+    The harness cycles variants through a BOUNDED pool (``distinct``)
+    so it measures the warm serving envelope — a serving tier's steady
+    state is repeat traffic over a working set, not a cold host prep
+    per arrival.  Benches submit this pool once before the measured
+    phases (cold-path cost is the ``serve`` section's own figure)."""
+    pool = [copy.deepcopy(design)]
+    pool += [_ballast_variant(design, i) for i in range(config.distinct)]
+    pool += [_ballast_variant(design, 1000 + i)
+             for i in range(config.distinct)]
+    return pool
+
+
+@dataclasses.dataclass
+class _Flight:
+    kind: str
+    handle: object
+    canary: bool = False
+    t_submit: float = 0.0
+
+
+def run_phase(backend, config, design, name="load", chaos=None,
+              clock=time.perf_counter, sleep=time.sleep):
+    """Drive one open-loop phase against ``backend`` and report SLOs.
+
+    ``chaos``: optional ``(spec_text, at_frac)`` — arm RAFT_TPU_CHAOS
+    with ``spec_text`` at ``at_frac`` of the phase duration so the
+    fault fires mid-run, restoring the previous env value afterwards.
+    Returns the phase report dict (see module docstring)."""
+    arrivals = poisson_arrivals(config.rate_hz, config.duration_s,
+                                config.seed)
+    kinds = request_mix(len(arrivals), config)
+    flights = []
+    chaos_timer = None
+    chaos_prev = os.environ.get("RAFT_TPU_CHAOS")
+    chaos_fires = None
+
+    def _arm_chaos(spec):
+        os.environ["RAFT_TPU_CHAOS"] = spec
+        logger.warning("loadgen %s: chaos armed mid-run: %s", name, spec)
+
+    if chaos is not None:
+        spec, at_frac = chaos
+        chaos_timer = threading.Timer(
+            float(at_frac) * config.duration_s, _arm_chaos, (spec,))
+        chaos_timer.daemon = True
+        chaos_timer.start()
+    t_start = clock()
+    solo_seq = 0
+    sweep_seq = 0
+    try:
+        for arr, kind in zip(arrivals, kinds):
+            lag = t_start + float(arr) - clock()
+            if lag > 0:
+                sleep(lag)
+            try:
+                if kind == "sweep":
+                    h = backend.submit_sweep(
+                        [_ballast_variant(design, 1000 + (sweep_seq + j)
+                                          % config.distinct)
+                         for j in range(config.sweep_n)])
+                    sweep_seq += 1
+                    flights.append(_Flight("sweep", h,
+                                           t_submit=clock() - t_start))
+                else:
+                    canary = (kind == "solo"
+                              and solo_seq % config.canary_every == 0)
+                    body = design if canary \
+                        else _ballast_variant(design,
+                                              solo_seq % config.distinct)
+                    if kind == "solo":
+                        solo_seq += 1
+                    deadline = config.tight_deadline_s \
+                        if kind == "tight" else None
+                    h = backend.submit(body, deadline_s=deadline)
+                    flights.append(_Flight(kind, h, canary=canary,
+                                           t_submit=clock() - t_start))
+            except RuntimeError as exc:       # backend refused at the door
+                flights.append(_Flight(kind, None))
+                logger.warning("loadgen %s: submit refused: %s", name, exc)
+    finally:
+        if chaos_timer is not None:
+            chaos_timer.cancel()
+            chaos_timer.join(timeout=1.0)
+    # ---- collect: every accepted request must reach a terminal status
+    statuses = {}
+    lost = 0
+    ok_lat = []
+    canary_bits = []
+    for fl in flights:
+        if fl.handle is None:
+            statuses["refused"] = statuses.get("refused", 0) + 1
+            continue
+        try:
+            res = fl.handle.result(timeout=config.collect_timeout_s)
+        except Exception as exc:               # noqa: BLE001 — timeout =
+            lost += 1                          # lost request, the SLO sin
+            logger.warning("loadgen %s: %s request never reached a "
+                           "terminal status (%s)", name, fl.kind, exc)
+            continue
+        status = getattr(res, "status", None) or "unknown"
+        statuses[status] = statuses.get(status, 0) + 1
+        if status == "ok":
+            ok_lat.append(float(getattr(res, "latency_s", 0.0)))
+            if fl.canary and getattr(res, "Xi", None) is not None:
+                canary_bits.append(np.asarray(res.Xi))
+    if chaos is not None:
+        from raft_tpu.chaos import get_injector
+
+        inj = get_injector()
+        chaos_fires = inj.snapshot() if inj is not None else None
+        if chaos_prev is None:
+            os.environ.pop("RAFT_TPU_CHAOS", None)
+        else:
+            os.environ["RAFT_TPU_CHAOS"] = chaos_prev
+    offered = len(flights)
+    ok = statuses.get("ok", 0)
+    lat_ms = np.asarray(sorted(ok_lat)) * 1e3
+    bits = None
+    if len(canary_bits) >= 2:
+        bits = all(np.array_equal(canary_bits[0], b)
+                   for b in canary_bits[1:])
+    report = {
+        "name": name,
+        "offered": offered,
+        "rate_hz": round(config.rate_hz, 3),
+        "duration_s": round(config.duration_s, 3),
+        "wall_s": round(clock() - t_start, 3),
+        "statuses": statuses,
+        "ok": ok,
+        "goodput": round(ok / offered, 4) if offered else 1.0,
+        "lost": lost,
+        "p50_ms": round(float(np.percentile(lat_ms, 50)), 2)
+        if len(lat_ms) else None,
+        "p95_ms": round(float(np.percentile(lat_ms, 95)), 2)
+        if len(lat_ms) else None,
+        "p99_ms": round(float(np.percentile(lat_ms, 99)), 2)
+        if len(lat_ms) else None,
+        "canaries_ok": len(canary_bits),
+        "bits_identical": bits,
+    }
+    if chaos_fires is not None:
+        report["chaos"] = chaos_fires
+    logger.info("loadgen %s: offered=%d goodput=%.3f lost=%d p95=%s",
+                name, offered, report["goodput"], lost,
+                report["p95_ms"])
+    return report

@@ -49,11 +49,28 @@ optional priority preemption at waterfall block boundaries
 bit-identically (docs/serving.md, "Sweep requests & priority
 preemption").
 
+Elastic fleet (PR 13): an in-router autoscaler
+(:mod:`raft_tpu.serve.autoscale`, ``RAFT_TPU_AUTOSCALE``) reads each
+replica's lock-free pressure gauge via ``/statz`` and grows/shrinks
+the fleet against high/low-water thresholds with hysteresis —
+scale-out moves only the new replica's hash-ring arcs and starts warm
+off the shared cache, scale-in drains first so no accepted request is
+lost; the router checkpoints streamed sweep chunks and fails the
+*remaining* designs over to the next ring replica when a replica dies
+mid-sweep.  SLOs are measured by the open-loop Poisson load harness
+(:mod:`raft_tpu.loadgen`) under normal load, sustained overload and
+mid-run chaos (docs/robustness.md, "Autoscaling" / "Load harness &
+SLOs").
+
 Entry points: ``python -m raft_tpu serve [--http PORT [--replicas N]]``
 / ``warmup`` (CLI) and the in-process :class:`Engine` API used by
 tests and ``bench.py``.  Design document: docs/serving.md.
 """
 
+from raft_tpu.serve.autoscale import (  # noqa: F401
+    AutoscaleConfig,
+    Autoscaler,
+)
 from raft_tpu.serve.buckets import (  # noqa: F401
     BucketSpec,
     SlotPhysics,

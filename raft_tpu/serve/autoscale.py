@@ -1,0 +1,263 @@
+"""Autoscaler policy loop for the replica router (elastic fleet).
+
+The Router (serve/router.py) already had everything an autoscaler
+needs — a lock-free per-replica pressure gauge (``Engine.probe()``
+served via ``/statz``), a consistent-hash ring where growth moves only
+the new replica's vnode arcs, a shared warm cache so a fresh replica
+answers its first request at warm-path latency, and a drain-first
+SIGTERM story that resolves every accepted request with a terminal
+status.  This module adds the missing POLICY: a small deterministic
+loop that reads the fleet's gauges and spawns/retires replicas against
+high/low-water pressure thresholds with hysteresis.
+
+Policy (``Autoscaler.step``, one evaluation per tick):
+
+* **pressure** = mean over alive replicas of (queue_depth + in_flight),
+  with any replica actively shedding treated as high pressure outright
+  (shedding means its bounded queue already overflowed — the strongest
+  overload signal the engine emits);
+* **heal** when the number of ALIVE replicas falls below
+  ``min_replicas`` (a chaos kill or crash, not a policy decision):
+  reap the corpses from the ring (``fleet.reap_dead``, when offered —
+  their arcs move to survivors so retries stop burning hops on dead
+  processes) and spawn a replacement IMMEDIATELY — the floor is an
+  availability invariant, so healing bypasses both the hysteresis
+  window and the cooldown (one spawn per tick still bounds the rate);
+* **scale-out** when pressure has been at/above ``high_water``
+  continuously for ``sustain_s`` (the hysteresis window: a single
+  burst tick never spawns a process) and the fleet is below
+  ``max_replicas``;
+* **scale-in** when pressure has been at/below ``low_water``
+  continuously for ``sustain_s`` and the fleet is above
+  ``min_replicas`` — retirement is drain-first
+  (``Router.retire_replica``), so scale-in can never lose an accepted
+  request;
+* **cooldown**: after any action the policy holds for ``cooldown_s``
+  before acting again, so one overload episode produces a measured
+  ramp, not a flap storm.
+
+Determinism: the loop takes an injected ``clock`` and acts only inside
+``step()`` — unit tests (tests/test_autoscale.py) drive it against a
+fake fleet with a hand-advanced clock and get byte-identical decision
+logs.  The live thread (``start()``) merely calls ``step()`` every
+``interval_s``.
+
+The fleet object must provide ``replica_gauges() -> {rid: doc|None}``,
+``scale_out() -> rid``, ``retire_replica(rid) -> bool`` and
+``retire_candidate() -> rid|None`` — the Router implements exactly
+this surface (plus the optional ``reap_dead() -> [rid]`` the heal
+rule uses when present).
+
+Env knobs (read by ``AutoscaleConfig.from_env``; ``RAFT_TPU_AUTOSCALE``
+itself enables the loop inside Router):
+
+=============================  =======  ==============================
+``RAFT_TPU_AUTOSCALE_HIGH``    4.0      high-water pressure/replica
+``RAFT_TPU_AUTOSCALE_LOW``     0.5      low-water pressure/replica
+``RAFT_TPU_AUTOSCALE_MIN``     1        floor replica count
+``RAFT_TPU_AUTOSCALE_MAX``     4        ceiling replica count
+``RAFT_TPU_AUTOSCALE_SUSTAIN`` 2.0      hysteresis window (s)
+``RAFT_TPU_AUTOSCALE_COOLDOWN`` 5.0     post-action hold (s)
+``RAFT_TPU_AUTOSCALE_INTERVAL`` 1.0     live-loop tick period (s)
+=============================  =======  ==============================
+"""
+
+import dataclasses
+import os
+import threading
+import time
+
+from raft_tpu.utils.profiling import logger
+
+
+def _env_float(name, default):
+    raw = os.environ.get(name, "").strip()
+    try:
+        return float(raw) if raw else default
+    except ValueError:
+        return default
+
+
+def _env_int(name, default):
+    raw = os.environ.get(name, "").strip()
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        return default
+
+
+@dataclasses.dataclass
+class AutoscaleConfig:
+    """Thresholds + hysteresis of the policy loop (module docstring)."""
+
+    high_water: float = 4.0
+    low_water: float = 0.5
+    min_replicas: int = 1
+    max_replicas: int = 4
+    sustain_s: float = 2.0
+    cooldown_s: float = 5.0
+    interval_s: float = 1.0
+
+    @classmethod
+    def from_env(cls):
+        return cls(
+            high_water=_env_float("RAFT_TPU_AUTOSCALE_HIGH", 4.0),
+            low_water=_env_float("RAFT_TPU_AUTOSCALE_LOW", 0.5),
+            min_replicas=_env_int("RAFT_TPU_AUTOSCALE_MIN", 1),
+            max_replicas=_env_int("RAFT_TPU_AUTOSCALE_MAX", 4),
+            sustain_s=_env_float("RAFT_TPU_AUTOSCALE_SUSTAIN", 2.0),
+            cooldown_s=_env_float("RAFT_TPU_AUTOSCALE_COOLDOWN", 5.0),
+            interval_s=_env_float("RAFT_TPU_AUTOSCALE_INTERVAL", 1.0),
+        )
+
+
+class Autoscaler:
+    """Deterministic policy loop over a fleet (see module docstring)."""
+
+    def __init__(self, fleet, config=None, clock=time.monotonic):
+        self.fleet = fleet
+        self.config = config or AutoscaleConfig()
+        self.clock = clock
+        self.decisions = []        # [{t, action, replica, pressure, ...}]
+        self.steps = 0
+        self._t0 = clock()
+        self._high_since = None    # clock() when pressure crossed high
+        self._low_since = None
+        self._last_action_t = None
+        self._stop_evt = threading.Event()
+        self._thread = None
+
+    # ------------------------------------------------------------ policy
+
+    def pressure(self, gauges):
+        """(pressure per alive replica, any-shedding, n_alive) from one
+        round of ``/statz`` gauges; dead/unreachable replicas read as
+        None and count toward neither."""
+        live = [g for g in gauges.values() if g]
+        if not live:
+            return 0.0, False, 0
+        total = sum(float(g.get("queue_depth", 0))
+                    + float(g.get("in_flight", 0)) for g in live)
+        shedding = any(g.get("shedding") for g in live)
+        return total / len(live), shedding, len(live)
+
+    def step(self):
+        """One policy evaluation; returns the decision record when an
+        action was taken, else None.  All state transitions happen here
+        so an injected clock replays the policy exactly."""
+        now = self.clock()
+        self.steps += 1
+        gauges = self.fleet.replica_gauges()
+        per, shedding, alive = self.pressure(gauges)
+        n = len(gauges)
+        high = shedding or per >= self.config.high_water
+        low = (not shedding) and per <= self.config.low_water
+        # hysteresis clocks: the condition must hold CONTINUOUSLY
+        if not high:
+            self._high_since = None
+        elif self._high_since is None:
+            self._high_since = now
+        if not low:
+            self._low_since = None
+        elif self._low_since is None:
+            self._low_since = now
+        # heal: alive count below the floor means a replica DIED (chaos
+        # kill, crash) rather than a policy choice — the floor is an
+        # availability invariant, so repair skips hysteresis/cooldown
+        if alive < self.config.min_replicas:
+            reap = getattr(self.fleet, "reap_dead", None)
+            reaped = reap() if reap is not None else []
+            # ceiling still binds: an unreachable-but-alive replica
+            # (slow /statz) reads as dead, and unbounded healing on
+            # that misread would blow past max_replicas
+            if n - len(reaped) < self.config.max_replicas:
+                replica = self.fleet.scale_out()
+                self._last_action_t = now
+                self._high_since = self._low_since = None
+                rec = self._record(now, "heal", replica, per, shedding,
+                                   alive + 1)
+                if reaped:
+                    rec["reaped"] = list(reaped)
+                return rec
+            return None
+        in_cooldown = (self._last_action_t is not None
+                       and now - self._last_action_t
+                       < self.config.cooldown_s)
+        if in_cooldown:
+            return None
+        if (high and self._high_since is not None
+                and now - self._high_since >= self.config.sustain_s
+                and n < self.config.max_replicas):
+            replica = self.fleet.scale_out()
+            self._last_action_t = now
+            self._high_since = None
+            return self._record(now, "scale_out", replica, per,
+                                shedding, n + 1)
+        if (low and self._low_since is not None
+                and now - self._low_since >= self.config.sustain_s
+                and alive > self.config.min_replicas):
+            replica = self.fleet.retire_candidate()
+            if replica is None:
+                return None
+            if not self.fleet.retire_replica(replica):
+                return None
+            self._last_action_t = now
+            self._low_since = None
+            return self._record(now, "scale_in", replica, per,
+                                shedding, n - 1)
+        return None
+
+    def _record(self, now, action, replica, per, shedding, n_after):
+        rec = {
+            "t": round(now - self._t0, 3),
+            "action": action,
+            "replica": replica,
+            "pressure": round(per, 3),
+            "shedding": bool(shedding),
+            "replicas": int(n_after),
+        }
+        self.decisions.append(rec)
+        logger.warning("autoscale %s: %s (pressure %.2f%s, fleet -> %d)",
+                       action, replica, per,
+                       ", shedding" if shedding else "", n_after)
+        return rec
+
+    def snapshot(self):
+        return {
+            "steps": self.steps,
+            "decisions": list(self.decisions),
+            "scale_outs": sum(1 for d in self.decisions
+                              if d["action"] == "scale_out"),
+            "scale_ins": sum(1 for d in self.decisions
+                             if d["action"] == "scale_in"),
+            "heals": sum(1 for d in self.decisions
+                         if d["action"] == "heal"),
+            "config": dataclasses.asdict(self.config),
+        }
+
+    # --------------------------------------------------------- live loop
+
+    def start(self):
+        """Run ``step()`` every ``interval_s`` on a daemon thread (the
+        production mode; tests drive ``step()`` directly instead)."""
+        if self._thread is not None:
+            return self
+        self._stop_evt.clear()
+
+        def _loop():
+            while not self._stop_evt.wait(self.config.interval_s):
+                try:
+                    self.step()
+                except Exception:  # noqa: BLE001 — policy must outlive
+                    logger.exception("autoscaler step failed")
+
+        self._thread = threading.Thread(
+            target=_loop, name="raft-autoscale", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout=5.0):
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None

@@ -61,7 +61,7 @@ import queue
 import threading
 import time
 from collections import OrderedDict
-from concurrent.futures import Future, ThreadPoolExecutor
+from concurrent.futures import CancelledError, Future, ThreadPoolExecutor
 
 import numpy as np
 
@@ -530,7 +530,7 @@ class Engine:
         self._mesh_width = len(primary) if primary else 1
         self._lane_mesh = primary is not None
         self.stats = {
-            "requests": 0, "dispatches": 0, "failed": 0,
+            "requests": 0, "dispatches": 0, "ok": 0, "failed": 0,
             "rejected_deadline": 0, "rejected_overload": 0,
             "rejected_circuit": 0, "watchdog_timeout": 0,
             "watchdog_trips": 0, "dispatch_retries": 0,
@@ -1507,6 +1507,16 @@ class Engine:
                                 "fresh prep", req.rid, owner,
                                 type(e).__name__)
                             continue
+                if isinstance(e, CancelledError) and self._stop:
+                    # the no-drain shutdown cancelled this pending prep:
+                    # the request was never served, so it resolves
+                    # "shutdown" (retryable at the router), not "failed"
+                    self.stats["shutdown_resolved"] += 1
+                    self._resolve(pend, RequestResult(
+                        rid=req.rid, status="shutdown",
+                        error="engine stopped before prep",
+                        latency_s=time.perf_counter() - req.t_submit))
+                    continue
                 self.stats["failed"] += 1
                 logger.warning(
                     "serve request %d quarantined: prep raised (%s: %s)",
@@ -1708,12 +1718,13 @@ class Engine:
             self.stats["latency_s"].append(latency)
             if self.stats["first_result_s"] is None:
                 self.stats["first_result_s"] = latency
-            self._resolve(pend, RequestResult(
-                rid=req.rid, status="ok", Xi=Xi, std=std,
-                solve_report=report_dict(rep), bucket=spec,
-                latency_s=latency, queue_s=t0 - req.t_submit,
-                batch_requests=len(members),
-                batch_occupancy=occupancy, backend=backend))
+            if self._resolve(pend, RequestResult(
+                    rid=req.rid, status="ok", Xi=Xi, std=std,
+                    solve_report=report_dict(rep), bucket=spec,
+                    latency_s=latency, queue_s=t0 - req.t_submit,
+                    batch_requests=len(members),
+                    batch_occupancy=occupancy, backend=backend)):
+                self.stats["ok"] += 1
 
     def _count_dispatch_retry(self, _attempt, _exc):
         self.stats["dispatch_retries"] += 1
@@ -1817,6 +1828,19 @@ class Engine:
             "low_water": self.config.low_water,
             "breakers_open": self._breakers.open_count(),
             "breaker_states": self._breakers.states(),
+            # monotonic uptime + cumulative terminal-status counters: the
+            # autoscaler and the load harness compute goodput from this
+            # gauge instead of scraping JSONL events (all GIL-atomic
+            # dict reads — still lock-free)
+            "uptime_s": time.perf_counter() - self._t_start,
+            "requests": self.stats["requests"],
+            "ok": self.stats["ok"],
+            "failed": self.stats["failed"],
+            "rejected_deadline": self.stats["rejected_deadline"],
+            "rejected_overload": self.stats["rejected_overload"],
+            "rejected_circuit": self.stats["rejected_circuit"],
+            "watchdog_timeout": self.stats["watchdog_timeout"],
+            "shutdown_resolved": self.stats["shutdown_resolved"],
         }
 
     def snapshot(self):
@@ -1826,10 +1850,18 @@ class Engine:
         out = {
             "requests": self.stats["requests"],
             "dispatches": self.stats["dispatches"],
+            "ok": self.stats["ok"],
             "failed": self.stats["failed"],
             "rejected_deadline": self.stats["rejected_deadline"],
             "rejected_overload": self.stats["rejected_overload"],
             "rejected_circuit": self.stats["rejected_circuit"],
+            "watchdog_timeout": self.stats["watchdog_timeout"],
+            "uptime_s": round(time.perf_counter() - self._t_start, 3),
+            # the probe gauge rides /statz too, so one scrape feeds the
+            # autoscaler's pressure signal and the goodput counters
+            "shedding": self._shedding,
+            "accepting": not (self._stop or self._shedding),
+            "breakers_open": self._breakers.open_count(),
             "watchdog_trips": self.stats["watchdog_trips"],
             "dispatch_retries": self.stats["dispatch_retries"],
             "shed_events": self.stats["shed_events"],
@@ -1847,6 +1879,9 @@ class Engine:
             "outstanding": len(self._outstanding),
             "queue_depth": len(self._queue),
             "in_flight": len(self._outstanding),
+            "prep_queue_depth": sum(
+                1 for f in list(self._prep_futs.values())
+                if not f.done()),
             "prep_cache_hits": self.stats["prep_cache_hits"],
             "prep_memo_hits": self.stats["prep_memo_hits"],
             "prep_batched_designs": self.stats["prep_batched_designs"],

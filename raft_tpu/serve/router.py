@@ -33,8 +33,30 @@ remaining deadline is re-checked per attempt.
 
 Fault injection: the ``replica_kill`` chaos fault (chaos.py) SIGKILLs
 the replica a request was just forwarded to, forcing the
-retry-on-other-replica path; the chaos env is stripped from replica
-processes so the fault stays at the router tier.
+retry-on-other-replica path (on the sweep path it fires after the first
+streamed chunk, forcing mid-stream chunk failover); ``replica_slow``
+stalls the wire client past its patience so the router retries a
+too-slow replica.  The chaos env is stripped from replica processes so
+the faults stay at the router tier.
+
+Sweep chunk failover (closes the PR 11 hole "no cross-replica retry
+after first chunk"): the forwarding thread checkpoints every completed
+chunk doc it relays (the PR 2 checkpoint wire schema is already the
+stream format), and when the serving replica dies mid-stream it
+resubmits ONLY the designs no completed chunk covers to the next ring
+replica, remapping the relayed chunk docs back to original design
+indices — the reassembled ``SweepResult`` is ``np.array_equal``
+-identical to an uninterrupted run because every replica compiles the
+same fixed-shape programs (pinned in tests/test_elastic.py).
+
+Elastic fleet: ``scale_out()`` spawns one more replica (only the new
+replica's vnode arcs move on the ring; the shared cache dir means it
+starts warm) and ``retire_replica()`` is drain-first — the ring drops
+the replica before SIGTERM, the replica's engine resolves every
+accepted request with a terminal status, and forwards answered with
+``shutdown`` retry on a surviving replica.  The autoscaler policy loop
+(serve/autoscale.py, ``RAFT_TPU_AUTOSCALE``) drives both from the
+``/statz`` gauges.
 """
 
 import hashlib
@@ -297,18 +319,30 @@ class Router:
                  device=None, window_ms=None, warmup=True,
                  replica_argv=(), env_overrides=None,
                  endpoints=None, ready_timeout_s=DEFAULT_READY_TIMEOUT_S,
-                 breaker_failures=3, breaker_cooldown_s=5.0):
+                 breaker_failures=3, breaker_cooldown_s=5.0,
+                 autoscale=None, autoscale_config=None):
         self.cache_dir = str(cache_dir) if cache_dir else None
         self._lock = threading.Lock()
         self._rid = 0
         self._stop = False
         self._outstanding = {}
+        self._t_start = time.monotonic()
         self.stats = {
             "requests": 0, "forwarded": 0, "replica_retries": 0,
             "dead_replica_skips": 0, "rejected_deadline": 0,
             "failed": 0, "ok": 0, "shutdown_resolved": 0,
-            "chaos_replica_kills": 0, "sweeps": 0,
+            "chaos_replica_kills": 0, "chaos_replica_slows": 0,
+            "sweeps": 0, "sweep_chunk_failovers": 0,
+            "scale_outs": 0, "scale_ins": 0, "reaps": 0,
         }
+        # spawn recipe kept for scale_out (None in attach mode: the
+        # router does not own attached processes, so it cannot grow or
+        # retire them)
+        self._spawn_kw = None if endpoints is not None else dict(
+            cache_dir=self.cache_dir, precision=precision, device=device,
+            window_ms=window_ms, warmup=warmup, extra_argv=replica_argv,
+            env_overrides=env_overrides, ready_timeout_s=ready_timeout_s)
+        self._next_replica = n_replicas
         if endpoints is not None:          # attach mode
             self.replicas = {
                 f"r{i}": Replica(f"r{i}", host, port)
@@ -341,6 +375,18 @@ class Router:
         self._pool = ThreadPoolExecutor(
             max_workers=max(8, 4 * len(self.replicas)),
             thread_name_prefix="router-fwd")
+        self.autoscaler = None
+        if autoscale is None:
+            autoscale = os.environ.get(
+                "RAFT_TPU_AUTOSCALE", "").strip().lower() in (
+                "1", "true", "yes", "on")
+        if autoscale and self._spawn_kw is not None:
+            from raft_tpu.serve.autoscale import (AutoscaleConfig,
+                                                  Autoscaler)
+
+            self.autoscaler = Autoscaler(
+                self, autoscale_config or AutoscaleConfig.from_env())
+            self.autoscaler.start()
         logger.info("router up: %d replica(s) %s", len(self.replicas),
                     {r.id: r.port for r in self.replicas.values()})
 
@@ -399,7 +445,8 @@ class Router:
         return handle
 
     def probe(self):
-        alive = sum(1 for r in self.replicas.values() if not r.dead())
+        alive = sum(1 for r in list(self.replicas.values())
+                    if not r.dead())
         stopped = self._stop
         return {
             "queue_depth": len(self._outstanding),
@@ -411,15 +458,126 @@ class Router:
             "replicas_alive": alive,
             "breakers_open": self._breakers.open_count(),
             "breaker_states": self._breakers.states(),
+            "uptime_s": time.monotonic() - self._t_start,
+            "requests": self.stats["requests"],
+            "ok": self.stats["ok"],
+            "failed": self.stats["failed"],
+            "rejected_deadline": self.stats["rejected_deadline"],
+            "shutdown_resolved": self.stats["shutdown_resolved"],
         }
 
     def snapshot(self):
         out = dict(self.stats)
         out["in_flight"] = len(self._outstanding)
         out["queue_depth"] = len(self._outstanding)
-        out["replicas"] = [r.info() for r in self.replicas.values()]
+        out["uptime_s"] = round(time.monotonic() - self._t_start, 3)
+        out["replicas"] = [r.info() for r in list(self.replicas.values())]
         out["breakers"] = self._breakers.snapshot()
+        if self.autoscaler is not None:
+            out["autoscale"] = self.autoscaler.snapshot()
         return out
+
+    # -- elastic fleet ----------------------------------------------
+
+    def replica_gauges(self):
+        """One ``/statz`` scrape per replica -> {replica_id: doc|None}
+        (None for dead/unreachable replicas) — the autoscaler's input."""
+        gauges = {}
+        for rid, rep in list(self.replicas.items()):
+            if rep.dead():
+                gauges[rid] = None
+                continue
+            try:
+                _code, doc = rep.client.get("/statz", timeout=5.0)
+                gauges[rid] = doc
+            except Exception as exc:  # noqa: BLE001 — unreachable
+                gauges[rid] = None    # reads as dead; debug level since
+                # a corpse fires this every tick until heal reaps it
+                logger.debug("statz scrape of %s failed: %s", rid, exc)
+        return gauges
+
+    def scale_out(self):
+        """Spawn one more replica and claim only its vnode arcs on the
+        ring (every other replica keeps its warmed buckets; the shared
+        cache dir means the newcomer starts warm).  Returns the new
+        replica id."""
+        if self._spawn_kw is None:
+            raise RuntimeError(
+                "cannot scale out an attached-endpoint router")
+        with self._lock:
+            if self._stop:
+                raise RuntimeError("router is shut down")
+            replica_id = f"r{self._next_replica}"
+            self._next_replica += 1
+        rep = spawn_replica(replica_id, **self._spawn_kw)
+        with self._lock:
+            if self._stop:          # raced a shutdown: don't leak it
+                rep.proc.send_signal(signal.SIGTERM)
+                raise RuntimeError("router is shut down")
+            self.replicas[replica_id] = rep
+            self._ring = HashRing(sorted(self.replicas))
+            self.stats["scale_outs"] += 1
+        logger.info("scale-out: %s up on port %d (%d replicas)",
+                    replica_id, rep.port, len(self.replicas))
+        return replica_id
+
+    def reap_dead(self):
+        """Drop replicas whose PROCESS has died (chaos kill, crash —
+        not drain-first retirement) from the registry and ring, so
+        their vnode arcs move to survivors and forwards stop burning a
+        retry hop on a corpse.  The autoscaler's heal rule calls this
+        before spawning a replacement.  Returns the reaped ids."""
+        reaped = []
+        with self._lock:
+            for rid, rep in list(self.replicas.items()):
+                if rep.dead():
+                    del self.replicas[rid]
+                    reaped.append(rid)
+            if reaped:
+                self._ring = HashRing(sorted(self.replicas))
+                self.stats["reaps"] += len(reaped)
+        for rid in reaped:
+            logger.warning("reaped dead replica %s (process exited)",
+                           rid)
+        return reaped
+
+    def retire_candidate(self):
+        """The replica a scale-in should retire: the youngest (highest-
+        numbered) alive replica, so retirement exactly unwinds the last
+        scale-out's ring arcs."""
+        alive = [rid for rid, rep in sorted(self.replicas.items())
+                 if not rep.dead()]
+        if len(alive) <= 1:
+            return None
+        return max(alive, key=lambda rid: (len(rid), rid))
+
+    def retire_replica(self, replica_id, timeout=60.0):
+        """Drain-first retirement: drop the replica from the ring (new
+        placements stop immediately), then SIGTERM it — its transport
+        drains, resolving every accepted request with a terminal status
+        (in-flight router forwards either get their result line or a
+        ``shutdown`` line, which retries on a surviving replica) — and
+        reap the process.  No accepted request is lost."""
+        with self._lock:
+            rep = self.replicas.get(replica_id)
+            if rep is None or len(self.replicas) <= 1:
+                return False
+            del self.replicas[replica_id]
+            self._ring = HashRing(sorted(self.replicas))
+            self.stats["scale_ins"] += 1
+        if rep.proc is not None and rep.proc.poll() is None:
+            rep.proc.send_signal(signal.SIGTERM)
+            try:
+                rep.proc.wait(timeout)
+            except subprocess.TimeoutExpired:
+                logger.warning("retiring replica %s ignored SIGTERM; "
+                               "killing", replica_id)
+                rep.proc.kill()
+                rep.proc.wait(5)
+        rep.alive = False
+        logger.info("scale-in: %s retired (%d replicas)", replica_id,
+                    len(self.replicas))
+        return True
 
     def shutdown(self, wait=True, drain=False, timeout=30.0):
         """Stop admitting, resolve every outstanding handle with a
@@ -429,6 +587,8 @@ class Router:
             if self._stop:
                 return
             self._stop = True
+        if self.autoscaler is not None:
+            self.autoscaler.stop()
         self._pool.shutdown(wait=wait)
         with self._lock:
             leftovers = list(self._outstanding.items())
@@ -483,7 +643,7 @@ class Router:
         last_err = None
         attempted = breaker_skips = 0
         for replica_id in order:
-            rep = self.replicas[replica_id]
+            rep = self.replicas.get(replica_id)
             elapsed = time.perf_counter() - t0
             if deadline_s is not None and deadline_s - elapsed <= 0:
                 self.stats["rejected_deadline"] += 1
@@ -491,6 +651,9 @@ class Router:
                     "rid": rid, "status": "rejected_deadline",
                     "error": f"deadline expired after {elapsed:.3f}s at "
                              f"router (last: {last_err})"}))
+            if rep is None:                # retired mid-flight
+                last_err = f"{replica_id} retired"
+                continue
             if rep.dead():
                 self.stats["dead_replica_skips"] += 1
                 self._breakers.get(replica_id).record_failure(
@@ -513,13 +676,21 @@ class Router:
                     if rep.proc is not None:
                         rep.proc.kill()
                         rep.proc.wait(10)
+            slow_s = None
+            if inj is not None:
+                rule = inj.should("replica_slow", rid)
+                if rule is not None:
+                    self.stats["chaos_replica_slows"] += 1
+                    slow_s = float(rule.value
+                                   if rule.value is not None else 0.5)
             req = {"design": design, "cases": cases, "xi": True}
             if deadline_s is not None:
                 req["deadline_s"] = deadline_s - elapsed
             try:
                 self.stats["forwarded"] += 1
                 attempted += 1
-                doc = rep.client.solve(req, on_sent=on_sent)
+                doc = rep.client.solve(req, on_sent=on_sent,
+                                       slow_s=slow_s)
             except (ConnectionDropped, TransientError) as e:
                 breaker.record_failure(str(e))
                 self.stats["replica_retries"] += 1
@@ -537,8 +708,8 @@ class Router:
                 continue
             breaker.record_success()
             rep.served += 1
-            self.stats["ok" if doc.get("status") == "ok"
-                       else "failed"] += 1
+            status = doc.get("status") or "failed"
+            self.stats[status] = self.stats.get(status, 0) + 1
             res = wire.result_from_doc(doc, rid=rid)
             res.replica = replica_id
             res.latency_s = time.perf_counter() - t0
@@ -554,15 +725,25 @@ class Router:
                      f"(tried {len(order)}; last: {last_err})"}))
 
     def _forward_sweep(self, rid, handle, designs, cases, chunk, t0):
+        """Forward a sweep, checkpointing completed chunks: every chunk
+        doc relayed off the stream is a durable partial result (the PR 2
+        checkpoint schema), so when the serving replica dies mid-stream
+        only the designs no completed chunk covers are resubmitted to
+        the next ring replica — relayed failover chunks are remapped to
+        original design indices, and the reassembled result is
+        bit-identical to an uninterrupted run."""
         key = routing_key(designs[0], cases)
         order = self._ring.preference(key)
+        inj = get_injector()
         last_err = None
         attempted = breaker_skips = 0
-        req = {"designs": designs, "cases": cases}
-        if chunk is not None:
-            req["chunk"] = int(chunk)
+        streamed = []      # completed chunk docs (original design idx)
+        done = set()       # original design indices already answered
         for replica_id in order:
-            rep = self.replicas[replica_id]
+            rep = self.replicas.get(replica_id)
+            if rep is None:                # retired mid-flight
+                last_err = f"{replica_id} retired"
+                continue
             if rep.dead():
                 self.stats["dead_replica_skips"] += 1
                 self._breakers.get(replica_id).record_failure(
@@ -574,53 +755,126 @@ class Router:
                 breaker_skips += 1
                 last_err = f"{replica_id} breaker open"
                 continue
-            streamed = []
+            # checkpoint restart: only the uncovered designs cross the
+            # wire; idx_map carries sub-sweep index -> original index
+            idx_map = [i for i in range(len(designs)) if i not in done]
+            failover = bool(streamed)
+            if failover:
+                self.stats["sweep_chunk_failovers"] += 1
+                logger.warning(
+                    "sweep rid=%d: resuming on %s with %d/%d designs "
+                    "remaining (%d chunk(s) checkpointed)", rid,
+                    replica_id, len(idx_map), len(designs),
+                    len(streamed))
+            req = {"designs": [designs[i] for i in idx_map],
+                   "cases": cases}
+            if chunk is not None:
+                req["chunk"] = int(chunk)
+            base = len(streamed)
+            killed = []
 
-            def on_chunk(ch, replica_id=replica_id, streamed=streamed):
-                streamed.append(True)
+            def on_chunk(ch, replica_id=replica_id, rep=rep,
+                         idx_map=idx_map, base=base, killed=killed):
+                # remap sub-sweep design indices back to the caller's
+                # design order so reassembly scatters the right rows
+                ch["designs"] = [idx_map[j] for j in ch["designs"]]
+                ch["failed_idx"] = [idx_map[j]
+                                    for j in ch.get("failed_idx", [])]
+                ch["chunk"] = base + int(ch.get("chunk", 0))
                 ch["replica"] = replica_id
+                streamed.append(ch)
+                done.update(ch["designs"])
                 handle._push(ch)
+                if inj is not None and not killed and inj.should(
+                        "replica_kill", rid) is not None:
+                    # mid-stream kill: fires AFTER a relayed chunk, so
+                    # the failover path (not the clean retry) is what
+                    # must recover
+                    killed.append(True)
+                    self.stats["chaos_replica_kills"] += 1
+                    logger.warning(
+                        "chaos replica_kill: SIGKILL %s (sweep rid=%d "
+                        "mid-stream, %d chunk(s) relayed)", rep.id, rid,
+                        len(streamed))
+                    if rep.proc is not None:
+                        rep.proc.kill()
+                        rep.proc.wait(10)
 
             try:
                 self.stats["forwarded"] += 1
                 attempted += 1
-                terminal, chunks = rep.client.sweep(req, on_chunk=on_chunk)
+                terminal, _chunks = rep.client.sweep(req,
+                                                     on_chunk=on_chunk)
             except (ConnectionDropped, TransientError) as e:
                 breaker.record_failure(str(e))
-                last_err = str(e)
-                if streamed:
-                    # mid-stream loss: retrying on another replica would
-                    # re-run and re-emit chunks the consumer already saw,
-                    # so fail the sweep instead of replaying it
-                    last_err = (f"stream from {replica_id} dropped after "
-                                f"{len(streamed)} chunk(s): {e}")
-                    break
                 self.stats["replica_retries"] += 1
+                last_err = (f"stream from {replica_id} dropped after "
+                            f"{len(streamed)} chunk(s): {e}"
+                            if streamed else str(e))
                 logger.warning("sweep rid=%d to %s failed (%s); retrying "
-                               "on next replica", rid, replica_id, e)
+                               "on next replica", rid, replica_id,
+                               last_err)
                 continue
-            if terminal.get("status") == "shutdown" and not self._stop \
-                    and not streamed:
+            if terminal.get("status") == "shutdown" and not self._stop:
+                # replica mid-drain: chunks it already streamed are
+                # complete checkpointed results; the remainder retries
                 breaker.record_failure("replica draining")
                 self.stats["replica_retries"] += 1
                 last_err = f"{replica_id} draining"
                 continue
             breaker.record_success()
             rep.served += 1
-            self.stats["ok" if terminal.get("status") == "ok"
-                       else "failed"] += 1
-            res = wire.sweep_result_from_doc(terminal, chunks=chunks,
-                                             rid=rid)
-            res.replica = replica_id
-            res.latency_s = time.perf_counter() - t0
-            self._resolve(rid, handle._pend, res)
-            handle._close()
-            return
+            return self._resolve_sweep(rid, handle, designs, streamed,
+                                       terminal, replica_id, failover,
+                                       t0)
+        if streamed and len(done) == len(designs):
+            # every design's chunk arrived but the terminal line was
+            # lost: the checkpoints ARE the result — synthesize the
+            # terminal doc instead of recomputing anything
+            return self._resolve_sweep(
+                rid, handle, designs, streamed,
+                {"event": "sweep_result", "rid": rid, "status": "ok",
+                 "n_designs": len(designs)},
+                streamed[-1].get("replica"), True, t0)
         status = ("rejected_circuit"
                   if not attempted and breaker_skips else "failed")
         self.stats["failed"] += 1
         self._resolve(rid, handle._pend, wire.sweep_result_from_doc({
             "rid": rid, "status": status, "n_designs": len(designs),
             "error": f"no replica served the sweep "
-                     f"(tried {len(order)}; last: {last_err})"}))
+                     f"(tried {len(order)}; last: {last_err})"},
+            chunks=streamed))
+        handle._close()
+
+    def _resolve_sweep(self, rid, handle, designs, streamed, terminal,
+                       replica_id, failover, t0):
+        """Reassemble the terminal SweepResult from the relayed chunk
+        checkpoints.  After a failover the last replica's terminal line
+        describes only its sub-sweep, so the per-sweep fields are
+        rebuilt from the checkpoints (whose indices are already
+        remapped); the arrays always come from the chunks, scattered by
+        original design index."""
+        term = dict(terminal)
+        term["n_designs"] = len(designs)
+        if failover and streamed:
+            term["n_chunks"] = len(streamed)
+            term["chunks_done"] = len(streamed)
+            fail_i, fail_m = [], []
+            for ch in streamed:
+                fail_i.extend(int(i) for i in ch.get("failed_idx", []))
+                fail_m.extend(ch.get("failed_msg", []))
+            term["failed_idx"], term["failed_msg"] = fail_i, fail_m
+            # chunk docs carry the job-cumulative preemption count, so
+            # take each replica segment's high-water mark and sum those
+            preempt = {}
+            for ch in streamed:
+                key = ch.get("replica")
+                preempt[key] = max(preempt.get(key, 0),
+                                   int(ch.get("preemptions", 0)))
+            term["preemptions"] = sum(preempt.values())
+        self.stats["ok" if term.get("status") == "ok" else "failed"] += 1
+        res = wire.sweep_result_from_doc(term, chunks=streamed, rid=rid)
+        res.replica = replica_id
+        res.latency_s = time.perf_counter() - t0
+        self._resolve(rid, handle._pend, res)
         handle._close()

@@ -360,10 +360,16 @@ class WireClient:
         finally:
             conn.close()
 
-    def solve(self, doc, on_sent=None):
+    def solve(self, doc, on_sent=None, slow_s=None):
         """POST a request document, stream the response, return the
         terminal result document.  ``on_sent`` fires after the request
-        bytes are on the wire (the replica_kill chaos hook point)."""
+        bytes are on the wire (the replica_kill chaos hook point).
+        ``slow_s`` is the replica_slow chaos hook point: stall that many
+        seconds after the request is on the wire, then give up on the
+        reply exactly as a socket timeout would — the raised
+        ``ConnectionDropped`` sends the router to the next ring replica
+        (the solve is pure, so the abandoned replica's late answer is
+        simply discarded)."""
         body = wire.dumps(doc).encode()
         conn = self._conn()
         try:
@@ -372,6 +378,11 @@ class WireClient:
                     "Content-Type": "application/json"})
                 if on_sent is not None:
                     on_sent()
+                if slow_s is not None:
+                    time.sleep(float(slow_s))
+                    raise ConnectionDropped(
+                        f"chaos replica_slow: gave up on "
+                        f"{self.host}:{self.port} after {slow_s:.3f}s")
                 resp = conn.getresponse()
                 if resp.status != 200:
                     err = {}
@@ -381,6 +392,12 @@ class WireClient:
                             http.client.HTTPException):
                         err = {"error": f"HTTP {resp.status} "
                                         f"(unparseable error body)"}
+                    if err.get("error") == "draining":
+                        # refused before admission (drain-first
+                        # retirement): safe to re-attempt elsewhere
+                        raise ConnectionDropped(
+                            f"{self.host}:{self.port} is draining; "
+                            f"request refused before admission")
                     return {"event": "result", "rid": err.get("rid", -1),
                             "status": err.get("status", "failed"),
                             "http_status": resp.status,
@@ -433,6 +450,10 @@ class WireClient:
                             http.client.HTTPException):
                         err = {"error": f"HTTP {resp.status} "
                                         f"(unparseable error body)"}
+                    if err.get("error") == "draining":
+                        raise ConnectionDropped(
+                            f"{self.host}:{self.port} is draining; "
+                            f"sweep refused before admission")
                     return ({"event": "sweep_result",
                              "rid": err.get("rid", -1),
                              "status": err.get("status", "failed"),

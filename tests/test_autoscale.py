@@ -1,0 +1,254 @@
+"""Autoscaler policy (raft_tpu/serve/autoscale.py): deterministic
+contracts, driven against a fake fleet with a hand-advanced clock.
+
+* scale-out fires only after the high-water pressure signal has held
+  CONTINUOUSLY for ``sustain_s`` — a single burst tick never spawns;
+* no flapping: inside the hysteresis window (condition not yet
+  sustained, or cooldown after an action) the policy holds;
+* shedding anywhere in the fleet counts as high pressure outright;
+* scale-in is drain-first via the fleet's ``retire_replica`` and every
+  in-flight rid on the retired replica still reaches a terminal
+  status (the FakeFleet models the drain);
+* fleet bounds (``min_replicas``/``max_replicas``) are never crossed;
+* heal: a dead replica (chaos kill) below the floor is reaped and
+  replaced on the next tick, bypassing hysteresis and cooldown — but
+  an unreachable-yet-alive misread never spawns past the ceiling;
+* ring stability: growing the consistent-hash ring 2 -> 3 moves ONLY
+  keys the new replica claims (the property that makes scale-out
+  cheap — every other replica keeps its warmed buckets).
+"""
+
+import threading
+
+from raft_tpu.serve import AutoscaleConfig, Autoscaler, HashRing
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt=1.0):
+        self.t += dt
+
+
+class FakeFleet:
+    """Gauge-driven fleet double: pressure is set by the test; scale
+    actions mutate the replica map the way the Router would, and
+    retirement drains by resolving every in-flight rid terminally."""
+
+    def __init__(self, n=2):
+        self.replicas = {f"r{i}": [] for i in range(n)}  # rid -> in-flight
+        self.next_id = n
+        self.pressure = 0.0
+        self.shedding = False
+        self.terminal = {}          # request rid -> status
+        self.dead = set()           # rids whose process has died
+        self.unreachable = set()    # alive but /statz times out
+
+    def replica_gauges(self):
+        return {
+            rid: None if rid in self.dead or rid in self.unreachable
+            else {"queue_depth": self.pressure, "in_flight": 0,
+                  "shedding": self.shedding}
+            for rid in self.replicas
+        }
+
+    def reap_dead(self):
+        reaped = sorted(self.dead & set(self.replicas))
+        for rid in reaped:
+            del self.replicas[rid]
+        return reaped
+
+    def scale_out(self):
+        rid = f"r{self.next_id}"
+        self.next_id += 1
+        self.replicas[rid] = []
+        return rid
+
+    def retire_candidate(self):
+        if len(self.replicas) <= 1:
+            return None
+        return max(self.replicas, key=lambda rid: (len(rid), rid))
+
+    def retire_replica(self, replica_id):
+        if replica_id not in self.replicas or len(self.replicas) <= 1:
+            return False
+        # drain-first: every accepted request resolves terminally
+        for req in self.replicas.pop(replica_id):
+            self.terminal[req] = "ok"
+        return True
+
+
+def _scaler(fleet, clock, **kw):
+    kw.setdefault("sustain_s", 2.0)
+    kw.setdefault("cooldown_s", 5.0)
+    return Autoscaler(fleet, AutoscaleConfig(**kw), clock=clock)
+
+
+def test_scale_out_needs_sustained_high_water():
+    clock, fleet = FakeClock(), FakeFleet(n=2)
+    a = _scaler(fleet, clock, high_water=4.0)
+    fleet.pressure = 8.0
+    assert a.step() is None           # t=0: first high sample, no action
+    clock.tick(1.0)
+    assert a.step() is None           # t=1: held 1 s < sustain 2 s
+    clock.tick(1.0)
+    d = a.step()                      # t=2: sustained -> scale out
+    assert d is not None and d["action"] == "scale_out"
+    assert d["replica"] == "r2" and len(fleet.replicas) == 3
+    assert a.decisions == [d]
+
+
+def test_burst_inside_hysteresis_never_flaps():
+    clock, fleet = FakeClock(), FakeFleet(n=2)
+    a = _scaler(fleet, clock, high_water=4.0)
+    # pressure oscillates around the threshold: the continuous-hold
+    # requirement resets each time it dips, so no action ever fires
+    for pressure in (8.0, 0.0, 8.0, 0.0, 8.0, 0.0, 8.0, 0.0):
+        fleet.pressure = pressure
+        assert a.step() is None
+        clock.tick(1.0)
+    assert a.decisions == [] and len(fleet.replicas) == 2
+
+
+def test_shedding_is_high_pressure_and_cooldown_holds():
+    clock, fleet = FakeClock(), FakeFleet(n=2)
+    a = _scaler(fleet, clock, high_water=1e9)   # unreachable by depth
+    fleet.shedding = True
+    a.step()
+    clock.tick(2.0)
+    d = a.step()
+    assert d is not None and d["action"] == "scale_out" and d["shedding"]
+    # still shedding, but cooldown_s=5 holds the next action
+    clock.tick(2.0)
+    assert a.step() is None
+    clock.tick(1.0)
+    assert a.step() is None           # t=5.0 after action start? hold
+    clock.tick(2.1)
+    d2 = a.step()                     # cooldown over + sustained again
+    assert d2 is not None and d2["action"] == "scale_out"
+
+
+def test_scale_in_drains_all_in_flight_to_terminal():
+    clock, fleet = FakeClock(), FakeFleet(n=3)
+    fleet.replicas["r2"] = ["rid-7", "rid-8", "rid-9"]   # in flight
+    a = _scaler(fleet, clock, low_water=0.5, min_replicas=1)
+    fleet.pressure = 0.0
+    a.step()
+    clock.tick(2.0)
+    d = a.step()
+    assert d is not None and d["action"] == "scale_in"
+    assert d["replica"] == "r2" and "r2" not in fleet.replicas
+    # drain-first: 100% of the retired replica's rids went terminal
+    assert fleet.terminal == {"rid-7": "ok", "rid-8": "ok", "rid-9": "ok"}
+
+
+def test_fleet_bounds_hold():
+    clock, fleet = FakeClock(), FakeFleet(n=2)
+    a = _scaler(fleet, clock, max_replicas=2, min_replicas=2,
+                cooldown_s=0.0)
+    fleet.pressure = 99.0
+    for _ in range(6):                # sustained high, but at max
+        a.step()
+        clock.tick(1.0)
+    assert all(d["action"] != "scale_out" for d in a.decisions)
+    fleet.pressure = 0.0
+    for _ in range(6):                # sustained low, but at min
+        a.step()
+        clock.tick(1.0)
+    assert a.decisions == [] and len(fleet.replicas) == 2
+
+
+def test_heal_respawns_below_floor_without_hysteresis():
+    """A chaos kill drops alive below min_replicas: the very next tick
+    reaps the corpse from the ring and spawns a replacement — no
+    sustain wait, no cooldown hold (the floor is an availability
+    invariant, not a policy preference)."""
+    clock, fleet = FakeClock(), FakeFleet(n=2)
+    a = _scaler(fleet, clock, min_replicas=2, max_replicas=3)
+    assert a.step() is None                 # healthy fleet: no action
+    # take an action-adjacent timestamp so cooldown WOULD hold a
+    # normal action, then kill a replica
+    a._last_action_t = clock()
+    fleet.dead.add("r1")
+    clock.tick(0.1)                         # deep inside cooldown_s=5
+    d = a.step()
+    assert d is not None and d["action"] == "heal"
+    assert d["reaped"] == ["r1"]
+    assert "r1" not in fleet.replicas and "r2" in fleet.replicas
+    assert len(fleet.replicas) == 2         # back at the floor
+    # healthy again: no further heals
+    assert all(s is None for s in (a.step(),))
+
+
+def test_heal_never_exceeds_ceiling_on_unreachable_misread():
+    """A slow /statz scrape reads a busy-but-alive replica as None;
+    reap_dead finds no corpse, and healing must not spawn past
+    max_replicas on that misread."""
+    clock, fleet = FakeClock(), FakeFleet(n=2)
+    a = _scaler(fleet, clock, min_replicas=2, max_replicas=2)
+    fleet.unreachable = {"r0", "r1"}
+    for _ in range(4):
+        assert a.step() is None
+        clock.tick(1.0)
+    assert len(fleet.replicas) == 2 and a.decisions == []
+
+
+def test_heal_counts_in_snapshot():
+    clock, fleet = FakeClock(), FakeFleet(n=2)
+    a = _scaler(fleet, clock, min_replicas=2, max_replicas=3)
+    fleet.dead.add("r0")
+    assert a.step()["action"] == "heal"
+    snap = a.snapshot()
+    assert snap["heals"] == 1 and snap["scale_outs"] == 0
+
+
+def test_decision_log_replays_identically():
+    def run():
+        clock, fleet = FakeClock(), FakeFleet(n=1)
+        a = _scaler(fleet, clock, high_water=4.0, low_water=0.5,
+                    cooldown_s=3.0, max_replicas=3)
+        script = [8.0] * 4 + [0.0] * 12 + [8.0] * 4
+        for pressure in script:
+            fleet.pressure = pressure
+            a.step()
+            clock.tick(1.0)
+        return a.decisions
+
+    first, second = run(), run()
+    assert first == second and len(first) >= 2
+
+
+def test_live_loop_starts_and_stops():
+    fleet = FakeFleet(n=1)
+    stepped = threading.Event()
+    a = Autoscaler(fleet, AutoscaleConfig(interval_s=0.01))
+    orig = a.step
+
+    def step():
+        stepped.set()
+        return orig()
+
+    a.step = step
+    a.start()
+    assert stepped.wait(5.0)
+    a.stop()
+    assert a._thread is None
+
+
+def test_ring_growth_moves_only_new_replica_keys():
+    ring2 = HashRing(["r0", "r1"])
+    ring3 = HashRing(["r0", "r1", "r2"])
+    moved = stayed = 0
+    for i in range(512):
+        key = f"design-family-{i}"
+        before, after = ring2.lookup(key), ring3.lookup(key)
+        if before != after:
+            assert after == "r2", (key, before, after)
+            moved += 1
+        else:
+            stayed += 1
+    assert moved > 0 and stayed > 0     # ~1/3 move, the rest are pinned

@@ -185,6 +185,23 @@ def test_batched_prep_section_pinned_in_compact_schema():
         assert key in bench._COMPACT_KEYS, key
 
 
+def test_serve_load_section_pinned_in_compact_schema():
+    """The elastic-fleet load-harness bench section (PR 13) stays
+    wired: both entry points exist and the headline SLO keys — normal
+    and chaos goodput, the lost-request count (must stay 0) and the
+    autoscaler heal count — ride the compact driver line (latency
+    quantiles, the overload phase and the full decision log stay in
+    BENCH_FULL.json under serve_load_phases / serve_load_decisions;
+    the driver tail's 1900-char parse budget is the constraint)."""
+    assert callable(bench.bench_serve_load)
+    assert callable(bench.bench_serve_load_smoke)
+    for key in ("serve_load_goodput", "serve_load_chaos_goodput",
+                "serve_load_lost", "serve_load_heals",
+                "smoke_load_goodput", "smoke_load_bits",
+                "serve_load_error", "serve_load_smoke_error"):
+        assert key in bench._COMPACT_KEYS, key
+
+
 def test_sanitizer_covers_serve_http_values():
     out = {
         "serve_http_overhead_ms": 1.66,

@@ -1,0 +1,180 @@
+"""Elastic replica fleet (PR 13): scale-out/retire + chunk failover
+over real subprocess replicas.
+
+Acceptance criteria, end to end:
+
+* ``Router.scale_out`` spawns a warm replica and claims only its own
+  vnode arcs (every moved key maps to the newcomer);
+* ``/statz`` gauges expose the autoscaler's inputs — monotonic
+  ``uptime_s`` plus cumulative terminal-status counters — per replica;
+* ``Router.retire_replica`` is drain-first: requests in flight on the
+  retired replica still reach a terminal status (none lost);
+* the ``replica_slow`` chaos fault makes the router give up on a
+  too-slow replica and retry on the next ring replica,
+  bit-identically;
+* a replica SIGKILLed mid-sweep (``replica_kill`` firing after the
+  first streamed chunk) loses nothing: completed chunks are
+  checkpoints, only the remaining designs are recomputed on the
+  surviving replica, and the reassembled result is
+  ``np.array_equal``-identical to an uninterrupted run.
+
+One module-scoped 2-replica router keeps the subprocess bill at a
+single compile of the NW bucket; the destructive kill test runs LAST.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu.designs import deep_spar
+from raft_tpu.serve import Router
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NW = (0.05, 0.5)
+
+
+def _spar(rho_fill=1800.0):
+    d = deep_spar(n_cases=2, nw_settings=NW)
+    d["platform"]["members"][0]["rho_fill"] = [float(rho_fill), 0.0, 0.0]
+    return d
+
+
+@pytest.fixture(scope="module")
+def shared_cache(tmp_path_factory):
+    return str(tmp_path_factory.mktemp("elastic_shared_cache"))
+
+
+@pytest.fixture(scope="module")
+def router2(shared_cache):
+    router = Router(n_replicas=2, cache_dir=shared_cache,
+                    precision="float64", window_ms=20.0)
+    warm = router.evaluate(_spar(), timeout=400)
+    assert warm.status == "ok", warm.error
+    yield router
+    router.shutdown()
+
+
+def test_scale_out_claims_only_its_own_arcs_and_serves(router2):
+    old_ring = router2._ring
+    new_id = router2.scale_out()
+    assert new_id in router2.replicas
+    assert router2.stats["scale_outs"] == 1
+    moved = 0
+    for i in range(256):
+        key = f"design-family-{i}"
+        before, after = old_ring.lookup(key), router2._ring.lookup(key)
+        if before != after:
+            assert after == new_id, (key, before, after)
+            moved += 1
+    assert moved > 0
+    # the newcomer serves off the shared warm cache
+    res = router2.evaluate(_spar(2500.0), timeout=400)
+    assert res.status == "ok", res.error
+    assert router2.probe()["replicas_alive"] == 3
+
+
+def test_statz_gauges_expose_uptime_and_terminal_counters(router2):
+    gauges = router2.replica_gauges()
+    assert set(gauges) == set(router2.replicas)
+    for rid, g in gauges.items():
+        assert g is not None, f"{rid} unreachable"
+        assert g["uptime_s"] > 0.0
+        for key in ("requests", "ok", "failed", "rejected_deadline",
+                    "rejected_overload", "watchdog_timeout", "shedding",
+                    "accepting", "queue_depth", "in_flight",
+                    "breakers_open", "prep_queue_depth"):
+            assert key in g, (rid, key)
+        assert g["accepting"] is True
+    # the fixture's warm request landed somewhere: cumulative ok counts
+    assert sum(g["ok"] for g in gauges.values()) >= 1
+    # uptime is monotonic between scrapes
+    later = router2.replica_gauges()
+    for rid in gauges:
+        assert later[rid]["uptime_s"] >= gauges[rid]["uptime_s"]
+
+
+def test_retire_replica_drains_in_flight_to_terminal(router2):
+    cand = router2.retire_candidate()
+    assert cand == "r2"      # the youngest: exactly unwinds scale-out
+    handles = [router2.submit(_spar(3000.0 + i)) for i in range(4)]
+    assert router2.retire_replica(cand)
+    assert cand not in router2.replicas
+    assert router2.stats["scale_ins"] == 1
+    statuses = [h.result(timeout=400).status for h in handles]
+    # drain-first: every accepted rid reached a terminal status, and
+    # none was lost to the retirement
+    assert statuses == ["ok"] * 4, statuses
+    assert router2.probe()["replicas_alive"] == 2
+
+
+def test_replica_slow_retries_next_replica_bit_identically(
+        router2, monkeypatch):
+    d = _spar(4000.0)
+    clean = router2.evaluate(d, timeout=400)
+    assert clean.status == "ok", clean.error
+    slows_before = router2.stats["chaos_replica_slows"]
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "replica_slow=0.3*1:3")
+    slowed = router2.evaluate(d, timeout=400)
+    monkeypatch.delenv("RAFT_TPU_CHAOS")
+    assert slowed.status == "ok", slowed.error
+    assert router2.stats["chaos_replica_slows"] == slows_before + 1
+    # abandoned the slow replica, answered by its ring successor, and
+    # the retried answer is the same bits
+    assert slowed.replica != clean.replica
+    assert np.array_equal(slowed.Xi, clean.Xi)
+    assert np.array_equal(slowed.std, clean.std)
+
+
+def test_midstream_kill_failover_recomputes_only_remaining_chunks(
+        router2, monkeypatch):
+    """LAST (kills a replica): the mid-stream chunk-failover contract."""
+    designs = [_spar(1800.0 + 10 * i) for i in range(4)]
+    ref = router2.submit_sweep(designs, chunk=2).result(400)
+    assert ref.status == "ok", ref.error
+    assert ref.n_chunks == 2
+    kills_before = router2.stats["chaos_replica_kills"]
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "replica_kill*1:7")
+    handle = router2.submit_sweep(designs, chunk=2)
+    chunks = list(handle.chunks(timeout=400))
+    killed = handle.result(timeout=10)
+    monkeypatch.delenv("RAFT_TPU_CHAOS")
+    assert killed.status == "ok", killed.error
+    assert router2.stats["chaos_replica_kills"] == kills_before + 1
+    assert router2.stats["sweep_chunk_failovers"] >= 1
+    # only the REMAINING designs were resubmitted: no design index is
+    # covered by two streamed chunks
+    covered = [i for ch in chunks for i in ch["designs"]]
+    assert sorted(covered) == list(range(len(designs))), covered
+    # the failover came from the surviving replica after a checkpointed
+    # first chunk
+    assert len({ch["replica"] for ch in chunks}) == 2, chunks
+    # reassembled result is bit-identical to the uninterrupted run
+    assert np.array_equal(ref.Xi_r, killed.Xi_r)
+    assert np.array_equal(ref.Xi_i, killed.Xi_i)
+    for key in ref.report:
+        assert np.array_equal(ref.report[key], killed.report[key]), key
+    assert killed.failed_idx == ref.failed_idx == []
+    assert router2.probe()["replicas_alive"] == 1
+
+
+def test_engine_probe_counters_without_traffic():
+    """Engine.probe() carries the autoscaler's inputs from birth: a
+    monotonic uptime and zeroed cumulative terminal counters (no
+    subprocess, no compile — the gauge must be readable before any
+    request arrives)."""
+    from raft_tpu.serve import Engine, EngineConfig
+
+    eng = Engine(EngineConfig(precision="float64"))
+    try:
+        p1 = eng.probe()
+        for key in ("requests", "ok", "failed", "rejected_deadline",
+                    "rejected_overload", "rejected_circuit",
+                    "watchdog_timeout", "shutdown_resolved"):
+            assert p1[key] == 0, key
+        assert p1["uptime_s"] >= 0.0
+        time.sleep(0.01)
+        assert eng.probe()["uptime_s"] > p1["uptime_s"]
+    finally:
+        eng.shutdown()

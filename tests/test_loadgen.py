@@ -1,0 +1,147 @@
+"""Open-loop load harness (raft_tpu/loadgen.py): determinism and
+accounting contracts against a fake backend.
+
+* the Poisson arrival schedule and the request mix are pure functions
+  of the seed (the offered load of a phase replays exactly);
+* a phase against a healthy fake backend reports goodput 1.0, zero
+  lost requests, and a per-status breakdown that sums to offered;
+* canary requests reuse the byte-identical base design and the report
+  asserts their answers are bit-identical (``bits_identical``);
+* a backend that loses requests (handle never goes terminal) is
+  reported as ``lost`` — the one outcome the serve tier must never
+  produce.
+"""
+
+import dataclasses
+
+import numpy as np
+
+from raft_tpu.loadgen import (
+    LoadgenConfig,
+    poisson_arrivals,
+    request_mix,
+    run_phase,
+    warm_pool,
+)
+
+
+@dataclasses.dataclass
+class _Res:
+    status: str
+    latency_s: float = 0.01
+    Xi: object = None
+
+
+class _Handle:
+    def __init__(self, res):
+        self._res = res
+
+    def result(self, timeout=None):
+        if self._res is None:
+            raise TimeoutError("lost")
+        return self._res
+
+
+class FakeBackend:
+    """Resolves everything 'ok' instantly; records what it was asked."""
+
+    def __init__(self, lose_every=0):
+        self.solo = []
+        self.sweeps = []
+        self.deadlines = []
+        self.lose_every = lose_every
+        self._n = 0
+
+    def submit(self, design, cases=None, deadline_s=None):
+        self._n += 1
+        self.solo.append(design)
+        self.deadlines.append(deadline_s)
+        if self.lose_every and self._n % self.lose_every == 0:
+            return _Handle(None)
+        xi = np.full((2, 6, 3), 1.5 + 0.5j) if "_loadgen_variant" \
+            not in design else None
+        return _Handle(_Res("ok", Xi=xi))
+
+    def submit_sweep(self, designs, cases=None, chunk=None):
+        self.sweeps.append(list(designs))
+        return _Handle(_Res("ok"))
+
+
+def _fast_cfg(**kw):
+    kw.setdefault("rate_hz", 200.0)
+    kw.setdefault("duration_s", 0.2)
+    kw.setdefault("seed", 3)
+    return LoadgenConfig(**kw)
+
+
+def test_arrivals_and_mix_replay_per_seed():
+    a1 = poisson_arrivals(50.0, 2.0, seed=7)
+    a2 = poisson_arrivals(50.0, 2.0, seed=7)
+    assert np.array_equal(a1, a2)
+    assert len(a1) > 0 and float(a1[-1]) < 2.0
+    assert np.all(np.diff(a1) > 0)
+    assert not np.array_equal(a1, poisson_arrivals(50.0, 2.0, seed=8))
+    cfg = LoadgenConfig(seed=7)
+    m1 = request_mix(64, cfg)
+    assert m1 == request_mix(64, cfg)
+    assert set(m1) <= {"solo", "sweep", "tight"}
+    # changing the mix probabilities must not reshuffle arrivals
+    assert np.array_equal(a1, poisson_arrivals(50.0, 2.0, seed=7))
+
+
+def test_phase_on_healthy_backend_is_clean():
+    backend = FakeBackend()
+    cfg = _fast_cfg()
+    report = run_phase(backend, cfg, {"base": True}, name="normal")
+    offered = report["offered"]
+    assert offered == len(poisson_arrivals(cfg.rate_hz, cfg.duration_s,
+                                           cfg.seed))
+    assert report["goodput"] == 1.0
+    assert report["lost"] == 0
+    assert sum(report["statuses"].values()) == offered
+    assert report["statuses"]["ok"] == offered
+    assert report["p50_ms"] is not None
+    assert report["p95_ms"] >= report["p50_ms"] >= 0.0
+    # tight requests carried the deadline; solos and canaries did not
+    tights = [d for d in backend.deadlines if d is not None]
+    assert all(d == cfg.tight_deadline_s for d in tights)
+    # sweeps carried sweep_n variant designs each
+    assert all(len(s) == cfg.sweep_n for s in backend.sweeps)
+
+
+def test_canaries_are_byte_identical_and_bits_checked():
+    backend = FakeBackend()
+    base = {"base": True}
+    report = run_phase(backend, _fast_cfg(), base, name="canary")
+    canaries = [d for d in backend.solo if "_loadgen_variant" not in d]
+    assert len(canaries) >= 2
+    assert all(d == base for d in canaries)
+    assert report["canaries_ok"] == len(canaries)
+    assert report["bits_identical"] is True
+
+
+def test_warm_pool_covers_every_submitted_body():
+    """The bounded variant pool is the warm-envelope contract: every
+    body a phase submits (solos, sweep members, canaries) must be a
+    member of ``warm_pool(config, design)``, so pre-warming the pool
+    guarantees no measured request pays a cold prep."""
+    backend = FakeBackend()
+    base = {"base": True}
+    cfg = _fast_cfg(distinct=3, sweep_n=2)
+    run_phase(backend, cfg, base, name="pool")
+    pool = warm_pool(cfg, base)
+    assert len(pool) == 1 + 2 * cfg.distinct
+    submitted = backend.solo + [d for s in backend.sweeps for d in s]
+    assert len(submitted) > len(pool)        # the pool actually cycles
+    for d in submitted:
+        assert d in pool, d
+
+
+def test_lost_requests_are_counted_not_hidden():
+    backend = FakeBackend(lose_every=5)
+    cfg = _fast_cfg(collect_timeout_s=0.1)
+    report = run_phase(backend, cfg, {"base": True}, name="lossy")
+    assert report["lost"] > 0
+    assert report["goodput"] < 1.0
+    assert report["lost"] + sum(report["statuses"].values()) \
+        == report["offered"]
