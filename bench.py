@@ -122,6 +122,8 @@ _COMPACT_KEYS = (
     "perf_docs_error", "sweep_scaling_error", "sweep1024_error",
     "sweep4096_error", "serve_multichip_error", "multichip_smoke_error",
     "prep_error", "prep_smoke_error",
+    "analysis_rules", "analysis_findings", "analysis_allowlisted",
+    "analysis_error",
 )
 
 
@@ -399,6 +401,7 @@ def main(argv=None):
                     ("chaos_smoke", bench_chaos_smoke),
                     ("prep_smoke", bench_batched_prep_smoke),
                     ("multichip_smoke", bench_multichip_smoke),
+                    ("analysis", bench_analysis),
                     ("kernel", lambda: bench_kernels(
                         gj6_batch=128, stage_n=128, stage_block=64,
                         stage_m=4))]
@@ -462,6 +465,7 @@ def main(argv=None):
             ("kernel", bench_kernels, 0.5),
             ("sweep_warm", bench_sweep_warm, 4.0),
             ("prep", bench_batched_prep, 3.0),
+            ("analysis", bench_analysis, 0.5),
         ]
 
     out = {}
@@ -1992,6 +1996,26 @@ def bench_batched_prep_smoke(n_designs=8):
     return {
         "smoke_prep_ratio": round(solo_wall / max(bp_wall, 1e-9), 2),
         "smoke_prep_bits": bool(_prep_bits_identical(family, lanes)),
+    }
+
+
+def bench_analysis():
+    """Static-analysis gate (docs/analysis.md): every registered rule
+    over the repo, zero unallowlisted findings required.  Pure-AST (no
+    JAX, no device), so the same section runs on smoke and full
+    rounds; a regression lands under ``analysis_error`` like any other
+    broken section."""
+    from raft_tpu.analysis import analyze
+
+    t0 = time.perf_counter()
+    report = analyze()
+    wall = time.perf_counter() - t0
+    assert report.ok, "; ".join(str(f) for f in report.findings[:5])
+    return {
+        "analysis_rules": len(report.reports),
+        "analysis_findings": len(report.findings),
+        "analysis_allowlisted": report.n_allowlisted,
+        "analysis_wall_s": round(wall, 2),
     }
 
 

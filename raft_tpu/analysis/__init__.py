@@ -1,0 +1,37 @@
+"""Zero-dependency AST static-analysis framework for the repo's
+load-bearing contracts (docs/analysis.md).
+
+Entry points:
+
+* ``python -m raft_tpu.analysis [--rule NAME] [--json]`` — CLI, exit 0
+  iff zero unallowlisted findings;
+* ``tests/test_analysis.py`` — one parametrized tier-1 test per
+  registered rule (plus fixture tests pinning what each rule catches);
+* :func:`analyze` — the library call both of those use.
+
+The framework never imports the code under analysis — everything is
+``ast`` over source text, so it runs identically with or without JAX.
+"""
+
+from raft_tpu.analysis.core import (AnalysisReport, Finding, Rule,
+                                    load_allowlist, run_rules)
+from raft_tpu.analysis.project import ProjectModel
+from raft_tpu.analysis.rules import ALL_RULES, rule_by_name
+
+import os
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def analyze(root=None, rules=None, allowlist_dir=None):
+    """Run ``rules`` (default: all registered) over ``root`` (default:
+    this repo); returns an :class:`AnalysisReport`."""
+    project = ProjectModel(root or REPO_ROOT)
+    return run_rules(project, rules or ALL_RULES,
+                     allowlist_dir=allowlist_dir)
+
+
+__all__ = ["ALL_RULES", "AnalysisReport", "Finding", "ProjectModel",
+           "Rule", "analyze", "load_allowlist", "rule_by_name",
+           "run_rules"]

@@ -1,0 +1,384 @@
+"""Shared project model for the static-analysis framework.
+
+One parse of the repository feeds every rule: the module list (path,
+source, AST, dotted name, import aliases), the package-internal module
+graph, every ``RAFT_TPU_*`` environment read site, every lock-acquire
+site, and the traced-function roster — functions passed to
+``jit``/``vmap``/``shard_map``/``pallas_call``/``scan``/``while_loop``
+call sites plus their transitive callees within the package.
+
+The model is pure ``ast`` + ``os`` — building it never imports the
+code under analysis, so analysis runs identically with or without JAX
+(and on a box where the package would fail to import).
+"""
+
+import ast
+import os
+from dataclasses import dataclass, field
+
+SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "node_modules",
+             ".claude", ".ipynb_checkpoints"}
+
+#: transform name -> positions of its traced-function arguments
+TRANSFORMS = {
+    "jit": (0,),
+    "vmap": (0,),
+    "pmap": (0,),
+    "shard_map": (0,),
+    "pallas_call": (0,),
+    "scan": (0,),
+    "while_loop": (0, 1),
+    "fori_loop": (2,),
+}
+
+ENV_PREFIX = "RAFT_TPU_"
+
+
+def callee_name(call):
+    """Bare (rightmost) name of a call's callee, or ''."""
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return ""
+
+
+@dataclass
+class EnvReadSite:
+    """One ``os.environ``/``os.getenv`` read of a ``RAFT_TPU_*`` var."""
+
+    rel: str
+    lineno: int
+    var: str
+    module: str or None = None
+
+
+@dataclass
+class TracedFn:
+    """A function in the traced roster."""
+
+    module: "ModuleInfo"
+    qualname: str
+    node: object                      # FunctionDef | Lambda
+    origin: str                       # how it entered the roster
+    direct_body: bool = False         # scan/while_loop/pallas_call body
+    pallas: bool = False              # direct pallas_call kernel
+
+
+@dataclass
+class ModuleInfo:
+    """One parsed source file."""
+
+    path: str
+    rel: str
+    source: str
+    tree: object
+    dotted: str or None               # raft_tpu.foo for package files
+
+    import_aliases: dict = field(default_factory=dict)   # name -> module
+    from_imports: dict = field(default_factory=dict)     # name -> (mod, orig)
+    functions: dict = field(default_factory=dict)        # qualname -> node
+
+    def _index(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    self.import_aliases[alias.asname or
+                                        alias.name.split(".")[0]] = \
+                        alias.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for alias in node.names:
+                    self.from_imports[alias.asname or alias.name] = \
+                        (node.module, alias.name)
+
+        def collect(node, prefix):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    qual = (prefix + "." + child.name).lstrip(".")
+                    self.functions[qual] = child
+                    collect(child, qual)
+                elif isinstance(child, ast.ClassDef):
+                    collect(child, (prefix + "." + child.name).lstrip("."))
+                else:
+                    collect(child, prefix)
+
+        collect(self.tree, "")
+
+    def resolve_local(self, name, caller_qual=None):
+        """A function def in this module matching a bare name: a
+        module-level def, a sibling/nested def in the caller's scope, or
+        (last) a unique method of that name anywhere in the module."""
+        if name in self.functions:
+            return name, self.functions[name]
+        if caller_qual:
+            scope = caller_qual.split(".")
+            for depth in range(len(scope), 0, -1):
+                qual = ".".join(scope[:depth]) + "." + name
+                if qual in self.functions:
+                    return qual, self.functions[qual]
+        hits = [(q, n) for q, n in self.functions.items()
+                if q.endswith("." + name)]
+        if len(hits) == 1:
+            return hits[0]
+        return None
+
+
+class ProjectModel:
+    """Parsed view of the whole repository (see module docstring)."""
+
+    def __init__(self, root, package="raft_tpu"):
+        self.root = os.path.abspath(root)
+        self.package = package
+        self.modules = {}              # rel -> ModuleInfo
+        self._load()
+        self._env_sites = None
+        self._roster = None
+
+    # ---------------------------------------------------------- loading
+
+    def _iter_py_files(self):
+        for dirpath, dirnames, filenames in os.walk(self.root):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d not in SKIP_DIRS)
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    yield os.path.join(dirpath, name)
+
+    def _load(self):
+        pkg_prefix = self.package + os.sep
+        for path in self._iter_py_files():
+            rel = os.path.relpath(path, self.root)
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+            try:
+                tree = ast.parse(source, filename=rel)
+            except SyntaxError:
+                # unparseable files surface through the bare-except rule
+                # (every rule shares this parse); record a stub
+                tree = ast.parse("")
+            dotted = None
+            if rel.startswith(pkg_prefix) or rel == self.package + ".py":
+                dotted = rel[:-3].replace(os.sep, ".")
+                if dotted.endswith(".__init__"):
+                    dotted = dotted[:-len(".__init__")]
+            info = ModuleInfo(path=path, rel=rel.replace(os.sep, "/"),
+                              source=source, tree=tree, dotted=dotted)
+            info._index()
+            self.modules[info.rel] = info
+
+    def package_modules(self):
+        return [m for m in self.modules.values() if m.dotted]
+
+    def module_by_dotted(self, dotted):
+        for m in self.modules.values():
+            if m.dotted == dotted:
+                return m
+        return None
+
+    def test_modules(self):
+        return [m for m in self.modules.values()
+                if m.rel.startswith("tests/")]
+
+    def read_text(self, relpath):
+        """A non-Python project file (docs, allowlists), or None."""
+        path = os.path.join(self.root, relpath)
+        if not os.path.exists(path):
+            return None
+        with open(path, encoding="utf-8") as fh:
+            return fh.read()
+
+    # ------------------------------------------------------ env read sites
+
+    def _env_name(self, module, node):
+        """The name of a module-alias reference, e.g. ``_os`` -> ``os``."""
+        if isinstance(node, ast.Name):
+            return module.import_aliases.get(node.id) or \
+                (".".join(module.from_imports[node.id])
+                 if node.id in module.from_imports else node.id)
+        if isinstance(node, ast.Attribute):
+            base = self._env_name(module, node.value)
+            return f"{base}.{node.attr}" if base else node.attr
+        return None
+
+    def env_read_sites(self):
+        """Every literal ``RAFT_TPU_*`` env read in the repo."""
+        if self._env_sites is not None:
+            return self._env_sites
+        sites = []
+        for module in self.modules.values():
+            for node in ast.walk(module.tree):
+                var = None
+                if isinstance(node, ast.Call):
+                    target = self._env_name(module, node.func)
+                    if target in ("os.environ.get", "os.getenv",
+                                  "environ.get"):
+                        if node.args and isinstance(node.args[0],
+                                                    ast.Constant) \
+                                and isinstance(node.args[0].value, str):
+                            var = node.args[0].value
+                elif isinstance(node, ast.Subscript):
+                    target = self._env_name(module, node.value)
+                    if target in ("os.environ", "environ") \
+                            and isinstance(node.slice, ast.Constant) \
+                            and isinstance(node.slice.value, str):
+                        var = node.slice.value
+                if var and var.startswith(ENV_PREFIX):
+                    sites.append(EnvReadSite(
+                        rel=module.rel, lineno=node.lineno, var=var,
+                        module=module.dotted))
+        self._env_sites = sites
+        return sites
+
+    # ------------------------------------------------------- traced roster
+
+    def _fn_args_of_transform(self, call):
+        """(transform name, [fn-arg nodes]) when the call is a traced
+        transform, else (None, [])."""
+        name = callee_name(call)
+        if name not in TRANSFORMS:
+            return None, []
+        args = []
+        for pos in TRANSFORMS[name]:
+            if pos < len(call.args):
+                args.append(call.args[pos])
+        # jit(f) spelled with keyword fun=... is not used here; the
+        # positional form covers the codebase
+        return name, args
+
+    def _unwrap_partial(self, node):
+        if isinstance(node, ast.Call) and callee_name(node) == "partial" \
+                and node.args:
+            return self._unwrap_partial(node.args[0])
+        return node
+
+    def _resolve_fn(self, module, node, caller_qual=None):
+        """Resolve an AST expression naming a function to
+        (module, qualname, FunctionDef) within the package, else None."""
+        node = self._unwrap_partial(node)
+        if isinstance(node, ast.Lambda):
+            return module, f"<lambda:{node.lineno}>", node
+        if isinstance(node, ast.Name):
+            local = module.resolve_local(node.id, caller_qual)
+            if local:
+                return module, local[0], local[1]
+            if node.id in module.from_imports:
+                src_mod, orig = module.from_imports[node.id]
+                if src_mod.startswith(self.package):
+                    target = self.module_by_dotted(src_mod)
+                    if target:
+                        hit = target.resolve_local(orig)
+                        if hit:
+                            return target, hit[0], hit[1]
+            return None
+        if isinstance(node, ast.Attribute):
+            base = node.value
+            if isinstance(base, ast.Name):
+                dotted = module.import_aliases.get(base.id)
+                if dotted and dotted.startswith(self.package):
+                    target = self.module_by_dotted(dotted)
+                    if target:
+                        hit = target.resolve_local(node.attr)
+                        if hit:
+                            return target, hit[0], hit[1]
+                # self.f / cls.f: a method of the enclosing class only —
+                # arbitrary-object attributes (out.append) never resolve
+                if base.id in ("self", "cls"):
+                    local = module.resolve_local(node.attr, caller_qual)
+                    if local:
+                        return module, local[0], local[1]
+        return None
+
+    def traced_roster(self):
+        """{(rel, qualname): TracedFn} — transform-call targets plus
+        their transitive package-internal callees."""
+        if self._roster is not None:
+            return self._roster
+        roster = {}
+
+        def add(module, qual, node, origin, direct, pallas=False):
+            key = (module.rel, qual)
+            if key not in roster:
+                roster[key] = TracedFn(module=module, qualname=qual,
+                                       node=node, origin=origin,
+                                       direct_body=direct, pallas=pallas)
+                return True
+            entry = roster[key]
+            changed = False
+            if direct and not entry.direct_body:
+                entry.direct_body = True
+                changed = True
+            if pallas and not entry.pallas:
+                entry.pallas = True
+                changed = True
+            return changed
+
+        # seed: direct transform-call targets + decorated functions
+        for module in self.package_modules():
+            for node in ast.walk(module.tree):
+                if isinstance(node, ast.Call):
+                    tname, fnargs = self._fn_args_of_transform(node)
+                    for arg in fnargs:
+                        hit = self._resolve_fn(module, arg)
+                        if hit:
+                            mod, qual, fnode = hit
+                            add(mod, qual, fnode,
+                                f"{tname} call at {module.rel}:"
+                                f"{node.lineno}",
+                                tname in ("scan", "while_loop",
+                                          "fori_loop", "pallas_call"),
+                                pallas=tname == "pallas_call")
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    for dec in node.decorator_list:
+                        base = dec.func if isinstance(dec, ast.Call) \
+                            else dec
+                        base = self._unwrap_partial(base) \
+                            if isinstance(base, ast.Call) else base
+                        name = base.attr if isinstance(base,
+                                                       ast.Attribute) \
+                            else (base.id if isinstance(base, ast.Name)
+                                  else "")
+                        if name in ("jit", "vmap", "pmap", "shard_map") \
+                                or (isinstance(dec, ast.Call)
+                                    and callee_name(dec) == "partial"
+                                    and dec.args
+                                    and self._transform_ref(dec.args[0])):
+                            local = module.resolve_local(node.name)
+                            if local:
+                                add(module, local[0], node,
+                                    f"@{name or 'partial(jit)'} "
+                                    f"decorator", False)
+
+        # transitive closure: package-internal callees of traced fns
+        changed = True
+        while changed:
+            changed = False
+            for key, entry in list(roster.items()):
+                for node in ast.walk(entry.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    if callee_name(node) in TRANSFORMS:
+                        continue     # a nested transform re-seeds above
+                    hit = self._resolve_fn(entry.module, node.func,
+                                           caller_qual=entry.qualname)
+                    if hit:
+                        mod, qual, fnode = hit
+                        if fnode is entry.node:
+                            continue
+                        # direct_body does NOT propagate: a callee of a
+                        # scan body can receive static closure values,
+                        # so all-params-traced only holds for the body
+                        # function itself
+                        if add(mod, qual, fnode,
+                               f"called from traced {entry.qualname} "
+                               f"({entry.module.rel})", False):
+                            changed = True
+        self._roster = roster
+        return roster
+
+    def _transform_ref(self, node):
+        name = node.attr if isinstance(node, ast.Attribute) else (
+            node.id if isinstance(node, ast.Name) else "")
+        return name in ("jit", "vmap", "pmap", "shard_map")

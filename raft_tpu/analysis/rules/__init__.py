@@ -1,0 +1,35 @@
+"""Rule registry: every shipped analyzer, in catalog order.
+
+Add a rule by defining a :class:`raft_tpu.analysis.core.Rule` subclass
+in a module here and appending an instance to ``ALL_RULES`` — the CLI,
+the parametrized tier-1 test, and the bench smoke section all iterate
+this list, so registration is the only step.
+"""
+
+from raft_tpu.analysis.rules.purity import TracedPurity
+from raft_tpu.analysis.rules.locks import LockDiscipline
+from raft_tpu.analysis.rules.flags import FlagHygiene
+from raft_tpu.analysis.rules.hygiene import AllowlistHygiene
+from raft_tpu.analysis.rules.legacy import (
+    BareExcept, FixedPorts, PallasParityRegistered,
+    BatchedPrepRegistered, ChaosRegistered)
+
+ALL_RULES = [
+    TracedPurity(),
+    LockDiscipline(),
+    FlagHygiene(),
+    BareExcept(),
+    FixedPorts(),
+    PallasParityRegistered(),
+    BatchedPrepRegistered(),
+    ChaosRegistered(),
+    AllowlistHygiene(),
+]
+
+
+def rule_by_name(name):
+    for rule in ALL_RULES:
+        if rule.name == name:
+            return rule
+    raise KeyError(f"no rule named {name!r}; registered: "
+                   f"{[r.name for r in ALL_RULES]}")

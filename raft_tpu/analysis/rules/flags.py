@@ -1,0 +1,166 @@
+"""flag-hygiene: every ``RAFT_TPU_*`` flag is documented, tested, and
+— when it can change compiled bits — part of the serve cache's flag
+surface.
+
+Four cross-checks over the project model's env-read sites (package +
+bench + entry files; tests are consumers, not owners):
+
+1. **documented** — the flag appears in docs/usage.md's env table;
+2. **tested** — the flag appears in at least one tests/*.py (env
+   plumbing without a test is how a renamed flag silently becomes a
+   no-op);
+3. **cache surface** — a flag read by a module in the compiled-code
+   roster (``serve/cache.py``'s ``_CODE_VERSION_MODULES``: the sources
+   whose behavior bakes into traced executables) must be declared in
+   ``serve/cache.py``'s ``ENV_FLAG_SURFACE`` map, either pointing at
+   the ``current_flags()`` key that refuses cross-flag executables, or
+   explicitly marked bits-neutral (``None``) with a comment saying why
+   — the same invalidation discipline the cache already enforces for
+   pallas/mixed_precision/fixed_point, now closed under *new* flags;
+4. **no stale rows** — a flag named in docs/usage.md or
+   ``ENV_FLAG_SURFACE`` that no source reads anymore is flagged, so
+   the table tracks the code.
+"""
+
+import ast
+import re
+
+from raft_tpu.analysis.core import Finding, Rule
+from raft_tpu.analysis.project import ENV_PREFIX
+
+DOCS = "docs/usage.md"
+CACHE = "raft_tpu/serve/cache.py"
+
+_VAR_RE = re.compile(r"RAFT_TPU_[A-Z0-9_]*[A-Z0-9]")
+
+#: flags that live outside the serve/docs contract on purpose
+_META_FLAGS = {
+    # driver-internal handshake between bench.py and its subprocess
+    # scripts; never user-facing
+    "RAFT_TPU_BENCH_ROOT",
+    # tier-1 duration recorder switch, read only by tests/conftest.py
+    "RAFT_TPU_TIER1_RECORD",
+}
+
+
+def _owned_sites(project):
+    return [s for s in project.env_read_sites()
+            if not s.rel.startswith("tests/")
+            and s.var not in _META_FLAGS]
+
+
+def _literal_assign(tree, name):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if isinstance(target, ast.Name) and target.id == name:
+                    try:
+                        return ast.literal_eval(node.value)
+                    except (ValueError, SyntaxError):
+                        return None
+    return None
+
+
+class FlagHygiene(Rule):
+    """See module docstring."""
+
+    name = "flag-hygiene"
+    scope = ()
+    describe = ("RAFT_TPU_* flags: documented in docs/usage.md, "
+                "exercised by a test, and on the serve cache's flag "
+                "surface when bits-changing")
+
+    def finalize(self, project):
+        findings = []
+        sites = _owned_sites(project)
+        docs = project.read_text(DOCS) or ""
+        cache_mod = project.modules.get(CACHE)
+        first_site = {}
+        for s in sites:
+            first_site.setdefault(s.var, s)
+
+        # 1 + 2: documented and tested
+        test_source = "\n".join(m.source
+                                for m in project.test_modules())
+        for var, site in sorted(first_site.items()):
+            if var not in docs:
+                findings.append(Finding(
+                    rule=self.name, path=site.rel, line=site.lineno,
+                    ident=var,
+                    message=f"{var} is read here but missing from "
+                            f"{DOCS}'s env table"))
+            if var not in test_source:
+                findings.append(Finding(
+                    rule=self.name, path=site.rel, line=site.lineno,
+                    ident=f"{var}:untested",
+                    message=f"{var} appears in no tests/*.py — add a "
+                            "test exercising the env plumbing (see "
+                            "tests/test_env_flags.py)"))
+
+        # 3: cache surface for compiled-roster modules
+        if cache_mod is None:
+            findings.append(Finding(
+                rule=self.name, path=CACHE, line=1,
+                ident="missing-cache",
+                message=f"{CACHE} not found — the flag-surface "
+                        "cross-check has no contract to read"))
+            return findings
+        roster = _literal_assign(cache_mod.tree, "_CODE_VERSION_MODULES")
+        surface = _literal_assign(cache_mod.tree, "ENV_FLAG_SURFACE")
+        flag_keys = tuple(_literal_assign(cache_mod.tree, "_FLAG_KEYS")
+                          or ())
+        topo_keys = tuple(_literal_assign(cache_mod.tree,
+                                          "_TOPOLOGY_KEYS") or ())
+        if not isinstance(surface, dict):
+            findings.append(Finding(
+                rule=self.name, path=CACHE, line=1,
+                ident="missing-surface",
+                message=f"{CACHE} declares no literal ENV_FLAG_SURFACE "
+                        "dict mapping RAFT_TPU_* names to "
+                        "current_flags() keys (or None with a "
+                        "bits-neutral reason comment)"))
+            surface = {}
+        roster = set(roster or ())
+        roster_vars = {}
+        for s in sites:
+            if s.module in roster:
+                roster_vars.setdefault(s.var, s)
+        for var, site in sorted(roster_vars.items()):
+            if var not in surface:
+                findings.append(Finding(
+                    rule=self.name, path=site.rel, line=site.lineno,
+                    ident=f"{var}:surface",
+                    message=f"{var} is read by compiled-roster module "
+                            f"{site.module} but absent from "
+                            f"ENV_FLAG_SURFACE in {CACHE} — a "
+                            "cross-flag executable would be reused, "
+                            "not refused"))
+        for var, key in sorted(surface.items()):
+            if key is not None and key not in flag_keys + topo_keys:
+                findings.append(Finding(
+                    rule=self.name, path=CACHE, line=1,
+                    ident=f"{var}:surface-key",
+                    message=f"ENV_FLAG_SURFACE maps {var} to "
+                            f"{key!r}, which is not a _FLAG_KEYS/"
+                            "_TOPOLOGY_KEYS member — the refusal "
+                            "check never compares it"))
+            if var not in roster_vars:
+                findings.append(Finding(
+                    rule=self.name, path=CACHE, line=1,
+                    ident=f"{var}:surface-stale",
+                    message=f"ENV_FLAG_SURFACE lists {var} but no "
+                            "compiled-roster module reads it — stale "
+                            "row"))
+
+        # 4: docs rows for flags nothing reads anymore
+        all_source_vars = set()
+        for m in project.modules.values():
+            all_source_vars |= set(_VAR_RE.findall(m.source))
+        for var in sorted(set(_VAR_RE.findall(docs))):
+            if var not in all_source_vars:
+                findings.append(Finding(
+                    rule=self.name, path=DOCS, line=1,
+                    ident=f"{var}:doc-stale",
+                    message=f"{DOCS} documents {var} but no source "
+                            "file mentions it — retire the row"))
+        return findings

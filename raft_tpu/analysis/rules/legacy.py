@@ -1,0 +1,393 @@
+"""The five pre-framework lints, migrated onto the Rule protocol.
+
+Their bespoke test-module walkers are gone; the test files remain as
+thin shims (same test names, so tier-1 history stays comparable) that
+assert the framework rule reports nothing.  Semantics are unchanged —
+same detection logic, same allowlist keys (``path::qualname`` for the
+bare-except rule) — only the plumbing moved.
+"""
+
+import ast
+import re
+
+from raft_tpu.analysis.core import Finding, Rule
+from raft_tpu.analysis.project import callee_name
+
+# ------------------------------------------------------------ bare except
+
+# a call to any of these attribute/function names counts as handling
+LOG_NAMES = {
+    "print", "warn", "warning", "error", "exception", "info", "debug",
+    "log", "critical", "fail", "skip", "xfail",
+}
+# an assignment/subscript target whose name contains one of these counts
+# as recording a failure status
+RECORD_MARKERS = ("error", "fail", "status", "reason", "exc", "bad",
+                  "corrupt", "reject", "quarantine", "msg")
+
+
+def _names_in(node):
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _target_marks_failure(target):
+    if isinstance(target, ast.Name):
+        name = target.id.lower()
+    elif isinstance(target, ast.Attribute):
+        name = target.attr.lower()
+    elif isinstance(target, ast.Subscript):
+        name = ""
+        if isinstance(target.slice, ast.Constant) \
+                and isinstance(target.slice.value, str):
+            name = target.slice.value.lower()
+        base = target.value
+        if isinstance(base, ast.Name):
+            name += " " + base.id.lower()
+        elif isinstance(base, ast.Attribute):
+            name += " " + base.attr.lower()
+    else:
+        return False
+    return any(m in name for m in RECORD_MARKERS)
+
+
+def _handler_handles(handler):
+    """Whether an ``except Exception`` body re-raises, logs, or records
+    the failure."""
+    exc_name = handler.name
+    for node in ast.walk(handler):
+        if isinstance(node, (ast.Raise, ast.Assert)):
+            return True
+        if isinstance(node, ast.Call):
+            if callee_name(node) in LOG_NAMES:
+                return True
+            if any(kw.arg in ("error", "status") for kw in node.keywords):
+                return True
+            if exc_name and any(exc_name in _names_in(a)
+                                for a in node.args):
+                return True
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (node.targets
+                       if isinstance(node, ast.Assign) else [node.target])
+            if any(_target_marks_failure(t) for t in targets):
+                return True
+            if exc_name and exc_name in _names_in(node):
+                return True
+        if isinstance(node, (ast.Return, ast.Yield)) \
+                and node.value is not None:
+            if exc_name and exc_name in _names_in(node.value):
+                return True
+    return False
+
+
+def _broad_type(handler):
+    """'bare', 'broad' (Exception/BaseException, alone or in a tuple),
+    or None."""
+    if handler.type is None:
+        return "bare"
+    types = (handler.type.elts if isinstance(handler.type, ast.Tuple)
+             else [handler.type])
+    for t in types:
+        name = t.id if isinstance(t, ast.Name) else (
+            t.attr if isinstance(t, ast.Attribute) else "")
+        if name in ("Exception", "BaseException"):
+            return "broad"
+    return None
+
+
+def qualname_of(tree, lineno):
+    """Innermost enclosing function/class qualname for a line."""
+    best = "<module>"
+    best_span = None
+
+    def visit(node, prefix):
+        nonlocal best, best_span
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                end = getattr(child, "end_lineno", child.lineno)
+                qual = (prefix + "." + child.name).lstrip(".")
+                if child.lineno <= lineno <= end:
+                    span = end - child.lineno
+                    if best_span is None or span <= best_span:
+                        best, best_span = qual, span
+                    visit(child, qual)
+            else:
+                visit(child, prefix)
+
+    visit(tree, "")
+    return best
+
+
+class BareExcept(Rule):
+    """No bare ``except:`` ever; every ``except Exception`` must raise,
+    log, or record a failure status."""
+
+    name = "no-bare-except"
+    scope = ("**/*.py", "*.py")
+    describe = ("no bare `except:`; broad handlers must raise, log, or "
+                "record a failure status")
+
+    def check(self, tree, source, path):
+        findings = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            kind = _broad_type(node)
+            if kind is None:
+                continue
+            qual = qualname_of(tree, node.lineno)
+            if kind == "bare":
+                findings.append(Finding(
+                    rule=self.name, path=path, line=node.lineno,
+                    ident=f"{qual}:bare",
+                    message="bare `except:` — catch a class, at minimum "
+                            "`except Exception` with handling"))
+                continue
+            if _handler_handles(node):
+                continue
+            findings.append(Finding(
+                rule=self.name, path=path, line=node.lineno, ident=qual,
+                message=f"`except Exception` handler in {qual} neither "
+                        "raises, logs, nor records a failure status"))
+        return findings
+
+
+# ------------------------------------------------------------ fixed ports
+
+PORT_PATTERNS = [
+    re.compile(r"""\(\s*["'](?:127\.0\.0\.1|0\.0\.0\.0|localhost|::1?)"""
+               r"""["']\s*,\s*(\d+)\s*\)"""),
+    re.compile(r"""\b(?:port|http_port)\s*=\s*(\d+)"""),
+    re.compile(r"""["']--http["']\s*,\s*["'](\d+)["']"""),
+    re.compile(r"""["'](?:127\.0\.0\.1|0\.0\.0\.0|localhost|\[::1?\])"""
+               r""":(\d+)["']"""),
+]
+
+_PORT_ALLOW = "# port-lint: allow"
+
+
+class FixedPorts(Rule):
+    """Every server binds port 0 and reads the assigned port back — a
+    literal TCP port anywhere is a CI port-collision flake waiting."""
+
+    name = "no-fixed-ports"
+    scope = ("tests/*.py", "bench*.py", "raft_tpu/**/*.py",
+             "raft_tpu/*.py")
+    describe = "no fixed TCP port literals (bind port 0, read it back)"
+
+    def check(self, tree, source, path):
+        findings = []
+        for lineno, line in enumerate(source.splitlines(), 1):
+            if _PORT_ALLOW in line:
+                continue
+            for pat in PORT_PATTERNS:
+                for m in pat.finditer(line):
+                    if int(m.group(1)) != 0:
+                        findings.append(Finding(
+                            rule=self.name, path=path, line=lineno,
+                            ident=m.group(0).strip(),
+                            message=f"fixed TCP port literal "
+                                    f"`{m.group(0).strip()}` — bind "
+                                    "port 0 and read the assigned port "
+                                    "back"))
+        return findings
+
+
+# ------------------------------------------- registration lints (3 of them)
+
+def _test_registry(project, marker):
+    """(imported modules, marker-test names) per tests/*.py module."""
+    registry = []
+    for module in project.test_modules():
+        imports = set()
+        marked = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                imports.add(node.module)
+            elif isinstance(node, ast.Import):
+                imports.update(a.name for a in node.names)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)) \
+                    and node.name.startswith("test_") \
+                    and marker in node.name:
+                marked.append(node.name)
+        registry.append((module.rel, imports, marked))
+    return registry
+
+
+class PallasParityRegistered(Rule):
+    """Every module invoking ``pallas_call`` must be covered by a
+    registered ``test_*parity*`` test importing it."""
+
+    name = "pallas-parity-registered"
+    scope = ()
+    describe = ("every pallas_call module needs a registered "
+                "test_*parity* test")
+    #: the probe must keep finding this module, else it went stale
+    expected_modules = ("raft_tpu.pallas_kernels",)
+
+    def _kernel_modules(self, project):
+        mods = []
+        for module in project.package_modules():
+            for node in ast.walk(module.tree):
+                if isinstance(node, ast.Call) \
+                        and callee_name(node) == "pallas_call":
+                    mods.append(module)
+                    break
+        return mods
+
+    def finalize(self, project):
+        findings = []
+        mods = self._kernel_modules(project)
+        dotted = {m.dotted for m in mods}
+        for expected in self.expected_modules:
+            if project.module_by_dotted(expected) is not None \
+                    and expected not in dotted:
+                findings.append(Finding(
+                    rule=self.name, path="raft_tpu/analysis/rules/"
+                    "legacy.py", line=1, ident=f"stale-probe:{expected}",
+                    message=f"{expected} exists but the pallas_call "
+                            "probe no longer finds it — update the rule"))
+        registry = _test_registry(project, "parity")
+        for module in mods:
+            covered = any(module.dotted in imports and marked
+                          for _, imports, marked in registry)
+            if not covered:
+                findings.append(Finding(
+                    rule=self.name, path=module.rel, line=1,
+                    ident=module.dotted,
+                    message=f"{module.dotted} calls pallas_call but no "
+                            "tests/*.py imports it and defines a "
+                            "test_*parity* function"))
+        return findings
+
+
+class BatchedPrepRegistered(Rule):
+    """Every multi-design prep driver must be covered by a registered
+    ``test_*batched*`` test importing it."""
+
+    name = "batched-prep-registered"
+    scope = ()
+    describe = ("every multi-design prep driver needs a registered "
+                "test_*batched* test")
+    solo_prep_calls = ("_prepare_design", "_prepare_design_point")
+    prep_loop_defs = ("_sweep_prep_ahead_locked",)
+    expected_modules = ("raft_tpu.sweep", "raft_tpu.sweep_fused",
+                        "raft_tpu.serve.engine")
+
+    def _driver_modules(self, project):
+        mods = []
+        for module in project.package_modules():
+            hit = False
+            for node in ast.walk(module.tree):
+                if isinstance(node, ast.Call) \
+                        and callee_name(node) in self.solo_prep_calls:
+                    hit = True
+                elif isinstance(node, ast.FunctionDef) \
+                        and node.name in self.prep_loop_defs:
+                    hit = True
+                if hit:
+                    break
+            if hit:
+                mods.append(module)
+        return mods
+
+    def finalize(self, project):
+        findings = []
+        mods = self._driver_modules(project)
+        dotted = {m.dotted for m in mods}
+        for expected in self.expected_modules:
+            if project.module_by_dotted(expected) is not None \
+                    and expected not in dotted:
+                findings.append(Finding(
+                    rule=self.name, path="raft_tpu/analysis/rules/"
+                    "legacy.py", line=1, ident=f"stale-probe:{expected}",
+                    message=f"{expected} exists but the prep-driver "
+                            "probe no longer finds it — update the rule"))
+        registry = _test_registry(project, "batched")
+        for module in mods:
+            covered = any(module.dotted in imports and marked
+                          for _, imports, marked in registry)
+            if not covered:
+                findings.append(Finding(
+                    rule=self.name, path=module.rel, line=1,
+                    ident=module.dotted,
+                    message=f"{module.dotted} drives multi-design prep "
+                            "but no tests/*.py imports it and defines a "
+                            "test_*batched* function"))
+        return findings
+
+
+class ChaosRegistered(Rule):
+    """Every fault in ``raft_tpu.chaos.FAULTS`` must be injected by at
+    least one test (the fault name appears in a test file that defines
+    tests)."""
+
+    name = "chaos-registered"
+    scope = ()
+    describe = "every registered chaos fault needs a test injecting it"
+    expected_faults = ("prep_raise", "nan_lane", "replica_kill",
+                       "replica_slow", "conn_drop")
+
+    def _registered_faults(self, project):
+        module = project.module_by_dotted("raft_tpu.chaos")
+        if module is None:
+            return None
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            for target in node.targets:
+                if isinstance(target, ast.Name) \
+                        and target.id == "FAULTS":
+                    try:
+                        names = ast.literal_eval(node.value)
+                    except ValueError:
+                        return None
+                    if isinstance(names, tuple) and names:
+                        return names
+        return None
+
+    def finalize(self, project):
+        faults = self._registered_faults(project)
+        if faults is None:
+            return [Finding(
+                rule=self.name, path="raft_tpu/chaos.py", line=1,
+                ident="stale-probe:FAULTS",
+                message="chaos.py no longer assigns a literal FAULTS "
+                        "tuple; update this rule's probe")]
+        findings = []
+        for expected in self.expected_faults:
+            if expected not in faults:
+                findings.append(Finding(
+                    rule=self.name, path="raft_tpu/chaos.py", line=1,
+                    ident=f"missing-fault:{expected}",
+                    message=f"documented fault {expected!r} is no "
+                            "longer in chaos.FAULTS"))
+        # a test file naming the fault in any string constant counts —
+        # faults are only reachable through the RAFT_TPU_CHAOS spec
+        # string, so injection necessarily spells the name
+        registry = []
+        for module in project.test_modules():
+            if module.rel.endswith("test_chaos_registered.py"):
+                continue        # the shim naming a fault is not coverage
+            strings = set()
+            has_tests = False
+            for node in ast.walk(module.tree):
+                if isinstance(node, ast.Constant) \
+                        and isinstance(node.value, str):
+                    strings.add(node.value)
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)) \
+                        and node.name.startswith("test_"):
+                    has_tests = True
+            registry.append((strings, has_tests))
+        for fault in faults:
+            covered = any(has_tests and any(fault in s for s in strings)
+                          for strings, has_tests in registry)
+            if not covered:
+                findings.append(Finding(
+                    rule=self.name, path="raft_tpu/chaos.py", line=1,
+                    ident=fault,
+                    message=f"chaos fault {fault!r} has no test "
+                            "injecting it (add a RAFT_TPU_CHAOS test)"))
+        return findings

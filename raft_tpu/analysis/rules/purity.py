@@ -1,0 +1,235 @@
+"""traced-purity: host-side effects reachable from traced code.
+
+The project model's roster holds every function passed to
+``jit``/``vmap``/``shard_map``/``pallas_call``/``scan``/``while_loop``
+plus its transitive package-internal callees.  Inside those functions
+this rule flags:
+
+* ``np.*(...)`` calls (host NumPy inside a traced graph: a silent
+  constant-fold at best, a TracerArrayConversionError at worst);
+* ``time``/``datetime``/``random`` stdlib calls (trace-time values
+  frozen into the compiled executable);
+* ``os.environ`` / ``os.getenv`` reads (flag reads that bypass the
+  serve cache's flag surface — the executable silently bakes the value
+  in);
+* ``print`` calls (host I/O; use ``jax.debug.print`` under trace);
+* stores into captured or argument-rooted mutable state (a traced
+  function that mutates a closure list/dict runs once at trace time —
+  the mutation does not re-run per call); direct Pallas kernels are
+  exempt for parameter refs, since ``out_ref[...] = ...`` is how a
+  kernel produces output;
+* for functions that are *direct* ``scan``/``while_loop``/
+  ``pallas_call`` bodies (every parameter is a traced value by
+  construction): Python ``if`` on a parameter-derived value and
+  ``float()``/``int()``/``bool()`` coercions of one — both force a
+  concretization error or a silent trace-time specialization.
+
+Trace-time-constant uses that are deliberate (e.g. ``np`` math on
+static shapes) get allowlisted with a reason, never silently skipped.
+"""
+
+import ast
+
+from raft_tpu.analysis.core import Finding, Rule
+from raft_tpu.analysis.project import TRANSFORMS, callee_name
+
+HOST_MODULES = ("time", "datetime", "random")
+MUTATORS = {"append", "extend", "insert", "update", "add", "pop",
+            "popitem", "remove", "discard", "clear", "setdefault",
+            "appendleft", "popleft", "write", "sort"}
+COERCIONS = {"float", "int", "bool"}
+
+
+def _root_name(node):
+    """The leftmost Name of an attribute/subscript chain, or None."""
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _walk_own(fn_node):
+    """Walk a function body without descending into nested function
+    defs or lambdas (those are separate roster entries when live)."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _params_of(fn_node):
+    args = fn_node.args
+    names = [a.arg for a in args.posonlyargs + args.args
+             + args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return set(names)
+
+
+def _assigned_names(fn_node):
+    """Names bound inside the function body (excluding nested defs)."""
+    bound = set()
+    for node in _walk_own(fn_node):
+        if isinstance(node, ast.Name) \
+                and isinstance(node.ctx, (ast.Store, ast.Del)):
+            bound.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            bound.add(node.name)
+        elif isinstance(node, ast.withitem) \
+                and node.optional_vars is not None:
+            for n in ast.walk(node.optional_vars):
+                if isinstance(n, ast.Name):
+                    bound.add(n.id)
+    return bound
+
+
+def _names_in(node):
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+class TracedPurity(Rule):
+    """See module docstring."""
+
+    name = "traced-purity"
+    scope = ()
+    describe = ("no host effects (np/time/random/os.environ/print/"
+                "captured-state mutation) reachable from traced code")
+
+    def _module_target(self, module, node):
+        """Dotted module a call target resolves to via import aliases,
+        e.g. ``_np.asarray`` -> ``numpy``; '' when unknown."""
+        parts = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return "", ""
+        root = node.id
+        dotted = module.import_aliases.get(root)
+        if dotted is None and root in module.from_imports:
+            mod, orig = module.from_imports[root]
+            dotted = f"{mod}.{orig}"
+        if dotted is None:
+            return "", ".".join(reversed(parts + [root]))
+        return dotted, ".".join([dotted] + list(reversed(parts)))
+
+    def _check_fn(self, entry):
+        module, fn = entry.module, entry.node
+        qual = entry.qualname
+        findings = []
+        params = _params_of(fn) if not isinstance(fn, ast.Lambda) \
+            else {a.arg for a in fn.args.args}
+        bound = _assigned_names(fn) if not isinstance(fn, ast.Lambda) \
+            else set()
+
+        def add(node, kind, detail, msg):
+            findings.append(Finding(
+                rule=self.name, path=module.rel, line=node.lineno,
+                ident=f"{qual}:{kind}:{detail}",
+                message=f"{msg} in traced `{qual}` "
+                        f"(roster: {entry.origin})"))
+
+        # ---- taint for direct scan/while_loop/pallas_call bodies only:
+        # every parameter of a direct body is a traced value, so Python
+        # control flow / coercion on it is a concretization bug
+        tainted = set(params) if entry.direct_body else set()
+        if tainted:
+            for _ in range(2):      # two passes: one-hop chains settle
+                for node in _walk_own(fn):
+                    if isinstance(node, ast.Assign) \
+                            and _names_in(node.value) & tainted:
+                        for t in node.targets:
+                            tainted |= {n.id for n in ast.walk(t)
+                                        if isinstance(n, ast.Name)}
+
+        for node in _walk_own(fn):
+            if isinstance(node, ast.Call):
+                target_mod, dotted = self._module_target(module,
+                                                         node.func)
+                base = target_mod.split(".")[0]
+                if base == "numpy":
+                    add(node, "np", dotted,
+                        f"host NumPy call `{dotted}`")
+                elif base in HOST_MODULES:
+                    add(node, "host", dotted,
+                        f"host stdlib call `{dotted}`")
+                elif dotted in ("os.getenv",) \
+                        or target_mod == "os.environ":
+                    add(node, "env", dotted or "os.environ",
+                        "environment read")
+                elif isinstance(node.func, ast.Name) \
+                        and node.func.id == "print" \
+                        and "print" not in bound:
+                    add(node, "print", "print",
+                        "`print` call (use jax.debug.print)")
+                elif isinstance(node.func, ast.Name) \
+                        and node.func.id in COERCIONS \
+                        and node.func.id not in bound \
+                        and tainted \
+                        and any(_names_in(a) & tainted
+                                for a in node.args):
+                    add(node, "coerce", node.func.id,
+                        f"`{node.func.id}()` on a traced value "
+                        "(concretizes the tracer)")
+                # mutating method call on captured / argument state
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in MUTATORS:
+                    root = _root_name(node.func.value)
+                    if root and root not in bound and root != "self":
+                        where = ("argument" if root in params
+                                 else "captured state")
+                        add(node, "mutate", f"{root}.{node.func.attr}",
+                            f"mutation of {where} "
+                            f"`{root}.{node.func.attr}(...)`")
+            elif isinstance(node, ast.Subscript) \
+                    and isinstance(node.ctx, ast.Load):
+                target_mod, dotted = self._module_target(module,
+                                                         node.value)
+                if target_mod == "os.environ" or dotted == "os.environ":
+                    add(node, "env", "os.environ", "environment read")
+            elif isinstance(node, (ast.Assign, ast.AugAssign,
+                                   ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, (ast.Attribute, ast.Subscript)):
+                        root = _root_name(t)
+                        if root and root not in bound and root != "self":
+                            if entry.pallas and root in params:
+                                # ``out_ref[...] = ...`` is how a Pallas
+                                # kernel produces output — not a purity
+                                # violation
+                                continue
+                            where = ("argument" if root in params
+                                     else "captured state")
+                            add(t, "mutate", f"{root}[]",
+                                f"store into {where} rooted at "
+                                f"`{root}`")
+            elif isinstance(node, ast.If) and tainted \
+                    and _names_in(node.test) & tainted:
+                names = sorted(_names_in(node.test) & tainted)
+                add(node, "if", names[0],
+                    f"Python `if` on traced value(s) {names} "
+                    "(use lax.cond/jnp.where)")
+        return findings
+
+    def finalize(self, project):
+        findings = []
+        for entry in project.traced_roster().values():
+            # transforms themselves (jit wrappers re-entering) excluded
+            if callee_name_is_transform(entry.node):
+                continue
+            findings.extend(self._check_fn(entry))
+        return findings
+
+
+def callee_name_is_transform(fn_node):
+    """A roster entry that IS a transform alias (rare resolution
+    artifact) — nothing to check inside."""
+    return isinstance(fn_node, ast.Name) \
+        and fn_node.id in TRANSFORMS

@@ -169,6 +169,15 @@ class CircuitBreaker:
     for the stats snapshot.
     """
 
+    # shared-state contract enforced by the lock-discipline analyzer
+    # (docs/robustness.md 'Lock discipline')
+    _GUARDED_BY = {
+        "_state": "_lock",
+        "_failures": "_lock",
+        "_opened_at": "_lock",
+        "transitions": "_lock",
+    }
+
     def __init__(self, failure_threshold=3, cooldown_s=30.0,
                  clock=time.monotonic, name=""):
         self.failure_threshold = int(failure_threshold)
@@ -181,7 +190,7 @@ class CircuitBreaker:
         self._opened_at = None
         self.transitions = []
 
-    def _move(self, state, reason):
+    def _move_locked(self, state, reason):
         if state != self._state:
             self.transitions.append(
                 (self._clock(), self._state, state, reason))
@@ -202,7 +211,7 @@ class CircuitBreaker:
                 return True
             if self._state == STATE_OPEN:
                 if self._clock() - self._opened_at >= self.cooldown_s:
-                    self._move(STATE_HALF_OPEN, "cooldown elapsed")
+                    self._move_locked(STATE_HALF_OPEN, "cooldown elapsed")
                     return True      # this caller is the probe
                 return False
             return False             # half-open: probe already in flight
@@ -211,7 +220,7 @@ class CircuitBreaker:
         with self._lock:
             self._failures = 0
             if self._state != STATE_CLOSED:
-                self._move(STATE_CLOSED, "probe succeeded")
+                self._move_locked(STATE_CLOSED, "probe succeeded")
 
     def record_failure(self, reason="failure"):
         with self._lock:
@@ -219,7 +228,7 @@ class CircuitBreaker:
             if self._state == STATE_HALF_OPEN \
                     or self._failures >= self.failure_threshold:
                 self._opened_at = self._clock()
-                self._move(STATE_OPEN, reason)
+                self._move_locked(STATE_OPEN, reason)
 
     def trip(self, reason="tripped"):
         """Force-open regardless of the failure count (the watchdog's
@@ -227,7 +236,7 @@ class CircuitBreaker:
         with self._lock:
             self._failures = max(self._failures, self.failure_threshold)
             self._opened_at = self._clock()
-            self._move(STATE_OPEN, reason)
+            self._move_locked(STATE_OPEN, reason)
 
     def snapshot(self):
         with self._lock:
@@ -245,6 +254,8 @@ class BreakerBoard:
     """Keyed registry of circuit breakers — the engine keys on
     (backend, bucket spec) so one sick executable family never blocks
     the others."""
+
+    _GUARDED_BY = {"_breakers": "_lock"}
 
     def __init__(self, failure_threshold=3, cooldown_s=30.0,
                  clock=time.monotonic):

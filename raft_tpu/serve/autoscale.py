@@ -114,6 +114,19 @@ class AutoscaleConfig:
 class Autoscaler:
     """Deterministic policy loop over a fleet (see module docstring)."""
 
+    # policy state is single-writer by intent, but step() has two entry
+    # points (the live loop and direct calls from tests/bench) — the
+    # step lock serializes them so both can never pass the cooldown
+    # check together and double-act (docs/robustness.md
+    # 'Lock discipline')
+    _GUARDED_BY = {
+        "decisions": "_step_lock",
+        "steps": "_step_lock",
+        "_high_since": "_step_lock",
+        "_low_since": "_step_lock",
+        "_last_action_t": "_step_lock",
+    }
+
     def __init__(self, fleet, config=None, clock=time.monotonic):
         self.fleet = fleet
         self.config = config or AutoscaleConfig()
@@ -124,6 +137,7 @@ class Autoscaler:
         self._high_since = None    # clock() when pressure crossed high
         self._low_since = None
         self._last_action_t = None
+        self._step_lock = threading.Lock()
         self._stop_evt = threading.Event()
         self._thread = None
 
@@ -144,7 +158,15 @@ class Autoscaler:
     def step(self):
         """One policy evaluation; returns the decision record when an
         action was taken, else None.  All state transitions happen here
-        so an injected clock replays the policy exactly."""
+        so an injected clock replays the policy exactly.
+
+        Serialized: the live loop (``start()``) and direct callers
+        (tests, bench harnesses, an operator poke) may race — without
+        the lock both can observe "past cooldown" and double-scale."""
+        with self._step_lock:
+            return self._step_locked()
+
+    def _step_locked(self):
         now = self.clock()
         self.steps += 1
         gauges = self.fleet.replica_gauges()
@@ -174,7 +196,7 @@ class Autoscaler:
                 replica = self.fleet.scale_out()
                 self._last_action_t = now
                 self._high_since = self._low_since = None
-                rec = self._record(now, "heal", replica, per, shedding,
+                rec = self._record_locked(now, "heal", replica, per, shedding,
                                    alive + 1)
                 if reaped:
                     rec["reaped"] = list(reaped)
@@ -191,7 +213,7 @@ class Autoscaler:
             replica = self.fleet.scale_out()
             self._last_action_t = now
             self._high_since = None
-            return self._record(now, "scale_out", replica, per,
+            return self._record_locked(now, "scale_out", replica, per,
                                 shedding, n + 1)
         if (low and self._low_since is not None
                 and now - self._low_since >= self.config.sustain_s
@@ -203,11 +225,11 @@ class Autoscaler:
                 return None
             self._last_action_t = now
             self._low_since = None
-            return self._record(now, "scale_in", replica, per,
+            return self._record_locked(now, "scale_in", replica, per,
                                 shedding, n - 1)
         return None
 
-    def _record(self, now, action, replica, per, shedding, n_after):
+    def _record_locked(self, now, action, replica, per, shedding, n_after):
         rec = {
             "t": round(now - self._t0, 3),
             "action": action,

@@ -177,7 +177,7 @@ _CODE_VERSION_MODULES = (
     "raft_tpu.dynamics", "raft_tpu.hydro", "raft_tpu.waves",
     "raft_tpu.geometry", "raft_tpu.model", "raft_tpu.serve.buckets",
     "raft_tpu.pallas_kernels", "raft_tpu.precision",
-    "raft_tpu.waterfall",
+    "raft_tpu.waterfall", "raft_tpu.batched_prep",
 )
 
 
@@ -244,6 +244,33 @@ _FLAG_KEYS = ("backend", "x64", "code_version", "jax",
 #: artifacts (prep bits are topology-independent: PR 3 measured
 #: host-sharded prep bit-identical to single-device)
 _TOPOLOGY_KEYS = ("n_devices", "mesh", "lane_block")
+
+#: every env flag read by a _CODE_VERSION_MODULES module, mapped to the
+#: current_flags()/topology_flags() key that refuses cross-flag reuse —
+#: or None when the flag is bits-neutral, with the reason on the row.
+#: The flag-hygiene analyzer (raft_tpu/analysis) cross-checks this
+#: literal against the actual env-read sites, so a new bits-changing
+#: flag cannot ship without either a surface key or an explicit
+#: bits-neutral claim.
+ENV_FLAG_SURFACE = {
+    "RAFT_TPU_PALLAS": "pallas",
+    "RAFT_TPU_MIXED_PRECISION": "mixed_precision",
+    "RAFT_TPU_FIXED_POINT": "fixed_point",
+    # block count changes how often the waterfall block program runs,
+    # not the bits it produces (waterfall parity tests pin equality
+    # across blocks); executables themselves recompile per jaxpr, so a
+    # different block can never reuse the other's executable
+    "RAFT_TPU_FIXED_POINT_BLOCK": None,
+    "RAFT_TPU_SERVE_DEVICES": "n_devices",
+    "RAFT_TPU_SERVE_LANE_BLOCK": "lane_block",
+    # batched traced prep produces bit-identical prep artifacts to the
+    # per-design host path (batched-prep parity tests), and prep keys
+    # already fold in code_version — the mode flag itself is bits-neutral
+    "RAFT_TPU_BATCHED_PREP": None,
+    # prep lane-block padding is discarded after the batched solve;
+    # outputs are block-size independent by the same parity tests
+    "RAFT_TPU_PREP_BLOCK": None,
+}
 
 
 def flags_mismatch(entry_flags, flags=None, topology=True):

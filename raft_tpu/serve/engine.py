@@ -278,6 +278,11 @@ class _Pending:
     guarantees it a terminal status (at latest, ``status="shutdown"``
     when the engine stops)."""
 
+    # _once is not a mutual-exclusion guard: it is an exactly-once gate
+    # (first non-blocking acquire wins and the winner is the only writer
+    # of _result before _event publishes it), so no attribute maps to it
+    _GUARDED_BY = {}
+
     def __init__(self, rid):
         self.rid = rid
         self._event = threading.Event()
@@ -474,6 +479,31 @@ class Engine:
     >>> res = handle.result(timeout=300)
     >>> res.Xi.shape     # [ncase, 6, nw]
     """
+
+    # shared-state contract enforced by the lock-discipline analyzer
+    # (docs/robustness.md 'Lock discipline').  _wake is a Condition over
+    # _lock, so `with self._wake:` counts as holding _lock.
+    _GUARDED_BY = {
+        "_queue": "_lock",
+        "_stop": "_lock",
+        "_drain": "_lock",
+        "_shedding": "_lock",
+        "_rid": "_lock",
+        "_outstanding": "_lock",
+        "stats": "_lock",
+        "_sweep_jobs": "_lock",
+        "_ema_dispatch_s": "_lock",
+        "_prep_memo": "_prep_lock",
+        # the futures dedup table is maintained by submit-side code that
+        # already holds _lock; only the memo itself is under _prep_lock
+        "_prep_futs": "_lock",
+        "_bp_families": "_bp_lock",
+        "_inflight": "_watch_lock",
+    }
+    # probe() is the liveness/readiness gauge: GIL-atomic len()/scalar
+    # reads only, NEVER the lock — a wedged batcher holding _lock must
+    # not be able to wedge the health endpoint with it
+    _LOCK_FREE = ("probe",)
 
     def __init__(self, config=None, **overrides):
         self.config = config or EngineConfig(**overrides)
@@ -735,6 +765,7 @@ class Engine:
             leftovers = list(self._outstanding.values())
             self._queue = []
             self._sweep_jobs = []
+        resolved = 0
         for pend in leftovers:
             job = getattr(pend, "sweep_job", None)
             if job is not None:
@@ -746,14 +777,17 @@ class Engine:
                         preemptions=job.preemptions,
                         error="engine stopped before the sweep "
                               "finished")):
-                    self.stats["shutdown_resolved"] += 1
+                    resolved += 1
                 job.handle._close()
                 continue
             if self._resolve(pend, RequestResult(
                     rid=pend.rid, status="shutdown",
                     error="engine stopped before this request was "
                           "served")):
-                self.stats["shutdown_resolved"] += 1
+                resolved += 1
+        if resolved:
+            with self._lock:
+                self.stats["shutdown_resolved"] += resolved
 
     def _predicted_wait_locked(self, now):
         """Conservative lower bound on this submit's queue wait: the
@@ -832,8 +866,12 @@ class Engine:
             memo = self._prep_memo.get(key)
             if memo is not None:
                 self._prep_memo.move_to_end(key)
+        if memo is not None:
+            # outside _prep_lock: stats is _lock-guarded, and nesting
+            # _lock under _prep_lock would invert the lock order
+            with self._lock:
                 self.stats["prep_memo_hits"] += 1
-                return memo
+            return memo
 
         prepped = None
         if self._prep_cache is not None:
@@ -849,7 +887,8 @@ class Engine:
                     coalesce=self.config.coalesce)
                 prepped = _Prepped(nodes, args, physics, spec,
                                    float(w[1] - w[0]))
-                self.stats["prep_cache_hits"] += 1
+                with self._lock:
+                    self.stats["prep_cache_hits"] += 1
 
         if prepped is None and batched_prep_enabled():
             prepped = self._try_batched_prepare(req, key)
@@ -952,7 +991,8 @@ class Engine:
                 "falling back to the Model build", req.rid,
                 type(e).__name__, e)
             return None
-        self.stats["prep_batched_designs"] += 1
+        with self._lock:
+            self.stats["prep_batched_designs"] += 1
         return self._finish_batched(key, pd, nodes, args)
 
     def _prep_solo_into(self, req, fut):
@@ -995,8 +1035,9 @@ class Engine:
                 memo = self._prep_memo.get(key)
                 if memo is not None:
                     self._prep_memo.move_to_end(key)
-                    self.stats["prep_memo_hits"] += 1
             if memo is not None:
+                with self._lock:
+                    self.stats["prep_memo_hits"] += 1
                 futs[di].set_result(memo)
                 continue
             lane = None
@@ -1028,11 +1069,13 @@ class Engine:
             for di, req, _, _ in lanes:
                 self._prep_solo_into(req, futs[di])
             return
-        self.stats["prep_batched_groups"] += 1
+        with self._lock:
+            self.stats["prep_batched_groups"] += 1
         for (di, req, _, key), (pd, nodes, args) in zip(lanes, triples):
             try:
                 prepped = self._finish_batched(key, pd, nodes, args)
-                self.stats["prep_batched_designs"] += 1
+                with self._lock:
+                    self.stats["prep_batched_designs"] += 1
                 futs[di].set_result(prepped)
             except Exception as e:  # noqa: BLE001 — this lane only
                 futs[di].set_exception(e)
@@ -1350,7 +1393,8 @@ class Engine:
             job.suspended = (seg, out)
             job.t_suspend = time.perf_counter()
             job.preemptions += 1
-            self.stats["sweep_preemptions"] += 1
+            with self._lock:
+                self.stats["sweep_preemptions"] += 1
             return True
         _physics, members, _nodes, _args, ranges, _lanes = seg
         xr, xi, rep = out
@@ -1418,8 +1462,8 @@ class Engine:
             for name in SWEEP_REPORT_KEYS:
                 doc[name] = job.out[name][sel]
         job.handle._push(doc)
-        self.stats["sweep_chunks"] += 1
         with self._lock:
+            self.stats["sweep_chunks"] += 1
             job.seg_queue = None
             for di in chunk:
                 job.futs.pop(di, None)
@@ -1460,7 +1504,7 @@ class Engine:
         with self._lock:
             if job in self._sweep_jobs:
                 self._sweep_jobs.remove(job)
-        self.stats["failed"] += 1
+            self.stats["failed"] += 1
         self._resolve(job.pend, SweepResult(
             rid=job.rid, status="failed",
             n_designs=len(job.designs), n_chunks=len(job.chunks),
@@ -1478,7 +1522,8 @@ class Engine:
             # deadline admission: reject before paying dispatch
             if (req.deadline_s is not None
                     and now > req.t_submit + req.deadline_s):
-                self.stats["rejected_deadline"] += 1
+                with self._lock:
+                    self.stats["rejected_deadline"] += 1
                 self._resolve(pend, RequestResult(
                     rid=req.rid, status="rejected_deadline",
                     error=f"deadline {req.deadline_s}s expired in queue",
@@ -1511,13 +1556,15 @@ class Engine:
                     # the no-drain shutdown cancelled this pending prep:
                     # the request was never served, so it resolves
                     # "shutdown" (retryable at the router), not "failed"
-                    self.stats["shutdown_resolved"] += 1
+                    with self._lock:
+                        self.stats["shutdown_resolved"] += 1
                     self._resolve(pend, RequestResult(
                         rid=req.rid, status="shutdown",
                         error="engine stopped before prep",
                         latency_s=time.perf_counter() - req.t_submit))
                     continue
-                self.stats["failed"] += 1
+                with self._lock:
+                    self.stats["failed"] += 1
                 logger.warning(
                     "serve request %d quarantined: prep raised (%s: %s)",
                     req.rid, type(e).__name__, e)
@@ -1563,7 +1610,8 @@ class Engine:
                 self._dispatch_degraded(physics, spec, members, lanes)
                 return
             for req, pend, _p in members:
-                self.stats["rejected_circuit"] += 1
+                with self._lock:
+                    self.stats["rejected_circuit"] += 1
                 self._resolve(pend, RequestResult(
                     rid=req.rid, status="rejected_circuit", bucket=spec,
                     error=(f"circuit open for {key[0]}/{spec} "
@@ -1593,14 +1641,16 @@ class Engine:
         breaker = self._breakers.get(("cpu-degraded", spec))
         if not breaker.allow():
             for req, pend, _p in members:
-                self.stats["rejected_circuit"] += 1
+                with self._lock:
+                    self.stats["rejected_circuit"] += 1
                 self._resolve(pend, RequestResult(
                     rid=req.rid, status="rejected_circuit", bucket=spec,
                     error="circuit open on the primary AND degraded-CPU "
                           "paths",
                     latency_s=time.perf_counter() - req.t_submit))
             return
-        self.stats["degraded_dispatches"] += 1
+        with self._lock:
+            self.stats["degraded_dispatches"] += 1
         logger.warning(
             "serve: circuit open for %s; degrading bucket %s to the CPU "
             "backend", self.config.device or jax.default_backend(), spec)
@@ -1663,11 +1713,13 @@ class Engine:
                     key=str((backend, spec)),
                     on_retry=self._count_dispatch_retry)
         except WatchdogTimeout as e:
-            self.stats["watchdog_trips"] += 1
+            with self._lock:
+                self.stats["watchdog_trips"] += 1
             breaker.trip(f"watchdog_timeout after "
                          f"{self.config.watchdog_s:.1f}s")
             for req, pend, _p in members:
-                self.stats["watchdog_timeout"] += 1
+                with self._lock:
+                    self.stats["watchdog_timeout"] += 1
                 self._resolve(pend, RequestResult(
                     rid=req.rid, status="watchdog_timeout", bucket=spec,
                     error=str(e), backend=backend,
@@ -1679,7 +1731,8 @@ class Engine:
                 "serve dispatch failed for bucket %s on %s (%s: %s)",
                 spec, backend, type(e).__name__, e)
             for req, pend, _p in members:
-                self.stats["failed"] += 1
+                with self._lock:
+                    self.stats["failed"] += 1
                 self._resolve(pend, RequestResult(
                     rid=req.rid, status="failed", bucket=spec,
                     error=f"{type(e).__name__}: {e}", backend=backend,
@@ -1688,25 +1741,28 @@ class Engine:
         breaker.record_success()
         xr, xi, report = out
         if w.delta["backend_compiles"] or w.delta["persistent_cache_hits"]:
-            self.stats["bucket_compiles"].append({
-                "spec": spec.as_dict(),
-                "compile_s": round(w.delta["backend_compile_s"], 3),
-                "persistent_cache_hits":
-                    w.delta["persistent_cache_hits"],
-            })
+            with self._lock:
+                self.stats["bucket_compiles"].append({
+                    "spec": spec.as_dict(),
+                    "compile_s": round(w.delta["backend_compile_s"], 3),
+                    "persistent_cache_hits":
+                        w.delta["persistent_cache_hits"],
+                })
         xr = np.asarray(xr)
         xi = np.asarray(xi)
         # occupancy over the QUANTIZED capacity: on the sharded path the
         # denominator scales with the mesh width, so the stat reads as
         # "fraction of the whole mesh's lane capacity doing real work"
         occupancy = lanes / capacity
-        self.stats["dispatches"] += 1
-        self.stats["occupancy"].append(occupancy)
-        self.stats["batch_requests"].append(len(members))
         t_done = time.perf_counter()
         dt = t_done - t0
-        self._ema_dispatch_s = (dt if self._ema_dispatch_s is None
-                                else 0.3 * dt + 0.7 * self._ema_dispatch_s)
+        with self._lock:
+            self.stats["dispatches"] += 1
+            self.stats["occupancy"].append(occupancy)
+            self.stats["batch_requests"].append(len(members))
+            self._ema_dispatch_s = (
+                dt if self._ema_dispatch_s is None
+                else 0.3 * dt + 0.7 * self._ema_dispatch_s)
         for (req, pend, prepped), (a, b) in zip(members, ranges):
             Xi = xr[a:b] + 1j * xi[a:b]
             rep = jax.tree.map(lambda arr: np.asarray(arr)[a:b], report)
@@ -1715,19 +1771,22 @@ class Engine:
             std = np.sqrt(
                 np.sum(xr[a:b] ** 2 + xi[a:b] ** 2, axis=-1) * prepped.dw)
             latency = t_done - req.t_submit
-            self.stats["latency_s"].append(latency)
-            if self.stats["first_result_s"] is None:
-                self.stats["first_result_s"] = latency
+            with self._lock:
+                self.stats["latency_s"].append(latency)
+                if self.stats["first_result_s"] is None:
+                    self.stats["first_result_s"] = latency
             if self._resolve(pend, RequestResult(
                     rid=req.rid, status="ok", Xi=Xi, std=std,
                     solve_report=report_dict(rep), bucket=spec,
                     latency_s=latency, queue_s=t0 - req.t_submit,
                     batch_requests=len(members),
                     batch_occupancy=occupancy, backend=backend)):
-                self.stats["ok"] += 1
+                with self._lock:
+                    self.stats["ok"] += 1
 
     def _count_dispatch_retry(self, _attempt, _exc):
-        self.stats["dispatch_retries"] += 1
+        with self._lock:
+            self.stats["dispatch_retries"] += 1
 
     # ----------------------------------------------------------- watchdog
 

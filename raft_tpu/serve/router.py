@@ -315,6 +315,22 @@ class _RouterSweepHandle:
 class Router:
     """See module docstring.  Engine-compatible front surface."""
 
+    # shared-state contract enforced by the lock-discipline analyzer
+    # (docs/robustness.md 'Lock discipline'): every write to these
+    # attributes holds self._lock (or happens in __init__ / a *_locked
+    # method whose caller holds it)
+    _GUARDED_BY = {
+        "_rid": "_lock",
+        "_stop": "_lock",
+        "_outstanding": "_lock",
+        "stats": "_lock",
+        "replicas": "_lock",
+        "_ring": "_lock",
+    }
+    # probe() is the readiness gauge: GIL-atomic len()/dict reads only,
+    # so a wedged batcher holding _lock can never wedge the health check
+    _LOCK_FREE = ("probe",)
+
     def __init__(self, n_replicas=2, cache_dir=None, precision=None,
                  device=None, window_ms=None, warmup=True,
                  replica_argv=(), env_overrides=None,
@@ -545,8 +561,12 @@ class Router:
         """The replica a scale-in should retire: the youngest (highest-
         numbered) alive replica, so retirement exactly unwinds the last
         scale-out's ring arcs."""
-        alive = [rid for rid, rep in sorted(self.replicas.items())
-                 if not rep.dead()]
+        # snapshot under the lock: the autoscaler thread calls this
+        # while scale_out/reap_dead mutate the dict on other threads —
+        # unlocked iteration can raise "dict changed size" mid-scan
+        with self._lock:
+            alive = [rid for rid, rep in sorted(self.replicas.items())
+                     if not rep.dead()]
         if len(alive) <= 1:
             return None
         return max(alive, key=lambda rid: (len(rid), rid))
@@ -593,6 +613,7 @@ class Router:
         with self._lock:
             leftovers = list(self._outstanding.items())
             self._outstanding.clear()
+        resolved = 0
         for rid, pend in leftovers:
             handle = getattr(pend, "router_sweep", None)
             if handle is not None:
@@ -600,13 +621,18 @@ class Router:
                         "rid": rid, "status": "shutdown",
                         "n_designs": handle.n_designs,
                         "error": "router stopped"})):
-                    self.stats["shutdown_resolved"] += 1
+                    resolved += 1
                 handle._close()
                 continue
             if pend._set(wire.result_from_doc({
                     "rid": rid, "status": "shutdown",
                     "error": "router stopped"})):
-                self.stats["shutdown_resolved"] += 1
+                resolved += 1
+        if resolved:
+            # forwarding threads may still be retiring their own stats
+            # entries; unlocked += here can lose their increments
+            with self._lock:
+                self.stats["shutdown_resolved"] += resolved
         for rep in self.replicas.values():
             if rep.proc is not None and rep.proc.poll() is None:
                 rep.proc.send_signal(signal.SIGTERM)
@@ -646,7 +672,8 @@ class Router:
             rep = self.replicas.get(replica_id)
             elapsed = time.perf_counter() - t0
             if deadline_s is not None and deadline_s - elapsed <= 0:
-                self.stats["rejected_deadline"] += 1
+                with self._lock:
+                    self.stats["rejected_deadline"] += 1
                 return self._resolve(rid, pend, wire.result_from_doc({
                     "rid": rid, "status": "rejected_deadline",
                     "error": f"deadline expired after {elapsed:.3f}s at "
@@ -655,7 +682,8 @@ class Router:
                 last_err = f"{replica_id} retired"
                 continue
             if rep.dead():
-                self.stats["dead_replica_skips"] += 1
+                with self._lock:
+                    self.stats["dead_replica_skips"] += 1
                 self._breakers.get(replica_id).record_failure(
                     "replica process dead")
                 last_err = f"{replica_id} dead"
@@ -668,7 +696,8 @@ class Router:
             on_sent = None
             if inj is not None and inj.should("replica_kill",
                                               rid) is not None:
-                self.stats["chaos_replica_kills"] += 1
+                with self._lock:
+                    self.stats["chaos_replica_kills"] += 1
 
                 def on_sent(rep=rep):
                     logger.warning("chaos replica_kill: SIGKILL %s "
@@ -680,20 +709,23 @@ class Router:
             if inj is not None:
                 rule = inj.should("replica_slow", rid)
                 if rule is not None:
-                    self.stats["chaos_replica_slows"] += 1
+                    with self._lock:
+                        self.stats["chaos_replica_slows"] += 1
                     slow_s = float(rule.value
                                    if rule.value is not None else 0.5)
             req = {"design": design, "cases": cases, "xi": True}
             if deadline_s is not None:
                 req["deadline_s"] = deadline_s - elapsed
             try:
-                self.stats["forwarded"] += 1
+                with self._lock:
+                    self.stats["forwarded"] += 1
                 attempted += 1
                 doc = rep.client.solve(req, on_sent=on_sent,
                                        slow_s=slow_s)
             except (ConnectionDropped, TransientError) as e:
                 breaker.record_failure(str(e))
-                self.stats["replica_retries"] += 1
+                with self._lock:
+                    self.stats["replica_retries"] += 1
                 last_err = str(e)
                 logger.warning("forward rid=%d to %s failed (%s); "
                                "retrying on next replica", rid,
@@ -703,13 +735,15 @@ class Router:
                 # replica mid-drain: the request was NOT served — treat
                 # as transient and try the next replica
                 breaker.record_failure("replica draining")
-                self.stats["replica_retries"] += 1
+                with self._lock:
+                    self.stats["replica_retries"] += 1
                 last_err = f"{replica_id} draining"
                 continue
             breaker.record_success()
             rep.served += 1
             status = doc.get("status") or "failed"
-            self.stats[status] = self.stats.get(status, 0) + 1
+            with self._lock:
+                self.stats[status] = self.stats.get(status, 0) + 1
             res = wire.result_from_doc(doc, rid=rid)
             res.replica = replica_id
             res.latency_s = time.perf_counter() - t0
@@ -718,7 +752,8 @@ class Router:
         # that never got past open breakers is "rejected_circuit"
         status = ("rejected_circuit"
                   if not attempted and breaker_skips else "failed")
-        self.stats["failed"] += 1
+        with self._lock:
+            self.stats["failed"] += 1
         return self._resolve(rid, pend, wire.result_from_doc({
             "rid": rid, "status": status,
             "error": f"no replica served the request "
@@ -745,7 +780,8 @@ class Router:
                 last_err = f"{replica_id} retired"
                 continue
             if rep.dead():
-                self.stats["dead_replica_skips"] += 1
+                with self._lock:
+                    self.stats["dead_replica_skips"] += 1
                 self._breakers.get(replica_id).record_failure(
                     "replica process dead")
                 last_err = f"{replica_id} dead"
@@ -760,7 +796,8 @@ class Router:
             idx_map = [i for i in range(len(designs)) if i not in done]
             failover = bool(streamed)
             if failover:
-                self.stats["sweep_chunk_failovers"] += 1
+                with self._lock:
+                    self.stats["sweep_chunk_failovers"] += 1
                 logger.warning(
                     "sweep rid=%d: resuming on %s with %d/%d designs "
                     "remaining (%d chunk(s) checkpointed)", rid,
@@ -791,7 +828,8 @@ class Router:
                     # the failover path (not the clean retry) is what
                     # must recover
                     killed.append(True)
-                    self.stats["chaos_replica_kills"] += 1
+                    with self._lock:
+                        self.stats["chaos_replica_kills"] += 1
                     logger.warning(
                         "chaos replica_kill: SIGKILL %s (sweep rid=%d "
                         "mid-stream, %d chunk(s) relayed)", rep.id, rid,
@@ -801,13 +839,15 @@ class Router:
                         rep.proc.wait(10)
 
             try:
-                self.stats["forwarded"] += 1
+                with self._lock:
+                    self.stats["forwarded"] += 1
                 attempted += 1
                 terminal, _chunks = rep.client.sweep(req,
                                                      on_chunk=on_chunk)
             except (ConnectionDropped, TransientError) as e:
                 breaker.record_failure(str(e))
-                self.stats["replica_retries"] += 1
+                with self._lock:
+                    self.stats["replica_retries"] += 1
                 last_err = (f"stream from {replica_id} dropped after "
                             f"{len(streamed)} chunk(s): {e}"
                             if streamed else str(e))
@@ -819,7 +859,8 @@ class Router:
                 # replica mid-drain: chunks it already streamed are
                 # complete checkpointed results; the remainder retries
                 breaker.record_failure("replica draining")
-                self.stats["replica_retries"] += 1
+                with self._lock:
+                    self.stats["replica_retries"] += 1
                 last_err = f"{replica_id} draining"
                 continue
             breaker.record_success()
@@ -838,7 +879,8 @@ class Router:
                 streamed[-1].get("replica"), True, t0)
         status = ("rejected_circuit"
                   if not attempted and breaker_skips else "failed")
-        self.stats["failed"] += 1
+        with self._lock:
+            self.stats["failed"] += 1
         self._resolve(rid, handle._pend, wire.sweep_result_from_doc({
             "rid": rid, "status": status, "n_designs": len(designs),
             "error": f"no replica served the sweep "
@@ -872,7 +914,9 @@ class Router:
                 preempt[key] = max(preempt.get(key, 0),
                                    int(ch.get("preemptions", 0)))
             term["preemptions"] = sum(preempt.values())
-        self.stats["ok" if term.get("status") == "ok" else "failed"] += 1
+        with self._lock:
+            self.stats["ok" if term.get("status") == "ok"
+                       else "failed"] += 1
         res = wire.sweep_result_from_doc(term, chunks=streamed, rid=rid)
         res.replica = replica_id
         res.latency_s = time.perf_counter() - t0

@@ -1,0 +1,515 @@
+"""graft-lint framework tests (raft_tpu/analysis, docs/analysis.md).
+
+Three layers:
+
+* the tier-1 gate: one parametrized test per registered rule over the
+  REAL repository — the same condition ``python -m raft_tpu.analysis``
+  enforces (exit 0 iff zero unallowlisted findings);
+* fixture tests: each analyzer demonstrably catches a seeded violation
+  in a miniature project tree (and stays quiet on the fixed version) —
+  a rule that silently stops firing is itself a tier-1 failure;
+* policy tests: allowlist entries require reasons (a reasonless entry
+  does not suppress), stale entries are reported, and the CLI's
+  ``--json`` schema stays machine-readable.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from raft_tpu.analysis import (ALL_RULES, ProjectModel, analyze,
+                               rule_by_name, run_rules)
+from raft_tpu.analysis.core import load_allowlist
+from raft_tpu.analysis.rules.hygiene import AllowlistHygiene
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------ the tier-1 gate
+
+@pytest.fixture(scope="module")
+def repo_report():
+    return analyze()
+
+
+@pytest.mark.parametrize("rule_name",
+                         [r.name for r in ALL_RULES])
+def test_rule_is_clean_on_the_repo(repo_report, rule_name):
+    rr = next(r for r in repo_report.reports if r.rule == rule_name)
+    bad = rr.findings + rr.stale_allowlist
+    assert not bad, "\n".join(str(f) for f in bad)
+
+
+def test_every_registered_rule_has_name_and_description():
+    names = [r.name for r in ALL_RULES]
+    assert len(names) == len(set(names))
+    assert len(names) >= 9
+    for r in ALL_RULES:
+        assert r.describe, r.name
+
+
+# ------------------------------------------------------ fixture harness
+
+def _tree(tmp_path, files):
+    for rel, content in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(content))
+    return str(tmp_path)
+
+
+def _run(root, rule_name, tmp_path):
+    # point the allowlists at an empty dir so the repo's own entries
+    # neither suppress fixture findings nor report as stale
+    return analyze(root=root, rules=[rule_by_name(rule_name)],
+                   allowlist_dir=str(tmp_path / "no-allowlists"))
+
+
+def _idents(report):
+    return {f.ident for f in report.findings}
+
+
+# ------------------------------------------------------ traced-purity
+
+def test_purity_catches_numpy_in_jitted_fn(tmp_path):
+    root = _tree(tmp_path, {"raft_tpu/mod.py": """\
+        import jax
+        import numpy as np
+
+        def solve(x):
+            return np.asarray(x) + 1
+
+        solve_fast = jax.jit(solve)
+        """})
+    report = _run(root, "traced-purity", tmp_path)
+    assert "solve:np:numpy.asarray" in _idents(report)
+
+
+def test_purity_quiet_on_jnp_only_fn(tmp_path):
+    root = _tree(tmp_path, {"raft_tpu/mod.py": """\
+        import jax
+        import jax.numpy as jnp
+
+        def solve(x):
+            return jnp.asarray(x) + 1
+
+        solve_fast = jax.jit(solve)
+        """})
+    assert not _run(root, "traced-purity", tmp_path).findings
+
+
+def test_purity_catches_python_if_in_scan_body(tmp_path):
+    root = _tree(tmp_path, {"raft_tpu/mod.py": """\
+        from jax import lax
+
+        def body(carry, x):
+            if x:
+                carry = carry + x
+            return carry, x
+
+        def drive(xs):
+            return lax.scan(body, 0, xs)
+        """})
+    report = _run(root, "traced-purity", tmp_path)
+    assert "body:if:x" in _idents(report)
+
+
+def test_purity_exempts_pallas_out_ref_store(tmp_path):
+    root = _tree(tmp_path, {"raft_tpu/mod.py": """\
+        from jax.experimental import pallas as pl
+
+        def kernel(in_ref, out_ref):
+            out_ref[...] = in_ref[...] * 2
+
+        def call(x):
+            return pl.pallas_call(kernel, out_shape=x)(x)
+        """})
+    assert not _run(root, "traced-purity", tmp_path).findings
+
+
+def test_purity_catches_captured_state_mutation(tmp_path):
+    root = _tree(tmp_path, {"raft_tpu/mod.py": """\
+        import jax
+
+        log = []
+
+        def solve(x):
+            log.append(x)
+            return x
+
+        solve_fast = jax.jit(solve)
+        """})
+    report = _run(root, "traced-purity", tmp_path)
+    assert "solve:mutate:log.append" in _idents(report)
+
+
+def test_purity_reaches_transitive_callees(tmp_path):
+    root = _tree(tmp_path, {"raft_tpu/mod.py": """\
+        import jax
+        import numpy as np
+
+        def helper(x):
+            return np.sum(x)
+
+        def solve(x):
+            return helper(x) + 1
+
+        solve_fast = jax.jit(solve)
+        """})
+    report = _run(root, "traced-purity", tmp_path)
+    assert "helper:np:numpy.sum" in _idents(report)
+
+
+# ------------------------------------------------------ lock-discipline
+
+_LOCKED_CLASS = """\
+    import threading
+
+    class Engine:
+        _GUARDED_BY = {"stats": "_lock"}
+        _LOCK_FREE = ("probe",)
+
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.stats = {}
+"""
+
+
+def test_locks_catch_unguarded_stats_write(tmp_path):
+    root = _tree(tmp_path, {"raft_tpu/serve/engine.py":
+                            _LOCKED_CLASS + """\
+
+        def bump(self):
+            self.stats["ok"] += 1
+        """})
+    report = _run(root, "lock-discipline", tmp_path)
+    assert "Engine.bump:stats" in _idents(report)
+
+
+def test_locks_quiet_when_lock_held_or_locked_suffix(tmp_path):
+    root = _tree(tmp_path, {"raft_tpu/serve/engine.py":
+                            _LOCKED_CLASS + """\
+
+        def bump(self):
+            with self._lock:
+                self.stats["ok"] += 1
+
+        def bump_locked(self):
+            self.stats["ok"] += 1
+        """})
+    assert not _run(root, "lock-discipline", tmp_path).findings
+
+
+def test_locks_catch_lock_free_method_that_writes(tmp_path):
+    root = _tree(tmp_path, {"raft_tpu/serve/engine.py":
+                            _LOCKED_CLASS + """\
+
+        def probe(self):
+            self.stats["probes"] = 1
+            return dict(self.stats)
+        """})
+    report = _run(root, "lock-discipline", tmp_path)
+    assert "Engine.probe:stats" in _idents(report)
+
+
+def test_locks_catch_undeclared_contract(tmp_path):
+    root = _tree(tmp_path, {"raft_tpu/serve/engine.py": """\
+        import threading
+
+        class Quiet:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.state = 0
+        """})
+    report = _run(root, "lock-discipline", tmp_path)
+    assert "Quiet:undeclared" in _idents(report)
+
+
+def test_locks_condition_aliases_its_lock(tmp_path):
+    root = _tree(tmp_path, {"raft_tpu/serve/engine.py": """\
+        import threading
+
+        class Engine:
+            _GUARDED_BY = {"queue": "_lock"}
+
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cv = threading.Condition(self._lock)
+                self.queue = []
+
+            def push(self, item):
+                with self._cv:
+                    self.queue.append(item)
+        """})
+    assert not _run(root, "lock-discipline", tmp_path).findings
+
+
+# ------------------------------------------------------ flag-hygiene
+
+_FLAG_CACHE = """\
+    _CODE_VERSION_MODULES = ("raft_tpu.mod",)
+    _FLAG_KEYS = ("pallas",)
+    _TOPOLOGY_KEYS = ()
+    ENV_FLAG_SURFACE = {SURFACE}
+"""
+
+_FLAG_MOD = """\
+    import os
+
+    FLAG = os.environ.get("RAFT_TPU_NEWFLAG")
+"""
+
+
+def test_flags_catch_undocumented_untested_unsurfaced(tmp_path):
+    root = _tree(tmp_path, {
+        "raft_tpu/mod.py": _FLAG_MOD,
+        "raft_tpu/serve/cache.py":
+            _FLAG_CACHE.replace("{SURFACE}", "{}"),
+        "docs/usage.md": "no flags documented here\n",
+    })
+    idents = _idents(_run(root, "flag-hygiene", tmp_path))
+    assert "RAFT_TPU_NEWFLAG" in idents               # undocumented
+    assert "RAFT_TPU_NEWFLAG:untested" in idents
+    assert "RAFT_TPU_NEWFLAG:surface" in idents       # bits-changing
+
+
+def test_flags_quiet_when_documented_tested_and_on_surface(tmp_path):
+    root = _tree(tmp_path, {
+        "raft_tpu/mod.py": _FLAG_MOD,
+        "raft_tpu/serve/cache.py": _FLAG_CACHE.replace(
+            "{SURFACE}", '{"RAFT_TPU_NEWFLAG": "pallas"}'),
+        "docs/usage.md": "``RAFT_TPU_NEWFLAG`` — toggles the thing\n",
+        "tests/test_mod.py": """\
+            def test_newflag(monkeypatch):
+                monkeypatch.setenv("RAFT_TPU_NEWFLAG", "1")
+            """,
+    })
+    assert not _run(root, "flag-hygiene", tmp_path).findings
+
+
+def test_flags_catch_surface_key_and_stale_doc_row(tmp_path):
+    root = _tree(tmp_path, {
+        "raft_tpu/mod.py": _FLAG_MOD,
+        "raft_tpu/serve/cache.py": _FLAG_CACHE.replace(
+            "{SURFACE}", '{"RAFT_TPU_NEWFLAG": "no_such_key"}'),
+        "docs/usage.md": "``RAFT_TPU_NEWFLAG``; ``RAFT_TPU_GONE``\n",
+        "tests/test_mod.py": """\
+            def test_newflag():
+                assert "RAFT_TPU_NEWFLAG"
+            """,
+    })
+    idents = _idents(_run(root, "flag-hygiene", tmp_path))
+    assert "RAFT_TPU_NEWFLAG:surface-key" in idents
+    assert "RAFT_TPU_GONE:doc-stale" in idents
+
+
+# ------------------------------------------------------ legacy rules
+
+def test_bare_except_fixture(tmp_path):
+    root = _tree(tmp_path, {"raft_tpu/mod.py": """\
+        def risky():
+            try:
+                return 1
+            except:
+                pass
+
+        def silent():
+            try:
+                return 1
+            except Exception:
+                pass
+
+        def handled():
+            try:
+                return 1
+            except Exception as e:
+                print(e)
+        """})
+    idents = _idents(_run(root, "no-bare-except", tmp_path))
+    assert "risky:bare" in idents
+    assert "silent" in idents
+    assert not any(i.startswith("handled") for i in idents)
+
+
+def test_fixed_ports_fixture(tmp_path):
+    # concatenation keeps this test file itself port-literal-free
+    root = _tree(tmp_path, {
+        "raft_tpu/mod.py":
+            'ADDR = ("127.0.0.1", ' + '8080)\nOK = ("127.0.0.1", 0)\n',
+        "tests/test_mod.py": "PORT = dict(port" + "=9090)\n",
+    })
+    report = _run(root, "no-fixed-ports", tmp_path)
+    assert len(report.findings) == 2
+    assert {f.path for f in report.findings} == {
+        "raft_tpu/mod.py", "tests/test_mod.py"}
+
+
+def test_pallas_parity_registration_fixture(tmp_path):
+    kern = """\
+        from jax.experimental import pallas as pl
+
+        def kernel(ref, out):
+            out[...] = ref[...]
+
+        def run(x):
+            return pl.pallas_call(kernel, out_shape=x)(x)
+        """
+    root = _tree(tmp_path, {"raft_tpu/kern.py": kern})
+    idents = _idents(_run(root, "pallas-parity-registered", tmp_path))
+    assert "raft_tpu.kern" in idents
+    root2 = _tree(tmp_path / "fixed", {
+        "raft_tpu/kern.py": kern,
+        "tests/test_kern.py": """\
+            from raft_tpu.kern import run
+
+            def test_kern_parity():
+                assert run
+            """,
+    })
+    assert not _run(str(root2), "pallas-parity-registered",
+                    tmp_path).findings
+
+
+def test_batched_prep_registration_fixture(tmp_path):
+    driver = """\
+        def _prepare_design(d):
+            return d
+
+        def sweep(designs):
+            return [_prepare_design(d) for d in designs]
+        """
+    root = _tree(tmp_path, {"raft_tpu/driver.py": driver})
+    idents = _idents(_run(root, "batched-prep-registered", tmp_path))
+    assert "raft_tpu.driver" in idents
+    root2 = _tree(tmp_path / "fixed", {
+        "raft_tpu/driver.py": driver,
+        "tests/test_driver.py": """\
+            from raft_tpu.driver import sweep
+
+            def test_sweep_batched_parity():
+                assert sweep
+            """,
+    })
+    assert not _run(str(root2), "batched-prep-registered",
+                    tmp_path).findings
+
+
+def test_chaos_registration_fixture(tmp_path):
+    chaos = """\
+        FAULTS = ("prep_raise", "nan_lane", "replica_kill",
+                  "replica_slow", "conn_drop", "new_fault")
+        """
+    covered = """\
+        def test_faults():
+            for spec in ("prep_raise@1", "nan_lane@1", "replica_kill@1",
+                         "replica_slow@1", "conn_drop@1"):
+                assert spec
+        """
+    root = _tree(tmp_path, {"raft_tpu/chaos.py": chaos,
+                            "tests/test_chaos.py": covered})
+    idents = _idents(_run(root, "chaos-registered", tmp_path))
+    assert idents == {"new_fault"}
+    root2 = _tree(tmp_path / "fixed", {
+        "raft_tpu/chaos.py": chaos,
+        "tests/test_chaos.py": covered.replace(
+            '"conn_drop@1"', '"conn_drop@1", "new_fault@1"'),
+    })
+    assert not _run(str(root2), "chaos-registered", tmp_path).findings
+
+
+# ------------------------------------------------------ allowlist policy
+
+def test_reasonless_allowlist_entry_does_not_suppress(tmp_path):
+    root = _tree(tmp_path, {"raft_tpu/mod.py": """\
+        def silent():
+            try:
+                return 1
+            except Exception:
+                pass
+        """})
+    allow_dir = tmp_path / "allow"
+    allow_dir.mkdir()
+    (allow_dir / "no-bare-except.txt").write_text(
+        "raft_tpu/mod.py::silent\n")
+    project = ProjectModel(root)
+    report = run_rules(project, [rule_by_name("no-bare-except")],
+                       allowlist_dir=str(allow_dir))
+    # the finding still surfaces (no suppression without a reason) ...
+    assert any(f.ident == "silent" for f in report.findings)
+    # ... and the missing reason is itself a hygiene finding
+    _entries, problems = load_allowlist("no-bare-except",
+                                        str(allow_dir))
+    assert problems and "no reason" in problems[0].message
+    hyg = AllowlistHygiene(allowlist_dir=str(allow_dir))
+    assert any("no reason" in f.message for f in hyg.finalize(project))
+
+
+def test_reasoned_allowlist_entry_suppresses(tmp_path):
+    root = _tree(tmp_path, {"raft_tpu/mod.py": """\
+        def silent():
+            try:
+                return 1
+            except Exception:
+                pass
+        """})
+    allow_dir = tmp_path / "allow"
+    allow_dir.mkdir()
+    (allow_dir / "no-bare-except.txt").write_text(
+        "raft_tpu/mod.py::silent  # fixture: intentionally quiet\n")
+    report = run_rules(ProjectModel(root),
+                       [rule_by_name("no-bare-except")],
+                       allowlist_dir=str(allow_dir))
+    assert not report.findings
+    assert report.n_allowlisted == 1
+
+
+def test_stale_allowlist_entry_is_reported(tmp_path):
+    root = _tree(tmp_path, {"raft_tpu/mod.py": "X = 1\n"})
+    allow_dir = tmp_path / "allow"
+    allow_dir.mkdir()
+    (allow_dir / "no-bare-except.txt").write_text(
+        "raft_tpu/gone.py::nothing  # reason that outlived its finding\n")
+    report = run_rules(ProjectModel(root),
+                       [rule_by_name("no-bare-except")],
+                       allowlist_dir=str(allow_dir))
+    assert any("stale allowlist entry" in f.message
+               for f in report.findings)
+
+
+def test_repo_allowlist_entries_all_carry_reasons():
+    for rule in ALL_RULES:
+        _entries, problems = load_allowlist(rule.name)
+        assert not problems, "\n".join(str(p) for p in problems)
+
+
+# ------------------------------------------------------ CLI
+
+def test_cli_json_schema_and_exit_status():
+    out = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.analysis", "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0, out.stdout + out.stderr
+    doc = json.loads(out.stdout)
+    assert set(doc) == {"rules", "n_rules", "findings", "n_findings",
+                        "n_allowlisted", "ok"}
+    assert doc["ok"] is True and doc["n_findings"] == 0
+    assert doc["n_rules"] >= 9
+    assert doc["n_rules"] == len(doc["rules"])
+    for f in doc["findings"]:
+        assert set(f) == {"rule", "path", "line", "ident", "key",
+                          "message"}
+
+
+def test_cli_list_names_every_rule():
+    out = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.analysis", "--list"],
+        cwd=REPO, capture_output=True, text=True, timeout=60,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert out.returncode == 0
+    for rule in ALL_RULES:
+        assert rule.name in out.stdout

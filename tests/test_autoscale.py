@@ -252,3 +252,32 @@ def test_ring_growth_moves_only_new_replica_keys():
         else:
             stayed += 1
     assert moved > 0 and stayed > 0     # ~1/3 move, the rest are pinned
+
+
+def test_concurrent_steps_never_double_scale():
+    """The live loop and a direct caller (test/bench/operator poke) may
+    call ``step()`` at the same instant; the step lock (enforced by the
+    lock-discipline analyzer via ``_GUARDED_BY``) serializes them so
+    both can never observe "past cooldown" and double-act."""
+    clock, fleet = FakeClock(), FakeFleet(n=2)
+    a = _scaler(fleet, clock, high_water=4.0, max_replicas=8)
+    fleet.pressure = 8.0
+    a.step()                          # t=0: start the hysteresis clock
+    clock.tick(2.0)                   # t=2: sustained — next step acts
+    start = threading.Barrier(8)
+    decisions = []
+
+    def racer():
+        start.wait()
+        d = a.step()
+        if d is not None:
+            decisions.append(d)
+
+    threads = [threading.Thread(target=racer) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # exactly ONE racer wins; the rest land in the cooldown hold
+    assert len(decisions) == 1 and decisions[0]["action"] == "scale_out"
+    assert len(fleet.replicas) == 3

@@ -1,110 +1,16 @@
-"""Repo-wide batched-prep registration lint (AST-based, no imports
-executed).
+"""Shim over the ``batched-prep-registered`` framework rule.
 
-Every module under ``raft_tpu/`` that drives *multi-design* prep — it
-invokes the solo per-design prep family (``_prepare_design`` /
-``_prepare_design_point``) or defines the serve engine's sweep
-prep-ahead loop (``_sweep_prep_ahead_locked``) — must have a registered
-batched-parity test: some ``tests/*.py`` file that imports the module
-AND defines at least one ``test_*batched*`` function.  The batched
-traced prep path (RAFT_TPU_BATCHED_PREP, raft_tpu/batched_prep.py) only
-stays safe to flip on while every driver that could route designs
-through it is pinned to the solo path it replaces — this lint makes
-"wire a new sweep driver, skip the batched-parity test" a tier-1
-failure instead of a review judgement call.
+The prep-driver registration lint now lives in
+``raft_tpu/analysis/rules/legacy.py``; the rule still pins its own
+probe (the three shipped drivers must be found by the scan, else a
+stale-probe finding fires).  This file keeps the historical test name
+so tier-1 runs stay comparable across the migration — see
+docs/analysis.md.
 """
 
-import ast
-import os
-
-ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PKG = os.path.join(ROOT, "raft_tpu")
-TESTS = os.path.dirname(os.path.abspath(__file__))
-
-SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "node_modules",
-             ".claude"}
-
-# the solo per-design prep entry points; a module *calling* one of
-# these on a multi-design path must hold batched parity
-SOLO_PREP_CALLS = {"_prepare_design", "_prepare_design_point"}
-# the serve engine preps sweeps through its own worker loop rather
-# than by calling the solo family by name
-PREP_LOOP_DEFS = {"_sweep_prep_ahead_locked"}
-
-
-def _iter_py_files(root):
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if d not in SKIP_DIRS]
-        for name in filenames:
-            if name.endswith(".py"):
-                yield os.path.join(dirpath, name)
-
-
-def _drives_multi_design_prep(tree):
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Call):
-            fn = node.func
-            name = fn.attr if isinstance(fn, ast.Attribute) else (
-                fn.id if isinstance(fn, ast.Name) else "")
-            if name in SOLO_PREP_CALLS:
-                return True
-        elif isinstance(node, ast.FunctionDef) \
-                and node.name in PREP_LOOP_DEFS:
-            return True
-    return False
-
-
-def _prep_driver_modules():
-    """Dotted module names under raft_tpu/ whose AST calls the solo
-    prep family or defines a sweep prep-ahead loop."""
-    mods = []
-    for path in _iter_py_files(PKG):
-        with open(path, encoding="utf-8") as fh:
-            tree = ast.parse(fh.read(), filename=path)
-        if _drives_multi_design_prep(tree):
-            rel = os.path.relpath(path, ROOT)
-            mods.append(rel[:-3].replace(os.sep, "."))
-    return mods
-
-
-def _test_registry():
-    """(imported modules, batched-test names) per tests/*.py file."""
-    registry = []
-    for path in _iter_py_files(TESTS):
-        with open(path, encoding="utf-8") as fh:
-            tree = ast.parse(fh.read(), filename=path)
-        imports = set()
-        batched_tests = []
-        for node in ast.walk(tree):
-            if isinstance(node, ast.ImportFrom) and node.module:
-                imports.add(node.module)
-            elif isinstance(node, ast.Import):
-                imports.update(a.name for a in node.names)
-            elif isinstance(node, ast.FunctionDef) \
-                    and node.name.startswith("test_") \
-                    and "batched" in node.name:
-                batched_tests.append(node.name)
-        registry.append((os.path.basename(path), imports, batched_tests))
-    return registry
+from raft_tpu.analysis import analyze, rule_by_name
 
 
 def test_every_prep_driver_module_has_a_batched_parity_test():
-    mods = _prep_driver_modules()
-    # the three shipped drivers exist and are found by the scan (the
-    # lint must not silently pass because the AST probe went stale)
-    for expected in ("raft_tpu.sweep", "raft_tpu.sweep_fused",
-                     "raft_tpu.serve.engine"):
-        assert expected in mods, expected
-    registry = _test_registry()
-    missing = []
-    for mod in mods:
-        covered = any(
-            mod in imports and batched_tests
-            for _, imports, batched_tests in registry
-        )
-        if not covered:
-            missing.append(mod)
-    assert not missing, (
-        "Multi-design prep drivers without a registered batched-parity "
-        f"test (add a test_*batched* importing the module): {missing}"
-    )
+    report = analyze(rules=[rule_by_name("batched-prep-registered")])
+    assert report.ok, "\n".join(str(f) for f in report.findings)

@@ -202,6 +202,17 @@ def test_serve_load_section_pinned_in_compact_schema():
         assert key in bench._COMPACT_KEYS, key
 
 
+def test_analysis_section_pinned_in_compact_schema():
+    """The static-analysis gate (docs/analysis.md) stays wired: the
+    entry point exists and the rule/finding counts ride the compact
+    driver line so a round that regresses the lint surface is visible
+    in the recorded tail, not just in BENCH_FULL.json."""
+    assert callable(bench.bench_analysis)
+    for key in ("analysis_rules", "analysis_findings",
+                "analysis_allowlisted", "analysis_error"):
+        assert key in bench._COMPACT_KEYS, key
+
+
 def test_sanitizer_covers_serve_http_values():
     out = {
         "serve_http_overhead_ms": 1.66,

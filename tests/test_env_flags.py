@@ -1,0 +1,157 @@
+"""Env-flag plumbing tests (the flag-hygiene analyzer's "tested" leg).
+
+The ``flag-hygiene`` rule (raft_tpu/analysis/rules/flags.py) requires
+every ``RAFT_TPU_*`` flag to be exercised by at least one test — env
+plumbing without a test is how a renamed flag silently becomes a no-op.
+This file covers the flags whose read sites have no natural home in an
+existing behavioral test: the import-time JAX switches, the serve CLI's
+env defaults, and the small numeric knobs.  Flags already exercised
+elsewhere (RAFT_TPU_PALLAS, RAFT_TPU_CHAOS, RAFT_TPU_AUTOSCALE_*, ...)
+stay with their behavioral tests.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+import raft_tpu.__main__ as rt_main
+from raft_tpu import waterfall
+from raft_tpu.serve import buckets
+from raft_tpu.serve.engine import EngineConfig
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------- import-time switches
+
+def test_no_x64_and_no_compile_cache_import_switches():
+    """RAFT_TPU_NO_X64 / RAFT_TPU_NO_COMPILE_CACHE gate the import-time
+    JAX config writes — observable only in a fresh interpreter."""
+    env = {k: v for k, v in os.environ.items()
+           if k != "JAX_COMPILATION_CACHE_DIR"}
+    env.update({"JAX_PLATFORMS": "cpu", "RAFT_TPU_NO_X64": "1",
+                "RAFT_TPU_NO_COMPILE_CACHE": "1"})
+    script = (
+        "import raft_tpu\n"
+        "from jax import config\n"
+        "assert config.jax_enable_x64 is False, 'NO_X64 ignored'\n"
+        "assert config.jax_compilation_cache_dir is None, "
+        "'NO_COMPILE_CACHE ignored'\n"
+        "print('ok')\n")
+    out = subprocess.run([sys.executable, "-c", script], env=env,
+                         cwd=REPO, capture_output=True, text=True,
+                         timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "ok" in out.stdout
+
+
+# ------------------------------------------------- serve CLI env defaults
+
+class _Abort(Exception):
+    """Sentinel: the CLI reached the captured call with env-derived
+    arguments; no server is actually started."""
+
+
+def test_serve_http_port_env_default(monkeypatch):
+    captured = {}
+
+    def fake_serve_http_main(args, http_port):
+        captured["port"] = http_port
+        raise _Abort
+
+    monkeypatch.setattr(rt_main, "_serve_http_main", fake_serve_http_main)
+    monkeypatch.setenv("RAFT_TPU_SERVE_HTTP_PORT", "0")
+    with pytest.raises(_Abort):
+        rt_main.main(["serve"])
+    assert captured["port"] == 0
+
+
+def test_serve_shared_cache_env_default(monkeypatch, tmp_path):
+    captured = {}
+
+    def fake_serve_http_main(args, http_port):
+        captured["cache_dir"] = args.cache_dir
+        raise _Abort
+
+    monkeypatch.setattr(rt_main, "_serve_http_main", fake_serve_http_main)
+    monkeypatch.setenv("RAFT_TPU_SERVE_HTTP_PORT", "0")
+    monkeypatch.setenv("RAFT_TPU_SERVE_SHARED_CACHE", str(tmp_path))
+    with pytest.raises(_Abort):
+        rt_main.main(["serve"])
+    assert captured["cache_dir"] == str(tmp_path)
+
+
+def test_serve_replicas_env_default(monkeypatch):
+    import raft_tpu.serve as serve_pkg
+
+    captured = {}
+
+    def fake_router(**kw):
+        captured.update(kw)
+        raise _Abort
+
+    monkeypatch.setattr(serve_pkg, "Router", fake_router)
+    monkeypatch.setenv("RAFT_TPU_SERVE_REPLICAS", "2")
+    with pytest.raises(_Abort):
+        rt_main.main(["serve", "--http", "0"])
+    assert captured["n_replicas"] == 2
+
+
+def test_autoscale_env_enables_policy_loop(monkeypatch):
+    """RAFT_TPU_AUTOSCALE=1 makes a spawn-mode Router start the
+    autoscaler; replica spawn and the policy loop are stubbed so the
+    test exercises only the env plumbing."""
+    import raft_tpu.serve.autoscale as autoscale_mod
+    import raft_tpu.serve.router as router_mod
+
+    class FakeReplica:
+        def __init__(self, rid):
+            self.id, self.port = rid, 0
+
+    started = []
+
+    class FakeAutoscaler:
+        def __init__(self, fleet, config=None, **kw):
+            self.fleet = fleet
+
+        def start(self):
+            started.append(self)
+            return self
+
+    monkeypatch.setattr(router_mod, "spawn_replica",
+                        lambda rid, **kw: FakeReplica(rid))
+    monkeypatch.setattr(autoscale_mod, "Autoscaler", FakeAutoscaler)
+    monkeypatch.setenv("RAFT_TPU_AUTOSCALE", "1")
+    router = router_mod.Router(n_replicas=1)
+    try:
+        assert isinstance(router.autoscaler, FakeAutoscaler)
+        assert started == [router.autoscaler]
+    finally:
+        router._pool.shutdown(wait=False)
+
+
+# ------------------------------------------------- numeric knobs
+
+def test_serve_lane_block_env(monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_SERVE_LANE_BLOCK", "16")
+    assert buckets.lane_block() == 16
+    monkeypatch.setenv("RAFT_TPU_SERVE_LANE_BLOCK", "not-a-number")
+    assert buckets.lane_block() == buckets.DEFAULT_LANE_BLOCK
+    monkeypatch.setenv("RAFT_TPU_SERVE_LANE_BLOCK", "-3")
+    assert buckets.lane_block() == 1       # clamped to a sane floor
+
+
+def test_fixed_point_block_env(monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_FIXED_POINT_BLOCK", "7")
+    assert waterfall.block_iters() == 7
+    monkeypatch.setenv("RAFT_TPU_FIXED_POINT_BLOCK", "junk")
+    assert waterfall.block_iters() == waterfall.DEFAULT_BLOCK_ITERS
+
+
+def test_serve_preempt_env(monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_SERVE_PREEMPT", "1")
+    assert EngineConfig().preempt is True
+    monkeypatch.setenv("RAFT_TPU_SERVE_PREEMPT", "")
+    assert EngineConfig().preempt is False

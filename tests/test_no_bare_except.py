@@ -1,202 +1,17 @@
-"""Repo-wide exception-handling lint (AST-based, no imports executed).
+"""Shim over the ``no-bare-except`` framework rule.
 
-Two rules, enforced over every ``*.py`` in the repository:
-
- 1. no bare ``except:`` — ever (it swallows KeyboardInterrupt/SystemExit
-    and hides the fault envelope's own signals);
- 2. every ``except Exception`` / ``except BaseException`` handler must
-    DO something with the fault: re-raise, log it, print it, assert,
-    or record a failure status (assign/return something derived from
-    the exception or into an error/status-named target).  Silent
-    broad catches are how production fault envelopes rot.
-
-Intentional silent handlers go in ``tests/bare_except_allowlist.txt``
-(one ``relpath::qualname`` per line) with a comment saying why.
+The exception-handling lint now lives in
+``raft_tpu/analysis/rules/legacy.py`` (same detection logic, same
+``path::qualname`` allowlist keys); intentional silent handlers moved
+from ``tests/bare_except_allowlist.txt`` to
+``raft_tpu/analysis/allowlists/no-bare-except.txt`` (reasons now
+REQUIRED).  This file keeps the historical test name so tier-1 runs
+stay comparable across the migration — see docs/analysis.md.
 """
 
-import ast
-import os
-
-ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-ALLOWLIST_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                              "bare_except_allowlist.txt")
-
-SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "node_modules",
-             ".claude"}
-
-# a call to any of these attribute/function names counts as handling
-LOG_NAMES = {
-    "print", "warn", "warning", "error", "exception", "info", "debug",
-    "log", "critical", "fail", "skip", "xfail",
-}
-# an assignment/subscript target whose name contains one of these counts
-# as recording a failure status
-RECORD_MARKERS = ("error", "fail", "status", "reason", "exc", "bad",
-                  "corrupt", "reject", "quarantine", "msg")
-
-
-def _allowlist():
-    allowed = set()
-    if os.path.exists(ALLOWLIST_PATH):
-        with open(ALLOWLIST_PATH) as fh:
-            for line in fh:
-                line = line.split("#", 1)[0].strip()
-                if line:
-                    allowed.add(line)
-    return allowed
-
-
-def _iter_py_files():
-    for dirpath, dirnames, filenames in os.walk(ROOT):
-        dirnames[:] = [d for d in dirnames if d not in SKIP_DIRS]
-        for name in filenames:
-            if name.endswith(".py"):
-                yield os.path.join(dirpath, name)
-
-
-def _names_in(node):
-    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
-
-
-def _call_name(call):
-    fn = call.func
-    if isinstance(fn, ast.Attribute):
-        return fn.attr
-    if isinstance(fn, ast.Name):
-        return fn.id
-    return ""
-
-
-def _target_marks_failure(target):
-    if isinstance(target, ast.Name):
-        name = target.id.lower()
-    elif isinstance(target, ast.Attribute):
-        name = target.attr.lower()
-    elif isinstance(target, ast.Subscript):
-        name = ""
-        if isinstance(target.slice, ast.Constant) \
-                and isinstance(target.slice.value, str):
-            name = target.slice.value.lower()
-        base = target.value
-        if isinstance(base, ast.Name):
-            name += " " + base.id.lower()
-        elif isinstance(base, ast.Attribute):
-            name += " " + base.attr.lower()
-    else:
-        return False
-    return any(m in name for m in RECORD_MARKERS)
-
-
-def _handler_handles(handler):
-    """Whether an ``except Exception`` body re-raises, logs, or records
-    the failure."""
-    exc_name = handler.name
-    for node in ast.walk(handler):
-        if isinstance(node, ast.Raise):
-            return True
-        if isinstance(node, ast.Assert):
-            return True
-        if isinstance(node, ast.Call):
-            if _call_name(node) in LOG_NAMES:
-                return True
-            # e.g. pend._set(RequestResult(status="failed", error=...))
-            if any(kw.arg in ("error", "status") for kw in node.keywords):
-                return True
-            # e.g. errors.append(e) — the exception is captured somewhere
-            if exc_name and any(exc_name in _names_in(a)
-                                for a in node.args):
-                return True
-        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
-            targets = (node.targets
-                       if isinstance(node, ast.Assign) else [node.target])
-            if any(_target_marks_failure(t) for t in targets):
-                return True
-            if exc_name and exc_name in _names_in(node):
-                return True
-        if isinstance(node, (ast.Return, ast.Yield)) \
-                and node.value is not None:
-            if exc_name and exc_name in _names_in(node.value):
-                return True
-    return False
-
-
-def _qualname_of(tree, lineno):
-    """Innermost enclosing function/class qualname for a line."""
-    best = "<module>"
-    best_span = None
-
-    def visit(node, prefix):
-        nonlocal best, best_span
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                  ast.ClassDef)):
-                end = getattr(child, "end_lineno", child.lineno)
-                qual = (prefix + "." + child.name).lstrip(".")
-                if child.lineno <= lineno <= end:
-                    span = end - child.lineno
-                    if best_span is None or span <= best_span:
-                        best, best_span = qual, span
-                    visit(child, qual)
-            else:
-                visit(child, prefix)
-
-    visit(tree, "")
-    return best
-
-
-def _broad_type(handler):
-    """'bare', 'broad' (Exception/BaseException, alone or in a tuple),
-    or None."""
-    if handler.type is None:
-        return "bare"
-    types = (handler.type.elts if isinstance(handler.type, ast.Tuple)
-             else [handler.type])
-    for t in types:
-        name = t.id if isinstance(t, ast.Name) else (
-            t.attr if isinstance(t, ast.Attribute) else "")
-        if name in ("Exception", "BaseException"):
-            return "broad"
-    return None
+from raft_tpu.analysis import analyze, rule_by_name
 
 
 def test_no_bare_except_and_no_silent_broad_handlers():
-    allowed = _allowlist()
-    violations = []
-    used = set()
-    for path in _iter_py_files():
-        rel = os.path.relpath(path, ROOT)
-        with open(path, "rb") as fh:
-            try:
-                tree = ast.parse(fh.read(), filename=rel)
-            except SyntaxError as e:
-                violations.append(f"{rel}: unparseable ({e})")
-                continue
-        for node in ast.walk(tree):
-            if not isinstance(node, ast.ExceptHandler):
-                continue
-            kind = _broad_type(node)
-            if kind is None:
-                continue
-            key = f"{rel}::{_qualname_of(tree, node.lineno)}"
-            if kind == "bare":
-                # bare except is never allowlistable
-                violations.append(
-                    f"{rel}:{node.lineno}: bare `except:` — catch a "
-                    "class, at minimum `except Exception` with "
-                    "handling")
-                continue
-            if _handler_handles(node):
-                continue
-            if key in allowed:
-                used.add(key)
-                continue
-            violations.append(
-                f"{rel}:{node.lineno}: `except Exception` handler in "
-                f"{key.split('::')[1]} neither raises, logs, nor "
-                "records a failure status (allowlist as "
-                f"'{key}' only if the silence is intentional)")
-    assert not violations, "\n".join(violations)
-    stale = allowed - used
-    assert not stale, (
-        "bare_except_allowlist.txt entries no longer needed: "
-        f"{sorted(stale)}")
+    report = analyze(rules=[rule_by_name("no-bare-except")])
+    assert report.ok, "\n".join(str(f) for f in report.findings)

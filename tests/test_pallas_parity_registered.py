@@ -1,94 +1,16 @@
-"""Repo-wide Pallas kernel registration lint (AST-based, no imports
-executed).
+"""Shim over the ``pallas-parity-registered`` framework rule.
 
-Every module under ``raft_tpu/`` that invokes ``pallas_call`` (i.e.
-defines a hand-written kernel) must have a registered reference-parity
-test: some ``tests/*.py`` file that imports from the module AND defines
-at least one ``test_*parity*`` function.  Hand kernels only stay safe
-to ship while an interpret-mode parity test pins them to the XLA
-reference path they replace — this lint makes "add a kernel, skip the
-parity test" a tier-1 failure instead of a review judgement call.
+The kernel-registration lint now lives in
+``raft_tpu/analysis/rules/legacy.py``; the rule still pins its own
+probe (``raft_tpu.pallas_kernels`` must be found by the ``pallas_call``
+scan, else a stale-probe finding fires).  This file keeps the
+historical test name so tier-1 runs stay comparable across the
+migration — see docs/analysis.md.
 """
 
-import ast
-import os
-
-ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PKG = os.path.join(ROOT, "raft_tpu")
-TESTS = os.path.dirname(os.path.abspath(__file__))
-
-SKIP_DIRS = {".git", "__pycache__", ".pytest_cache", "node_modules",
-             ".claude"}
-
-
-def _iter_py_files(root):
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if d not in SKIP_DIRS]
-        for name in filenames:
-            if name.endswith(".py"):
-                yield os.path.join(dirpath, name)
-
-
-def _calls_pallas_call(tree):
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Call):
-            fn = node.func
-            name = fn.attr if isinstance(fn, ast.Attribute) else (
-                fn.id if isinstance(fn, ast.Name) else "")
-            if name == "pallas_call":
-                return True
-    return False
-
-
-def _kernel_modules():
-    """Dotted module names under raft_tpu/ whose AST contains a
-    ``pallas_call`` invocation."""
-    mods = []
-    for path in _iter_py_files(PKG):
-        with open(path, encoding="utf-8") as fh:
-            tree = ast.parse(fh.read(), filename=path)
-        if _calls_pallas_call(tree):
-            rel = os.path.relpath(path, ROOT)
-            mods.append(rel[:-3].replace(os.sep, "."))
-    return mods
-
-
-def _test_registry():
-    """(imported modules, parity-test names) per tests/*.py file."""
-    registry = []
-    for path in _iter_py_files(TESTS):
-        with open(path, encoding="utf-8") as fh:
-            tree = ast.parse(fh.read(), filename=path)
-        imports = set()
-        parity_tests = []
-        for node in ast.walk(tree):
-            if isinstance(node, ast.ImportFrom) and node.module:
-                imports.add(node.module)
-            elif isinstance(node, ast.Import):
-                imports.update(a.name for a in node.names)
-            elif isinstance(node, ast.FunctionDef) \
-                    and node.name.startswith("test_") \
-                    and "parity" in node.name:
-                parity_tests.append(node.name)
-        registry.append((os.path.basename(path), imports, parity_tests))
-    return registry
+from raft_tpu.analysis import analyze, rule_by_name
 
 
 def test_every_pallas_kernel_module_has_a_parity_test():
-    mods = _kernel_modules()
-    # the solve-core kernel module exists and is found by the scan (the
-    # lint must not silently pass because the AST probe went stale)
-    assert "raft_tpu.pallas_kernels" in mods
-    registry = _test_registry()
-    missing = []
-    for mod in mods:
-        covered = any(
-            mod in imports and parity_tests
-            for _, imports, parity_tests in registry
-        )
-        if not covered:
-            missing.append(mod)
-    assert not missing, (
-        "Pallas kernel modules without a registered reference-parity "
-        f"test (add a test_*parity* importing from the module): {missing}"
-    )
+    report = analyze(rules=[rule_by_name("pallas-parity-registered")])
+    assert report.ok, "\n".join(str(f) for f in report.findings)

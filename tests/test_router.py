@@ -45,6 +45,7 @@ from raft_tpu.serve import (
     spawn_replica,
     wire,
 )
+from raft_tpu.serve.router import Replica
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 NW = (0.05, 0.5)    # small frequency grid keeps compiles cheap
@@ -333,3 +334,83 @@ def test_router_sigterm_terminal_status_for_every_accepted_rid(
         assert ev["status"] in TERMINAL_STATUSES
     shutdown = [ln for ln in lines if '"shutdown"' in ln]
     assert shutdown and json.loads(shutdown[0])["signal"] == 15
+
+
+# --------------------------- unit: router shared-state lock regressions
+
+def _attached_router(n=2):
+    """Attach-mode router over just-freed ports: nothing listens, no
+    subprocess is spawned, and shutdown never signals a process —
+    enough surface to exercise the router's own shared state."""
+    endpoints = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        endpoints.append(("127.0.0.1", s.getsockname()[1]))
+        s.close()
+    return Router(endpoints=endpoints)
+
+
+def test_retire_candidate_snapshots_replicas_under_lock():
+    """retire_candidate runs on the autoscaler thread while scale-out/
+    reap mutate the replica dict on others; the locked snapshot
+    (enforced by the lock-discipline analyzer) means concurrent
+    mutation can never blow up the scan with 'dict changed size'."""
+    router = _attached_router(n=3)
+    try:
+        stop = threading.Event()
+        errors = []
+
+        def churn():
+            i = 0
+            while not stop.is_set():
+                i += 1
+                rid = f"x{i % 7}"
+                with router._lock:
+                    if rid in router.replicas:
+                        del router.replicas[rid]
+                    else:
+                        router.replicas[rid] = Replica(
+                            rid, "127.0.0.1", 0)
+
+        threads = [threading.Thread(target=churn) for _ in range(3)]
+        for t in threads:
+            t.start()
+        try:
+            for _ in range(300):
+                try:
+                    router.retire_candidate()
+                except RuntimeError as e:   # pragma: no cover — the bug
+                    errors.append(e)
+                    break
+        finally:
+            stop.set()
+            for t in threads:
+                t.join()
+        assert not errors, errors
+    finally:
+        router.shutdown(wait=False)
+
+
+def test_shutdown_resolved_stat_survives_concurrent_bumps():
+    """shutdown's shutdown_resolved accounting happens under the router
+    lock (lock-discipline regression): concurrent locked bumps from a
+    forwarding thread and shutdown's own tally must both land."""
+    from raft_tpu.serve.engine import _Pending
+
+    router = _attached_router(n=1)
+    n_outstanding, n_bumps = 7, 500
+    with router._lock:
+        for rid in range(n_outstanding):
+            router._outstanding[rid] = _Pending(rid)
+
+    def bumper():
+        for _ in range(n_bumps):
+            with router._lock:
+                router.stats["shutdown_resolved"] += 1
+
+    t = threading.Thread(target=bumper)
+    t.start()
+    router.shutdown(wait=True)
+    t.join()
+    assert router.stats["shutdown_resolved"] == n_outstanding + n_bumps
