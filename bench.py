@@ -106,6 +106,10 @@ _COMPACT_KEYS = (
     "kernel_gjstage_max_abs_diff",
     "serve_load_goodput", "serve_load_chaos_goodput",
     "serve_load_lost", "serve_load_heals",
+    "serve_load_engine_p50_ms", "serve_load_engine_p95_ms",
+    "serve_load_engine_p99_ms",
+    "serve_obs_overhead_pct", "serve_obs_p50_on_ms",
+    "serve_obs_p50_off_ms",
     "smoke_load_goodput", "smoke_load_bits",
     "sweep_cold_start_s", "sweep_warm_start_s", "sweep_warm_vs_cold",
     "sweep_prep_wall_s", "sweep_prep_solo_wall_s", "sweep_prep_batched",
@@ -118,6 +122,7 @@ _COMPACT_KEYS = (
     "serve_http_error", "serve_http_smoke_error",
     "serve_sweep_error", "serve_sweep_smoke_error",
     "serve_load_error", "serve_load_smoke_error",
+    "serve_obs_error",
     "sweep_waterfall_error",
     "perf_docs_error", "sweep_scaling_error", "sweep1024_error",
     "sweep4096_error", "serve_multichip_error", "multichip_smoke_error",
@@ -461,6 +466,7 @@ def main(argv=None):
             ("serve_http", bench_serve_http, 6.0),
             ("serve_sweep", bench_serve_sweep, 8.0),
             ("serve_load", bench_serve_load, 6.0),
+            ("serve_obs", bench_serve_obs_overhead, 2.0),
             ("serve_multichip", bench_serve_multichip, 0.5),
             ("kernel", bench_kernels, 0.5),
             ("sweep_warm", bench_sweep_warm, 4.0),
@@ -1372,6 +1378,11 @@ def bench_chaos_smoke():
 
 # ------------------------------------------------------ open-loop load
 
+def _q_ms(q_s):
+    """Quantile seconds -> rounded ms (None stays None)."""
+    return round(q_s * 1000.0, 3) if q_s is not None else None
+
+
 def bench_serve_load_smoke():
     """Tier-1-safe load-harness smoke: a short open-loop Poisson burst
     against a 2-replica router with ONE replica SIGKILLed mid-run — the
@@ -1476,6 +1487,30 @@ def bench_serve_load():
             stats = dict(router.stats)
             decisions = (router.autoscaler.snapshot()["decisions"]
                          if router.autoscaler else [])
+            # engine-side latency histogram, merged bucket-wise across
+            # the replicas that survived the phases: the server-observed
+            # quantiles next to the loadgen-observed ones (the gap is
+            # wire + router overhead)
+            from raft_tpu.obs.metrics import (LATENCY_BUCKETS_S,
+                                              quantile_from_counts)
+            eng_counts = [0] * (len(LATENCY_BUCKETS_S) + 1)
+            scrape_failed = 0
+            for rid in list(router.replicas):
+                rep = router.replicas.get(rid)
+                if rep is None:
+                    continue
+                try:
+                    code, sdoc = rep.client.get("/statz", timeout=10.0)
+                except Exception:  # noqa: BLE001 — dead replica
+                    scrape_failed += 1
+                    continue
+                hv = ((sdoc.get("metrics") or {}).get(
+                    "raft_tpu_engine_request_latency_seconds") or {}
+                ).get("value") if code == 200 else None
+                for i, c in enumerate((hv or {}).get("buckets") or []):
+                    eng_counts[i] += int(c)
+            eng_q = {q: quantile_from_counts(eng_counts, q)
+                     for q in (0.5, 0.95, 0.99)}
         finally:
             router.shutdown()
     phases = {"normal": normal, "overload": overload, "chaos": chaos}
@@ -1498,6 +1533,11 @@ def bench_serve_load():
         "serve_load_p50_ms": normal["p50_ms"],
         "serve_load_p95_ms": normal["p95_ms"],
         "serve_load_p99_ms": normal["p99_ms"],
+        "serve_load_engine_p50_ms": _q_ms(eng_q[0.5]),
+        "serve_load_engine_p95_ms": _q_ms(eng_q[0.95]),
+        "serve_load_engine_p99_ms": _q_ms(eng_q[0.99]),
+        "serve_load_scrape_failed": scrape_failed,
+        "serve_load_slowest_trace_id": normal.get("slowest_trace_id"),
         "serve_load_overload_goodput": overload["goodput"],
         "serve_load_overload_rejected": sum(rejections.values()),
         "serve_load_chaos_goodput": chaos["goodput"],
@@ -1507,6 +1547,59 @@ def bench_serve_load():
                                 if d["action"] == "heal"),
         "serve_load_decisions": decisions,
         "serve_load_s": round(time.perf_counter() - t0, 3),
+    }
+
+
+def bench_serve_obs_overhead(n_requests=30):
+    """Instrumentation A/B (docs/observability.md): the served solo
+    warm p50 with span recording ON vs ``RAFT_TPU_OBS_SPANS=0``.  The
+    observability layer's budget on the hot path is <= 2% of served
+    solo p50; the recorded ``serve_obs_overhead_pct`` is the evidence
+    (metrics and trace-id propagation stay on in BOTH legs — the A/B
+    isolates the per-stage span recording)."""
+    import tempfile
+
+    from raft_tpu.designs import deep_spar
+    from raft_tpu.serve import Engine, EngineConfig
+
+    t0 = time.perf_counter()
+    design = deep_spar(n_cases=2, nw_settings=(0.05, 0.5))
+
+    def leg(eng, env_val):
+        prior = os.environ.pop("RAFT_TPU_OBS_SPANS", None)
+        if env_val is not None:
+            os.environ["RAFT_TPU_OBS_SPANS"] = env_val
+        lats = []
+        try:
+            for _ in range(n_requests):
+                t = time.perf_counter()
+                r = eng.evaluate(design, timeout=560)
+                assert r.status == "ok", r.error
+                lats.append(time.perf_counter() - t)
+        finally:
+            if prior is None:
+                os.environ.pop("RAFT_TPU_OBS_SPANS", None)
+            else:
+                os.environ["RAFT_TPU_OBS_SPANS"] = prior
+        lats.sort()
+        return lats[len(lats) // 2]
+
+    with tempfile.TemporaryDirectory() as tmp:
+        with Engine(EngineConfig(precision="float64", window_ms=5.0,
+                                 cache_dir=tmp)) as eng:
+            warm = eng.evaluate(design, timeout=560)
+            assert warm.status == "ok", warm.error
+            # off leg first, then on: a drifting machine biases AGAINST
+            # the instrumented leg, never for it
+            p50_off = leg(eng, "0")
+            p50_on = leg(eng, None)
+    return {
+        "serve_obs_p50_on_ms": round(p50_on * 1000.0, 3),
+        "serve_obs_p50_off_ms": round(p50_off * 1000.0, 3),
+        "serve_obs_overhead_pct": round(
+            100.0 * (p50_on - p50_off) / p50_off, 2),
+        "serve_obs_n_requests": n_requests,
+        "serve_obs_s": round(time.perf_counter() - t0, 3),
     }
 
 

@@ -9,6 +9,7 @@ this list, so registration is the only step.
 from raft_tpu.analysis.rules.purity import TracedPurity
 from raft_tpu.analysis.rules.locks import LockDiscipline
 from raft_tpu.analysis.rules.flags import FlagHygiene
+from raft_tpu.analysis.rules.metrics import MetricsHygiene
 from raft_tpu.analysis.rules.hygiene import AllowlistHygiene
 from raft_tpu.analysis.rules.legacy import (
     BareExcept, FixedPorts, PallasParityRegistered,
@@ -18,6 +19,7 @@ ALL_RULES = [
     TracedPurity(),
     LockDiscipline(),
     FlagHygiene(),
+    MetricsHygiene(),
     BareExcept(),
     FixedPorts(),
     PallasParityRegistered(),

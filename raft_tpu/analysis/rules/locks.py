@@ -126,7 +126,9 @@ class LockDiscipline(Rule):
 
     name = "lock-discipline"
     scope = ("raft_tpu/serve/engine.py", "raft_tpu/serve/router.py",
-             "raft_tpu/serve/autoscale.py", "raft_tpu/resilience.py")
+             "raft_tpu/serve/autoscale.py", "raft_tpu/resilience.py",
+             "raft_tpu/obs/metrics.py", "raft_tpu/obs/tracing.py",
+             "raft_tpu/obs/profiler.py")
     describe = ("writes to _GUARDED_BY attributes hold the owning "
                 "lock; _LOCK_FREE readers never lock or write")
 

@@ -250,6 +250,7 @@ def run_phase(backend, config, design, name="load", chaos=None,
     lost = 0
     ok_lat = []
     canary_bits = []
+    slowest = None       # (latency_s, trace_id) of the slowest ok req
     for fl in flights:
         if fl.handle is None:
             statuses["refused"] = statuses.get("refused", 0) + 1
@@ -264,7 +265,13 @@ def run_phase(backend, config, design, name="load", chaos=None,
         status = getattr(res, "status", None) or "unknown"
         statuses[status] = statuses.get(status, 0) + 1
         if status == "ok":
-            ok_lat.append(float(getattr(res, "latency_s", 0.0)))
+            lat = float(getattr(res, "latency_s", 0.0))
+            ok_lat.append(lat)
+            # keep the slowest ok request's trace_id so the operator
+            # can gather_trace the phase's tail latency straight off
+            # the report (docs/observability.md)
+            if slowest is None or lat > slowest[0]:
+                slowest = (lat, getattr(res, "trace_id", None))
             if fl.canary and getattr(res, "Xi", None) is not None:
                 canary_bits.append(np.asarray(res.Xi))
     if chaos is not None:
@@ -301,6 +308,8 @@ def run_phase(backend, config, design, name="load", chaos=None,
         if len(lat_ms) else None,
         "canaries_ok": len(canary_bits),
         "bits_identical": bits,
+        "slowest_latency_s": round(slowest[0], 6) if slowest else None,
+        "slowest_trace_id": slowest[1] if slowest else None,
     }
     if chaos_fires is not None:
         report["chaos"] = chaos_fires

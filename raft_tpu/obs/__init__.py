@@ -1,0 +1,30 @@
+"""Observability layer for the serve stack (docs/observability.md).
+
+Three pieces, each importable on its own:
+
+* :mod:`raft_tpu.obs.metrics` — a process-local metrics registry
+  (Counter / Gauge / Histogram with fixed log-spaced latency buckets)
+  with streaming quantiles and a Prometheus text exposition; the
+  engine's / router's legacy ``stats`` dicts are compatibility views
+  over it (:class:`~raft_tpu.obs.metrics.StatsView`).
+* :mod:`raft_tpu.obs.tracing` — cross-process request tracing: a
+  :class:`~raft_tpu.obs.tracing.TraceContext` minted at ingress rides
+  the wire schema, and per-stage spans land in a bounded
+  :class:`~raft_tpu.obs.tracing.SpanRing` served by ``GET /tracez``.
+* :mod:`raft_tpu.obs.profiler` — on-demand ``jax.profiler`` capture
+  armed by ``POST /profilez`` (or ``RAFT_TPU_PROFILE_DIR`` for the
+  non-serve sweep drivers), wrapping the next dispatch window and
+  recording device memory stats + the waterfall flops ledger alongside.
+"""
+
+from raft_tpu.obs.metrics import (LATENCY_BUCKETS_S, Counter, Gauge,
+                                  Histogram, MetricsRegistry, StatsView)
+from raft_tpu.obs.tracing import (SpanRing, TraceContext, span,
+                                  spans_enabled)
+from raft_tpu.obs.profiler import ProfilerHook, profile_dir_from_env
+
+__all__ = [
+    "LATENCY_BUCKETS_S", "Counter", "Gauge", "Histogram",
+    "MetricsRegistry", "StatsView", "SpanRing", "TraceContext", "span",
+    "spans_enabled", "ProfilerHook", "profile_dir_from_env",
+]

@@ -1,0 +1,191 @@
+"""On-demand ``jax.profiler`` capture around one dispatch window.
+
+Two arming paths, one capture:
+
+* **Serve path** — ``POST /profilez`` (serve/transport.py) arms the
+  engine's :class:`ProfilerHook`; the NEXT ``_dispatch_guarded`` device
+  call runs under ``jax.profiler.start_trace``/``stop_trace`` and the
+  hook records device memory stats plus the waterfall executed-flops
+  ledger alongside (``capture.json`` in the log dir) — the MFU and
+  fallback-attribution evidence the next TPU round needs, without
+  re-running anything.
+* **Non-serve path** — ``RAFT_TPU_PROFILE_DIR=<dir>`` makes the first
+  ``waterfall_dispatch`` of the process capture itself the same way
+  (:func:`env_capture`), so the sweep drivers and bench sections get
+  the identical artifact with zero plumbing.
+
+Both paths are one-shot (arm → one window → disarm): profiling every
+window would turn a latency tool into a latency problem.  Capture
+failures (no profiler on this backend, unwritable dir) are recorded in
+the capture doc and never propagate into the dispatch — the solve wins
+over the telemetry.
+
+The ``RAFT_TPU_PROFILE_DIR`` env read lives HERE, not in waterfall.py:
+waterfall is a compiled-code-roster module (serve/cache.py
+``_CODE_VERSION_MODULES``) and this flag is bits-neutral — profiling a
+dispatch must never invalidate a cached executable.
+"""
+
+import json
+import os
+import threading
+import time
+
+from raft_tpu.utils.profiling import logger
+
+__all__ = ["ProfilerHook", "profile_dir_from_env", "env_capture"]
+
+# nesting guard: the engine hook wrapping a sweep dispatch that itself
+# reaches env_capture() must not start_trace twice (jax errors on
+# nested traces); plain bool, flipped only under _ACTIVE_LOCK
+_ACTIVE = [False]
+_ACTIVE_LOCK = threading.Lock()
+
+# env_capture is once-per-process: the flag captures THE next dispatch,
+# not every dispatch of a 256-design sweep
+_ENV_DONE = [False]
+
+
+def profile_dir_from_env():
+    """``RAFT_TPU_PROFILE_DIR`` or None."""
+    return os.environ.get("RAFT_TPU_PROFILE_DIR") or None
+
+
+def _device_memory_stats():
+    """Per-device ``memory_stats()`` where the backend provides them
+    (TPU/GPU do; CPU returns None) — plain JSON types only."""
+    import jax
+
+    out = {}
+    for dev in jax.local_devices():
+        try:
+            stats = dev.memory_stats()
+        except Exception as exc:  # noqa: BLE001 — backend without the API
+            logger.debug("memory_stats unavailable on %s: %s", dev, exc)
+            stats = None
+        out[str(dev)] = ({k: int(v) for k, v in stats.items()}
+                         if stats else None)
+    return out
+
+
+def _waterfall_ledger():
+    from raft_tpu.waterfall import last_dispatch_stats
+
+    return last_dispatch_stats()
+
+
+def _write_doc(log_dir, doc):
+    path = os.path.join(log_dir, "capture.json")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, indent=1)
+    os.replace(tmp, path)
+    return path
+
+
+def _capture(log_dir, fn, meta=None):
+    """Run ``fn`` under a jax.profiler trace; returns (result, doc).
+    Any capture failure lands in ``doc["error"]`` — never raised."""
+    import jax
+
+    doc = {"log_dir": log_dir, "t_unix": time.time(), "meta": meta or {}}
+    started = False
+    with _ACTIVE_LOCK:
+        nested = _ACTIVE[0]
+        _ACTIVE[0] = True
+    t0 = time.perf_counter()
+    try:
+        if not nested:
+            try:
+                os.makedirs(log_dir, exist_ok=True)
+                jax.profiler.start_trace(log_dir)
+                started = True
+            except Exception as exc:  # noqa: BLE001 — keep dispatching
+                doc["error"] = f"{type(exc).__name__}: {exc}"
+        else:
+            doc["error"] = "nested capture: an outer window is active"
+        result = fn()
+    finally:
+        doc["wall_s"] = round(time.perf_counter() - t0, 6)
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception as exc:  # noqa: BLE001
+                doc.setdefault("error",
+                               f"{type(exc).__name__}: {exc}")
+        if not nested:
+            with _ACTIVE_LOCK:
+                _ACTIVE[0] = False
+    try:
+        doc["device_memory"] = _device_memory_stats()
+        doc["waterfall"] = _waterfall_ledger()
+        if started:
+            doc["path"] = _write_doc(log_dir, doc)
+    except Exception as exc:  # noqa: BLE001 — telemetry never raises
+        doc.setdefault("error", f"{type(exc).__name__}: {exc}")
+    logger.info("profiler capture: dir=%s wall=%.3fs error=%s",
+                log_dir, doc["wall_s"], doc.get("error"))
+    return result, doc
+
+
+class ProfilerHook:
+    """One-shot dispatch-window profiler (see module docstring).
+
+    ``run(fn)`` is the hot-path shim: a single GIL-atomic read when
+    disarmed (the steady state), a full capture exactly once after
+    ``arm``."""
+
+    _GUARDED_BY = {"armed_dir": "_lock", "last": "_lock"}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.armed_dir = None
+        self.last = None
+
+    @classmethod
+    def from_env(cls):
+        hook = cls()
+        d = profile_dir_from_env()
+        if d:
+            hook.arm(d)
+        return hook
+
+    def arm(self, log_dir):
+        """Arm capture of the next dispatch window into ``log_dir``.
+        Non-reentrant: arming while a capture is already pending is
+        refused (the ``POST /profilez`` 409)."""
+        log_dir = str(log_dir)
+        with self._lock:
+            if self.armed_dir is not None:
+                return {"armed": False, "log_dir": self.armed_dir,
+                        "error": "already armed; capture pending"}
+            self.armed_dir = log_dir
+        return {"armed": True, "log_dir": log_dir}
+
+    def run(self, fn, meta=None):
+        if self.armed_dir is None:            # GIL-atomic fast path
+            return fn()
+        with self._lock:
+            log_dir, self.armed_dir = self.armed_dir, None
+        if log_dir is None:                   # lost the race: disarmed
+            return fn()
+        result, doc = _capture(log_dir, fn, meta=meta)
+        with self._lock:
+            self.last = doc
+        return result
+
+    def snapshot(self):
+        with self._lock:
+            return {"armed_dir": self.armed_dir, "last": self.last}
+
+
+def env_capture(fn, meta=None):
+    """The non-serve arming path: when ``RAFT_TPU_PROFILE_DIR`` is set,
+    capture ``fn``'s window ONCE per process; otherwise (and on every
+    later call) just run it."""
+    log_dir = profile_dir_from_env()
+    if not log_dir or _ENV_DONE[0]:
+        return fn()
+    _ENV_DONE[0] = True
+    result, _doc = _capture(log_dir, fn, meta=meta)
+    return result

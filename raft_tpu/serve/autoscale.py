@@ -127,10 +127,27 @@ class Autoscaler:
         "_last_action_t": "_step_lock",
     }
 
-    def __init__(self, fleet, config=None, clock=time.monotonic):
+    def __init__(self, fleet, config=None, clock=time.monotonic,
+                 registry=None):
+        from raft_tpu.obs.metrics import MetricsRegistry
+
         self.fleet = fleet
         self.config = config or AutoscaleConfig()
         self.clock = clock
+        # decision counters live on the metrics registry
+        # (docs/observability.md) — the Router passes its own registry
+        # so /metricz exports them; standalone use gets a private one
+        self.metrics = registry if registry is not None \
+            else MetricsRegistry()
+        self._ctr_scale_outs = self.metrics.counter(
+            "raft_tpu_autoscaler_scale_outs_total",
+            "replicas spawned by the pressure policy")
+        self._ctr_scale_ins = self.metrics.counter(
+            "raft_tpu_autoscaler_scale_ins_total",
+            "replicas retired (drain-first) by the pressure policy")
+        self._ctr_heals = self.metrics.counter(
+            "raft_tpu_autoscaler_heals_total",
+            "replicas spawned to repair the min-replica floor")
         self.decisions = []        # [{t, action, replica, pressure, ...}]
         self.steps = 0
         self._t0 = clock()
@@ -239,21 +256,23 @@ class Autoscaler:
             "replicas": int(n_after),
         }
         self.decisions.append(rec)
+        {"scale_out": self._ctr_scale_outs,
+         "scale_in": self._ctr_scale_ins,
+         "heal": self._ctr_heals}[action].inc()
         logger.warning("autoscale %s: %s (pressure %.2f%s, fleet -> %d)",
                        action, replica, per,
                        ", shedding" if shedding else "", n_after)
         return rec
 
     def snapshot(self):
+        # the legacy keys now read the registry counters — same values
+        # (one inc per recorded decision), same snapshot schema
         return {
             "steps": self.steps,
             "decisions": list(self.decisions),
-            "scale_outs": sum(1 for d in self.decisions
-                              if d["action"] == "scale_out"),
-            "scale_ins": sum(1 for d in self.decisions
-                             if d["action"] == "scale_in"),
-            "heals": sum(1 for d in self.decisions
-                         if d["action"] == "heal"),
+            "scale_outs": self._ctr_scale_outs.get(),
+            "scale_ins": self._ctr_scale_ins.get(),
+            "heals": self._ctr_heals.get(),
             "config": dataclasses.asdict(self.config),
         }
 

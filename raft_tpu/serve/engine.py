@@ -75,6 +75,10 @@ from raft_tpu.batched_prep import (
 )
 from raft_tpu.chaos import ChaosBackendError, ChaosError, get_injector
 from raft_tpu.health import log_report, report_dict
+from raft_tpu.obs.metrics import MetricsRegistry
+from raft_tpu.obs.profiler import ProfilerHook
+from raft_tpu.obs.tracing import SpanRing, TraceContext
+from raft_tpu.obs.tracing import span as obs_span
 from raft_tpu.resilience import (
     BackoffPolicy,
     BreakerBoard,
@@ -108,6 +112,11 @@ TERMINAL_STATUSES = (
     "ok", "failed", "rejected_deadline", "rejected_overload",
     "rejected_circuit", "watchdog_timeout", "shutdown",
 )
+
+
+def _trace_id_of(req):
+    """The trace id a result should carry for this request (or None)."""
+    return getattr(req.trace, "trace_id", None)
 
 
 def _env_float(name, default):
@@ -231,6 +240,7 @@ class Request:
     deadline_s: float = None    # relative to submit; None = no deadline
     rid: int = 0
     t_submit: float = 0.0
+    trace: object = None        # obs.tracing.TraceContext (or None)
 
 
 @dataclasses.dataclass
@@ -262,6 +272,7 @@ class RequestResult:
     batch_occupancy: float = 0.0     # real lanes / bucket slots
     backend: str = None              # backend the dispatch ran on
     replica: str = None              # replica id when routed (router.py)
+    trace_id: str = None             # obs trace id (None when untraced)
 
     @property
     def ok(self):
@@ -344,6 +355,7 @@ class SweepResult:
     latency_s: float = 0.0           # submit -> terminal
     suspend_s: float = 0.0           # cumulative preempted wall clock
     replica: str = None              # replica id when routed (router.py)
+    trace_id: str = None             # obs trace id (None when untraced)
 
     @property
     def ok(self):
@@ -410,9 +422,10 @@ class _SweepJob:
                  "chunk_idx", "futs", "t_submit", "suspended",
                  "t_suspend", "suspend_wall", "suspend_total",
                  "seg_queue", "chunk_t0", "chunk_failed", "failed",
-                 "out", "preemptions")
+                 "out", "preemptions", "trace")
 
-    def __init__(self, rid, designs, cases, handle, chunks, t_submit):
+    def __init__(self, rid, designs, cases, handle, chunks, t_submit,
+                 trace=None):
         self.rid = rid
         self.designs = designs
         self.cases = cases
@@ -431,6 +444,7 @@ class _SweepJob:
         self.failed = []             # [(design idx, msg)] whole sweep
         self.out = None              # aggregate arrays, lazily allocated
         self.preemptions = 0
+        self.trace = trace           # TraceContext; rides preemptions too
 
     @property
     def pend(self):
@@ -559,7 +573,24 @@ class Engine:
         primary = self._lane_devices(self.config.device)
         self._mesh_width = len(primary) if primary else 1
         self._lane_mesh = primary is not None
-        self.stats = {
+        # per-engine metrics registry + span ring + profiler hook
+        # (docs/observability.md).  The legacy stats dict becomes a
+        # StatsView: every integer key is a registry counter
+        # (raft_tpu_engine_<key>_total) and every existing call site /
+        # snapshot() key keeps working unchanged.
+        self.metrics = MetricsRegistry()
+        self._hist_latency = self.metrics.histogram(
+            "raft_tpu_engine_request_latency_seconds",
+            "submit-to-result latency of ok requests")
+        self._hist_queue = self.metrics.histogram(
+            "raft_tpu_engine_queue_wait_seconds",
+            "submit-to-dispatch-start queue wait of dispatched requests")
+        self._hist_dispatch = self.metrics.histogram(
+            "raft_tpu_engine_dispatch_seconds",
+            "device wall clock of one bucket dispatch")
+        self.trace_ring = SpanRing()
+        self._profiler = ProfilerHook.from_env()
+        self.stats = self.metrics.stats_view("engine", {
             "requests": 0, "dispatches": 0, "ok": 0, "failed": 0,
             "rejected_deadline": 0, "rejected_overload": 0,
             "rejected_circuit": 0, "watchdog_timeout": 0,
@@ -575,7 +606,7 @@ class Engine:
             "prep_memo_hits": 0, "prep_batched_designs": 0,
             "prep_batched_groups": 0, "bucket_compiles": [],
             "first_result_s": None, "warmup": None,
-        }
+        })
         self._t_start = time.perf_counter()
         if self.config.warm_on_start:
             self.stats["warmup"] = warmup(
@@ -591,15 +622,22 @@ class Engine:
 
     # ------------------------------------------------------------- client
 
-    def submit(self, design, cases=None, deadline_s=None):
+    def submit(self, design, cases=None, deadline_s=None, trace=None):
         """Enqueue one request; returns a handle with ``result(timeout)``.
 
         Admission control runs here: hopeless deadlines
         (``deadline_s <= 0`` or below the predicted queue wait) resolve
         immediately with ``rejected_deadline``, and an over-high-water
         queue sheds with ``rejected_overload`` — neither occupies a
-        queue slot."""
+        queue slot.
+
+        ``trace`` is the request's :class:`TraceContext` when it arrived
+        with one (the wire path / router); a fresh one is minted here
+        otherwise, so every request is traceable end-to-end."""
         now = time.perf_counter()
+        t_wall = time.time()
+        if trace is None:
+            trace = TraceContext.new()
         with self._lock:
             if self._stop:
                 raise RuntimeError("engine is shut down")
@@ -607,13 +645,19 @@ class Engine:
             rid = self._rid
             self.stats["requests"] += 1
             pend = _Pending(rid)
+            pend.trace_id = trace.trace_id
             # --- deadline admission (satellite: reject on submit) ---
             if deadline_s is not None:
                 predicted = self._predicted_wait_locked(now)
                 if deadline_s <= 0 or deadline_s < predicted:
                     self.stats["rejected_deadline"] += 1
+                    self.trace_ring.record(
+                        "admission", trace, t_wall,
+                        time.perf_counter() - now,
+                        status="rejected_deadline")
                     pend._set(RequestResult(
                         rid=rid, status="rejected_deadline",
+                        trace_id=trace.trace_id,
                         error=(f"deadline {deadline_s}s hopeless at "
                                f"submit (predicted wait "
                                f"{predicted:.3f}s)")))
@@ -636,20 +680,29 @@ class Engine:
                     self.config.low_water)
             if self._shedding:
                 self.stats["rejected_overload"] += 1
+                self.trace_ring.record(
+                    "admission", trace, t_wall,
+                    time.perf_counter() - now,
+                    status="rejected_overload")
                 pend._set(RequestResult(
                     rid=rid, status="rejected_overload",
+                    trace_id=trace.trace_id,
                     error=(f"queue at {qlen} >= high-water "
                            f"{self.config.max_queue}")))
                 return pend
             req = Request(design=design, cases=cases,
-                          deadline_s=deadline_s, rid=rid, t_submit=now)
+                          deadline_s=deadline_s, rid=rid, t_submit=now,
+                          trace=trace)
             fut = self._submit_prep_locked(req)
             self._queue.append(_Entry(req, pend, fut))
             self._outstanding[rid] = pend
             self._wake.notify()
+            self.trace_ring.record(
+                "admission", trace, t_wall, time.perf_counter() - now,
+                status="queued", rid=rid)
         return pend
 
-    def submit_sweep(self, designs, cases=None, chunk=None):
+    def submit_sweep(self, designs, cases=None, chunk=None, trace=None):
         """Enqueue a design sweep as ONE streamed request; returns a
         ``SweepHandle`` (``chunks()`` partial stream + terminal
         ``result()``).
@@ -685,6 +738,8 @@ class Engine:
             len(designs), n_cases=n_cases,
             chunk=chunk if chunk is not None
             else (self.config.sweep_chunk or None), rung=rung)
+        if trace is None:
+            trace = TraceContext.new()
         with self._lock:
             if self._stop:
                 raise RuntimeError("engine is shut down")
@@ -693,7 +748,9 @@ class Engine:
             self.stats["sweeps"] += 1
             self.stats["sweep_designs"] += len(designs)
             handle = SweepHandle(rid, len(designs), len(chunks))
-            job = _SweepJob(rid, designs, cases, handle, chunks, now)
+            handle.trace_id = trace.trace_id
+            job = _SweepJob(rid, designs, cases, handle, chunks, now,
+                            trace=trace)
             handle._pend.sweep_job = job
             self._sweep_jobs.append(job)
             self._outstanding[rid] = handle._pend
@@ -711,6 +768,22 @@ class Engine:
         ``Model(design, slots=...)``)."""
         prepped = self._prepare(Request(design=design, cases=cases))
         return prepped.spec
+
+    def capture_profile(self, log_dir=None):
+        """Arm ``jax.profiler`` capture of the NEXT dispatch window into
+        ``log_dir`` (``RAFT_TPU_PROFILE_DIR`` when omitted) — the
+        ``POST /profilez`` backend (serve/transport.py).  One-shot: the
+        hook disarms itself after the capture; ``capture.json`` in the
+        log dir records device memory stats and the waterfall flops
+        ledger alongside the trace."""
+        from raft_tpu.obs.profiler import profile_dir_from_env
+
+        log_dir = log_dir or profile_dir_from_env()
+        if not log_dir:
+            return {"armed": False,
+                    "error": "no log_dir given and RAFT_TPU_PROFILE_DIR "
+                             "is unset"}
+        return self._profiler.arm(log_dir)
 
     def shutdown(self, wait=True, drain=True, timeout=30.0):
         """Stop the engine.  ``drain=True`` serves what is already queued
@@ -775,6 +848,7 @@ class Engine:
                         n_chunks=len(job.chunks),
                         chunks_done=job.chunk_idx,
                         preemptions=job.preemptions,
+                        trace_id=getattr(job.trace, "trace_id", None),
                         error="engine stopped before the sweep "
                               "finished")):
                     resolved += 1
@@ -782,6 +856,7 @@ class Engine:
                 continue
             if self._resolve(pend, RequestResult(
                     rid=pend.rid, status="shutdown",
+                    trace_id=getattr(pend, "trace_id", None),
                     error="engine stopped before this request was "
                           "served")):
                 resolved += 1
@@ -850,6 +925,13 @@ class Engine:
             self._wake.notify_all()
 
     def _prepare(self, req):
+        """Host-side prep, span-recorded per traced request (a prep
+        memo hit still shows as a short span — the waterfall view of a
+        request must account for every stage)."""
+        with obs_span(self.trace_ring, "prep", req.trace, rid=req.rid):
+            return self._prepare_inner(req)
+
+    def _prepare_inner(self, req):
         """Host-side prep with the three-level cache (in-process memo ->
         on-disk prep cache -> full Model build).  Chaos hooks: prep_raise
         / prep_slow fire here, keyed on the rid of the request that owns
@@ -1029,7 +1111,7 @@ class Engine:
         lanes = []
         for di in dis:
             req = Request(design=job.designs[di], cases=job.cases,
-                          rid=job.rid)
+                          rid=job.rid, trace=job.trace)
             key = self._prep_key(req.design, req.cases)
             with self._prep_lock:
                 memo = self._prep_memo.get(key)
@@ -1259,7 +1341,7 @@ class Engine:
                 continue
             for di in pend:
                 req = Request(design=job.designs[di], cases=job.cases,
-                              rid=job.rid)
+                              rid=job.rid, trace=job.trace)
                 fut = self._sweep_prep_pool.submit(self._prepare, req)
                 fut.add_done_callback(self._on_prep_done)
                 job.futs[di] = fut
@@ -1341,7 +1423,8 @@ class Engine:
             physics, _members, nodes_s, args_s, _ranges, lanes = seg
             out = waterfall_dispatch(
                 physics, nodes_s, args_s, block=blk,
-                slab=len(args_s[0]), should_yield=sy)
+                slab=len(args_s[0]), should_yield=sy,
+                trace=job.trace, span_ring=self.trace_ring)
             if self._note_segment(job, seg, out):
                 return
         self._finish_chunk(job)
@@ -1462,6 +1545,10 @@ class Engine:
             for name in SWEEP_REPORT_KEYS:
                 doc[name] = job.out[name][sel]
         job.handle._push(doc)
+        self.trace_ring.record(
+            "sweep_chunk", job.trace, time.time() - wall, wall,
+            rid=job.rid, chunk=job.chunk_idx,
+            preemptions=job.preemptions)
         with self._lock:
             self.stats["sweep_chunks"] += 1
             job.seg_queue = None
@@ -1493,6 +1580,7 @@ class Engine:
             failed_idx=[int(di) for di, _m in job.failed],
             failed_msg=[m for _di, m in job.failed],
             preemptions=job.preemptions, mode=mode,
+            trace_id=getattr(job.trace, "trace_id", None),
             latency_s=time.perf_counter() - job.t_submit,
             suspend_s=job.suspend_total))
         job.handle._close()
@@ -1509,6 +1597,7 @@ class Engine:
             rid=job.rid, status="failed",
             n_designs=len(job.designs), n_chunks=len(job.chunks),
             chunks_done=job.chunk_idx, preemptions=job.preemptions,
+            trace_id=getattr(job.trace, "trace_id", None),
             error=msg))
         job.handle._close()
 
@@ -1526,6 +1615,7 @@ class Engine:
                     self.stats["rejected_deadline"] += 1
                 self._resolve(pend, RequestResult(
                     rid=req.rid, status="rejected_deadline",
+                    trace_id=_trace_id_of(req),
                     error=f"deadline {req.deadline_s}s expired in queue",
                     latency_s=now - req.t_submit))
                 continue
@@ -1560,6 +1650,7 @@ class Engine:
                         self.stats["shutdown_resolved"] += 1
                     self._resolve(pend, RequestResult(
                         rid=req.rid, status="shutdown",
+                        trace_id=_trace_id_of(req),
                         error="engine stopped before prep",
                         latency_s=time.perf_counter() - req.t_submit))
                     continue
@@ -1570,6 +1661,7 @@ class Engine:
                     req.rid, type(e).__name__, e)
                 self._resolve(pend, RequestResult(
                     rid=req.rid, status="failed",
+                    trace_id=_trace_id_of(req),
                     error=f"{type(e).__name__}: {e}",
                     latency_s=time.perf_counter() - req.t_submit))
                 continue
@@ -1614,6 +1706,7 @@ class Engine:
                     self.stats["rejected_circuit"] += 1
                 self._resolve(pend, RequestResult(
                     rid=req.rid, status="rejected_circuit", bucket=spec,
+                    trace_id=_trace_id_of(req),
                     error=(f"circuit open for {key[0]}/{spec} "
                            "(recent watchdog/backend failures); retry "
                            "after the breaker cooldown"),
@@ -1645,6 +1738,7 @@ class Engine:
                     self.stats["rejected_circuit"] += 1
                 self._resolve(pend, RequestResult(
                     rid=req.rid, status="rejected_circuit", bucket=spec,
+                    trace_id=_trace_id_of(req),
                     error="circuit open on the primary AND degraded-CPU "
                           "paths",
                     latency_s=time.perf_counter() - req.t_submit))
@@ -1691,6 +1785,13 @@ class Engine:
         the megabatch through the fixed-block lane-sharded executable
         (bit-identical across mesh widths; buckets.dispatch_slots)."""
         t0 = time.perf_counter()
+        t0_wall = time.time()
+        for req, _pend, _p in members:
+            queue_s = max(t0 - req.t_submit, 0.0)
+            self._hist_queue.observe(queue_s)
+            self.trace_ring.record(
+                "queue_wait", req.trace, t0_wall - queue_s, queue_s,
+                rid=req.rid)
         entries = self._member_entries(members)
         capacity = self._dispatch_capacity(spec, devices)
         try:
@@ -1708,8 +1809,14 @@ class Engine:
                                           devices=devices,
                                           block=self._lane_block)
 
+                # the profiler hook wraps the watched call: when armed
+                # (POST /profilez) exactly this window runs under
+                # jax.profiler capture, then the hook disarms itself
                 out = self._dispatch_policy.run(
-                    lambda: self._watched_call(_call),
+                    lambda: self._profiler.run(
+                        lambda: self._watched_call(_call),
+                        meta={"bucket": str(spec), "backend": backend,
+                              "requests": len(members)}),
                     key=str((backend, spec)),
                     on_retry=self._count_dispatch_retry)
         except WatchdogTimeout as e:
@@ -1722,6 +1829,7 @@ class Engine:
                     self.stats["watchdog_timeout"] += 1
                 self._resolve(pend, RequestResult(
                     rid=req.rid, status="watchdog_timeout", bucket=spec,
+                    trace_id=_trace_id_of(req),
                     error=str(e), backend=backend,
                     latency_s=time.perf_counter() - req.t_submit))
             return
@@ -1735,6 +1843,7 @@ class Engine:
                     self.stats["failed"] += 1
                 self._resolve(pend, RequestResult(
                     rid=req.rid, status="failed", bucket=spec,
+                    trace_id=_trace_id_of(req),
                     error=f"{type(e).__name__}: {e}", backend=backend,
                     latency_s=time.perf_counter() - req.t_submit))
             return
@@ -1756,6 +1865,13 @@ class Engine:
         occupancy = lanes / capacity
         t_done = time.perf_counter()
         dt = t_done - t0
+        self._hist_dispatch.observe(dt)
+        dispatch_wall_t0 = time.time() - dt
+        for req, _pend, _p in members:
+            self.trace_ring.record(
+                "dispatch", req.trace, dispatch_wall_t0, dt,
+                rid=req.rid, backend=backend,
+                batch_requests=len(members))
         with self._lock:
             self.stats["dispatches"] += 1
             self.stats["occupancy"].append(occupancy)
@@ -1771,6 +1887,7 @@ class Engine:
             std = np.sqrt(
                 np.sum(xr[a:b] ** 2 + xi[a:b] ** 2, axis=-1) * prepped.dw)
             latency = t_done - req.t_submit
+            self._hist_latency.observe(latency)
             with self._lock:
                 self.stats["latency_s"].append(latency)
                 if self.stats["first_result_s"] is None:
@@ -1778,6 +1895,7 @@ class Engine:
             if self._resolve(pend, RequestResult(
                     rid=req.rid, status="ok", Xi=Xi, std=std,
                     solve_report=report_dict(rep), bucket=spec,
+                    trace_id=_trace_id_of(req),
                     latency_s=latency, queue_s=t0 - req.t_submit,
                     batch_requests=len(members),
                     batch_occupancy=occupancy, backend=backend)):
@@ -1955,6 +2073,9 @@ class Engine:
             "lane_block": (self._lane_block
                            if self._lane_mesh else None),
             "mesh": "lane" if self._lane_mesh else None,
+            # observability surfaces (docs/observability.md)
+            "trace_spans": self.trace_ring.snapshot(),
+            "profiler": self._profiler.snapshot(),
         }
         if self._chaos is not None:
             out["chaos"] = self._chaos.snapshot()

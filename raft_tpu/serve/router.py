@@ -72,6 +72,8 @@ from bisect import bisect_right
 from concurrent.futures import ThreadPoolExecutor
 
 from raft_tpu.chaos import get_injector
+from raft_tpu.obs.metrics import MetricsRegistry
+from raft_tpu.obs.tracing import SpanRing, TraceContext
 from raft_tpu.resilience import BreakerBoard, TransientError
 from raft_tpu.serve import wire
 from raft_tpu.serve.engine import _Pending
@@ -286,6 +288,7 @@ class _RouterSweepHandle:
         self.rid = rid
         self.n_designs = n_designs
         self.n_chunks = 0            # learned from the first chunk line
+        self.trace_id = None         # set at router ingress
         self._q = queue.Queue()
         self._pend = _Pending(rid)
 
@@ -326,6 +329,7 @@ class Router:
         "stats": "_lock",
         "replicas": "_lock",
         "_ring": "_lock",
+        "_last_scrape_ok": "_lock",
     }
     # probe() is the readiness gauge: GIL-atomic len()/dict reads only,
     # so a wedged batcher holding _lock can never wedge the health check
@@ -343,14 +347,29 @@ class Router:
         self._stop = False
         self._outstanding = {}
         self._t_start = time.monotonic()
-        self.stats = {
+        # router-tier metrics registry + span ring
+        # (docs/observability.md): the stats dict is a StatsView whose
+        # integer keys are registry counters (raft_tpu_router_<k>_total)
+        self.metrics = MetricsRegistry()
+        self._hist_latency = self.metrics.histogram(
+            "raft_tpu_router_request_latency_seconds",
+            "router-ingress-to-resolution latency of forwarded requests")
+        self._scrape_errors = self.metrics.counter(
+            "raft_tpu_router_statz_scrape_errors_total",
+            "per-replica /statz scrapes that failed or timed out")
+        self._scrape_staleness = self.metrics.gauge(
+            "raft_tpu_router_scrape_staleness_seconds",
+            "age of the OLDEST alive replica's last good /statz scrape")
+        self._last_scrape_ok = {}    # replica id -> monotonic last-good
+        self.trace_ring = SpanRing()
+        self.stats = self.metrics.stats_view("router", {
             "requests": 0, "forwarded": 0, "replica_retries": 0,
             "dead_replica_skips": 0, "rejected_deadline": 0,
             "failed": 0, "ok": 0, "shutdown_resolved": 0,
             "chaos_replica_kills": 0, "chaos_replica_slows": 0,
             "sweeps": 0, "sweep_chunk_failovers": 0,
             "scale_outs": 0, "scale_ins": 0, "reaps": 0,
-        }
+        })
         # spawn recipe kept for scale_out (None in attach mode: the
         # router does not own attached processes, so it cannot grow or
         # retire them)
@@ -401,15 +420,19 @@ class Router:
                                                   Autoscaler)
 
             self.autoscaler = Autoscaler(
-                self, autoscale_config or AutoscaleConfig.from_env())
+                self, autoscale_config or AutoscaleConfig.from_env(),
+                registry=self.metrics)
             self.autoscaler.start()
         logger.info("router up: %d replica(s) %s", len(self.replicas),
                     {r.id: r.port for r in self.replicas.values()})
 
     # -- engine-compatible front surface ----------------------------
 
-    def submit(self, design, cases=None, deadline_s=None):
+    def submit(self, design, cases=None, deadline_s=None, trace=None):
         t0 = time.perf_counter()
+        t_wall = time.time()
+        if trace is None:
+            trace = TraceContext.new()
         with self._lock:
             if self._stop:
                 raise RuntimeError("router is shut down")
@@ -417,24 +440,30 @@ class Router:
             rid = self._rid
             self.stats["requests"] += 1
             pend = _Pending(rid)
+            pend.trace_id = trace.trace_id
             self._outstanding[rid] = pend
             # deadline admission before any forwarding
             if deadline_s is not None and deadline_s <= 0:
                 self.stats["rejected_deadline"] += 1
+                self.trace_ring.record(
+                    "ingress", trace, t_wall,
+                    time.perf_counter() - t0, proc="router",
+                    status="rejected_deadline")
                 self._resolve_locked(rid, pend, wire.result_from_doc({
                     "rid": rid, "status": "rejected_deadline",
+                    "trace_id": trace.trace_id,
                     "error": f"deadline_s={deadline_s:.3f} already "
                              f"expired at router admission"}))
                 return pend
         self._pool.submit(self._forward, rid, pend, design, cases,
-                          deadline_s, t0)
+                          deadline_s, t0, trace, t_wall)
         return pend
 
     def evaluate(self, design, cases=None, deadline_s=None, timeout=None):
         return self.submit(design, cases=cases,
                            deadline_s=deadline_s).result(timeout)
 
-    def submit_sweep(self, designs, cases=None, chunk=None):
+    def submit_sweep(self, designs, cases=None, chunk=None, trace=None):
         """Forward a sweep to the replica owning its design family.
 
         Placement hashes ``routing_key(designs[0], cases)`` — the
@@ -446,6 +475,8 @@ class Router:
         designs = list(designs)
         if not designs:
             raise ValueError("submit_sweep needs at least one design")
+        if trace is None:
+            trace = TraceContext.new()
         with self._lock:
             if self._stop:
                 raise RuntimeError("router is shut down")
@@ -454,10 +485,13 @@ class Router:
             self.stats["requests"] += 1
             self.stats["sweeps"] += 1
             handle = _RouterSweepHandle(rid, len(designs))
+            handle.trace_id = trace.trace_id
+            handle._pend.trace_id = trace.trace_id
             handle._pend.router_sweep = handle
             self._outstanding[rid] = handle._pend
         self._pool.submit(self._forward_sweep, rid, handle, designs,
-                          cases, chunk, time.perf_counter())
+                          cases, chunk, time.perf_counter(), trace,
+                          time.time())
         return handle
 
     def probe(self):
@@ -489,16 +523,102 @@ class Router:
         out["uptime_s"] = round(time.monotonic() - self._t_start, 3)
         out["replicas"] = [r.info() for r in list(self.replicas.values())]
         out["breakers"] = self._breakers.snapshot()
+        out["scrape_errors"] = self._scrape_errors.get()
+        out["scrape_ages_s"] = self.scrape_ages()
+        out["trace_spans"] = self.trace_ring.snapshot()
         if self.autoscaler is not None:
             out["autoscale"] = self.autoscaler.snapshot()
+        return out
+
+    # -- observability ----------------------------------------------
+
+    def gather_trace(self, trace_id, timeout=5.0):
+        """Stitch one request's spans across processes: the router's
+        own ring (ingress + per-attempt wire spans) plus every alive
+        replica's ``GET /tracez?trace_id=...`` (admission, prep,
+        queue_wait, dispatch, wf_block).  Returns ``{"trace_id",
+        "spans", "n_spans", "e2e_s", "coverage", "chrome"}`` where
+        ``chrome`` is a chrome://tracing JSON object with one track per
+        process — a failed-over request shows its retry hops on one
+        timeline because the SAME trace_id rode every attempt."""
+        spans = self.trace_ring.spans(trace_id=trace_id)
+        for rid, rep in list(self.replicas.items()):
+            if rep.dead():
+                continue
+            try:
+                _code, doc = rep.client.get(
+                    f"/tracez?trace_id={trace_id}", timeout=timeout)
+            except Exception as exc:  # noqa: BLE001 — best effort
+                logger.debug("tracez scrape of %s failed: %s", rid, exc)
+                continue
+            for s in doc.get("spans", []):
+                meta = dict(s.get("meta") or {})
+                meta.setdefault("replica", rid)
+                s["meta"] = meta
+                spans.append(s)
+        spans.sort(key=lambda s: s.get("t0", 0.0))
+        ingress = [s for s in spans if s.get("proc") == "router"
+                   and s.get("name") in ("ingress", "sweep_ingress")]
+        e2e_s = max((s["dur_s"] for s in ingress), default=0.0)
+        from raft_tpu.trace import chrome_trace_from_spans
+        out = {
+            "trace_id": trace_id,
+            "spans": spans,
+            "n_spans": len(spans),
+            "e2e_s": e2e_s,
+            "coverage": 0.0,
+            "chrome": chrome_trace_from_spans(
+                spans, label=f"raft_tpu trace {trace_id}"),
+        }
+        if ingress and e2e_s > 0:
+            # coverage: fraction of the ingress window the child spans
+            # account for (union of intervals clipped to the window)
+            root = max(ingress, key=lambda s: s["dur_s"])
+            lo, hi = root["t0"], root["t0"] + root["dur_s"]
+            ivals = sorted(
+                (max(s["t0"], lo), min(s["t0"] + s["dur_s"], hi))
+                for s in spans if s is not root)
+            cov, end = 0.0, lo
+            for a, b in ivals:
+                if b <= end or b <= a:
+                    continue
+                cov += b - max(a, end)
+                end = b
+            out["coverage"] = round(min(1.0, cov / e2e_s), 4)
+        return out
+
+    def capture_profile(self, log_dir=None):
+        """Arm a one-shot profiler capture on every alive replica
+        (``POST /profilez`` fan-out); each replica wraps its next
+        dispatch window in ``jax.profiler`` traces written under
+        ``log_dir`` (or the replica's ``RAFT_TPU_PROFILE_DIR``).
+        Returns {replica_id: replica response | error doc}."""
+        out = {}
+        for rid, rep in list(self.replicas.items()):
+            if rep.dead():
+                out[rid] = {"armed": False, "error": "replica dead"}
+                continue
+            doc = {"log_dir": log_dir} if log_dir else {}
+            try:
+                out[rid] = rep.client.post_json("/profilez", doc)
+            except Exception as exc:  # noqa: BLE001 — best effort
+                out[rid] = {"armed": False, "error": str(exc)}
         return out
 
     # -- elastic fleet ----------------------------------------------
 
     def replica_gauges(self):
         """One ``/statz`` scrape per replica -> {replica_id: doc|None}
-        (None for dead/unreachable replicas) — the autoscaler's input."""
+        (None for dead/unreachable replicas) — the autoscaler's input.
+
+        Scrape health is itself metered (docs/observability.md): every
+        failed/timed-out scrape of a LIVE replica bumps
+        ``raft_tpu_router_statz_scrape_errors_total``, and
+        ``raft_tpu_router_scrape_staleness_seconds`` tracks how old the
+        oldest alive replica's last good scrape is — a rising staleness
+        gauge means the autoscaler is steering on stale inputs."""
         gauges = {}
+        now = time.monotonic()
         for rid, rep in list(self.replicas.items()):
             if rep.dead():
                 gauges[rid] = None
@@ -506,11 +626,35 @@ class Router:
             try:
                 _code, doc = rep.client.get("/statz", timeout=5.0)
                 gauges[rid] = doc
+                with self._lock:
+                    self._last_scrape_ok[rid] = now
             except Exception as exc:  # noqa: BLE001 — unreachable
                 gauges[rid] = None    # reads as dead; debug level since
                 # a corpse fires this every tick until heal reaps it
+                self._scrape_errors.inc()
                 logger.debug("statz scrape of %s failed: %s", rid, exc)
+        with self._lock:
+            # staleness over ALIVE replicas only: a replica that never
+            # scraped ok ages from router start, a reaped one drops out
+            alive = {rid for rid, rep in self.replicas.items()
+                     if not rep.dead()}
+            self._last_scrape_ok = {
+                rid: t for rid, t in self._last_scrape_ok.items()
+                if rid in alive}
+            ages = [now - self._last_scrape_ok.get(rid, self._t_start)
+                    for rid in alive]
+        self._scrape_staleness.set(max(ages) if ages else 0.0)
         return gauges
+
+    def scrape_ages(self):
+        """{replica_id: seconds since last good /statz scrape} for
+        alive replicas (tests + /statz introspection)."""
+        now = time.monotonic()
+        with self._lock:
+            return {
+                rid: round(now - self._last_scrape_ok.get(
+                    rid, self._t_start), 3)
+                for rid, rep in self.replicas.items() if not rep.dead()}
 
     def scale_out(self):
         """Spawn one more replica and claim only its vnode arcs on the
@@ -662,20 +806,27 @@ class Router:
         with self._lock:
             self._resolve_locked(rid, pend, res)
 
-    def _forward(self, rid, pend, design, cases, deadline_s, t0):
+    def _forward(self, rid, pend, design, cases, deadline_s, t0,
+                 trace=None, t_wall=None):
         key = routing_key(design, cases)
         order = self._ring.preference(key)
         inj = get_injector()
         last_err = None
         attempted = breaker_skips = 0
+        if t_wall is None:
+            t_wall = time.time()
         for replica_id in order:
             rep = self.replicas.get(replica_id)
             elapsed = time.perf_counter() - t0
             if deadline_s is not None and deadline_s - elapsed <= 0:
                 with self._lock:
                     self.stats["rejected_deadline"] += 1
+                self.trace_ring.record(
+                    "ingress", trace, t_wall, elapsed, proc="router",
+                    status="rejected_deadline")
                 return self._resolve(rid, pend, wire.result_from_doc({
                     "rid": rid, "status": "rejected_deadline",
+                    "trace_id": getattr(trace, "trace_id", None),
                     "error": f"deadline expired after {elapsed:.3f}s at "
                              f"router (last: {last_err})"}))
             if rep is None:                # retired mid-flight
@@ -714,8 +865,14 @@ class Router:
                     slow_s = float(rule.value
                                    if rule.value is not None else 0.5)
             req = {"design": design, "cases": cases, "xi": True}
+            if trace is not None:
+                # the SAME trace_id rides every retry attempt — that is
+                # what lets gather_trace stitch a failed-over request
+                req["trace"] = trace.to_doc()
             if deadline_s is not None:
                 req["deadline_s"] = deadline_s - elapsed
+            w_wall = time.time()
+            w0 = time.perf_counter()
             try:
                 with self._lock:
                     self.stats["forwarded"] += 1
@@ -726,11 +883,19 @@ class Router:
                 breaker.record_failure(str(e))
                 with self._lock:
                     self.stats["replica_retries"] += 1
+                self.trace_ring.record(
+                    "wire", trace, w_wall, time.perf_counter() - w0,
+                    proc="router", replica=replica_id,
+                    attempt=attempted, outcome="retry")
                 last_err = str(e)
                 logger.warning("forward rid=%d to %s failed (%s); "
                                "retrying on next replica", rid,
                                replica_id, e)
                 continue
+            self.trace_ring.record(
+                "wire", trace, w_wall, time.perf_counter() - w0,
+                proc="router", replica=replica_id, attempt=attempted,
+                outcome=doc.get("status"))
             if doc.get("status") == "shutdown" and not self._stop:
                 # replica mid-drain: the request was NOT served — treat
                 # as transient and try the next replica
@@ -747,6 +912,12 @@ class Router:
             res = wire.result_from_doc(doc, rid=rid)
             res.replica = replica_id
             res.latency_s = time.perf_counter() - t0
+            if res.trace_id is None and trace is not None:
+                res.trace_id = trace.trace_id
+            self._hist_latency.observe(res.latency_s)
+            self.trace_ring.record(
+                "ingress", trace, t_wall, res.latency_s, proc="router",
+                replica=replica_id, status=status)
             return self._resolve(rid, pend, res)
         # a request whose forwards all genuinely failed is "failed"; one
         # that never got past open breakers is "rejected_circuit"
@@ -754,12 +925,17 @@ class Router:
                   if not attempted and breaker_skips else "failed")
         with self._lock:
             self.stats["failed"] += 1
+        self.trace_ring.record(
+            "ingress", trace, t_wall, time.perf_counter() - t0,
+            proc="router", status=status)
         return self._resolve(rid, pend, wire.result_from_doc({
             "rid": rid, "status": status,
+            "trace_id": getattr(trace, "trace_id", None),
             "error": f"no replica served the request "
                      f"(tried {len(order)}; last: {last_err})"}))
 
-    def _forward_sweep(self, rid, handle, designs, cases, chunk, t0):
+    def _forward_sweep(self, rid, handle, designs, cases, chunk, t0,
+                       trace=None, t_wall=None):
         """Forward a sweep, checkpointing completed chunks: every chunk
         doc relayed off the stream is a durable partial result (the PR 2
         checkpoint schema), so when the serving replica dies mid-stream
@@ -772,6 +948,8 @@ class Router:
         inj = get_injector()
         last_err = None
         attempted = breaker_skips = 0
+        if t_wall is None:
+            t_wall = time.time()
         streamed = []      # completed chunk docs (original design idx)
         done = set()       # original design indices already answered
         for replica_id in order:
@@ -805,6 +983,11 @@ class Router:
                     len(streamed))
             req = {"designs": [designs[i] for i in idx_map],
                    "cases": cases}
+            if trace is not None:
+                # one trace_id spans the whole sweep INCLUDING chunk
+                # failover resubmits — every replica segment's spans
+                # stitch onto the same gather_trace timeline
+                req["trace"] = trace.to_doc()
             if chunk is not None:
                 req["chunk"] = int(chunk)
             base = len(streamed)
@@ -838,6 +1021,8 @@ class Router:
                         rep.proc.kill()
                         rep.proc.wait(10)
 
+            w_wall = time.time()
+            w0 = time.perf_counter()
             try:
                 with self._lock:
                     self.stats["forwarded"] += 1
@@ -848,6 +1033,11 @@ class Router:
                 breaker.record_failure(str(e))
                 with self._lock:
                     self.stats["replica_retries"] += 1
+                self.trace_ring.record(
+                    "sweep_wire", trace, w_wall,
+                    time.perf_counter() - w0, proc="router",
+                    replica=replica_id, attempt=attempted,
+                    outcome="retry", chunks_relayed=len(streamed))
                 last_err = (f"stream from {replica_id} dropped after "
                             f"{len(streamed)} chunk(s): {e}"
                             if streamed else str(e))
@@ -855,6 +1045,11 @@ class Router:
                                "on next replica", rid, replica_id,
                                last_err)
                 continue
+            self.trace_ring.record(
+                "sweep_wire", trace, w_wall, time.perf_counter() - w0,
+                proc="router", replica=replica_id, attempt=attempted,
+                outcome=terminal.get("status"),
+                chunks_relayed=len(streamed))
             if terminal.get("status") == "shutdown" and not self._stop:
                 # replica mid-drain: chunks it already streamed are
                 # complete checkpointed results; the remainder retries
@@ -867,7 +1062,7 @@ class Router:
             rep.served += 1
             return self._resolve_sweep(rid, handle, designs, streamed,
                                        terminal, replica_id, failover,
-                                       t0)
+                                       t0, trace, t_wall)
         if streamed and len(done) == len(designs):
             # every design's chunk arrived but the terminal line was
             # lost: the checkpoints ARE the result — synthesize the
@@ -876,20 +1071,25 @@ class Router:
                 rid, handle, designs, streamed,
                 {"event": "sweep_result", "rid": rid, "status": "ok",
                  "n_designs": len(designs)},
-                streamed[-1].get("replica"), True, t0)
+                streamed[-1].get("replica"), True, t0, trace, t_wall)
         status = ("rejected_circuit"
                   if not attempted and breaker_skips else "failed")
         with self._lock:
             self.stats["failed"] += 1
+        self.trace_ring.record(
+            "sweep_ingress", trace, t_wall, time.perf_counter() - t0,
+            proc="router", status=status)
         self._resolve(rid, handle._pend, wire.sweep_result_from_doc({
             "rid": rid, "status": status, "n_designs": len(designs),
+            "trace_id": getattr(trace, "trace_id", None),
             "error": f"no replica served the sweep "
                      f"(tried {len(order)}; last: {last_err})"},
             chunks=streamed))
         handle._close()
 
     def _resolve_sweep(self, rid, handle, designs, streamed, terminal,
-                       replica_id, failover, t0):
+                       replica_id, failover, t0, trace=None,
+                       t_wall=None):
         """Reassemble the terminal SweepResult from the relayed chunk
         checkpoints.  After a failover the last replica's terminal line
         describes only its sub-sweep, so the per-sweep fields are
@@ -920,5 +1120,13 @@ class Router:
         res = wire.sweep_result_from_doc(term, chunks=streamed, rid=rid)
         res.replica = replica_id
         res.latency_s = time.perf_counter() - t0
+        if res.trace_id is None and trace is not None:
+            res.trace_id = trace.trace_id
+        self._hist_latency.observe(res.latency_s)
+        if t_wall is not None:
+            self.trace_ring.record(
+                "sweep_ingress", trace, t_wall, res.latency_s,
+                proc="router", replica=replica_id,
+                status=term.get("status"), failover=failover)
         self._resolve(rid, handle._pend, res)
         handle._close()

@@ -46,6 +46,7 @@ import queue
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qsl
 
 from raft_tpu.chaos import get_injector
 from raft_tpu.resilience import TransientError
@@ -90,6 +91,15 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(payload)
 
+    def _send_text(self, code, text,
+                   content_type="text/plain; version=0.0.4"):
+        payload = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(payload)))
+        self.end_headers()
+        self.wfile.write(payload)
+
     def _chunk(self, doc):
         data = (wire.dumps(doc) + "\n").encode()
         self.wfile.write(b"%x\r\n" % len(data) + data + b"\r\n")
@@ -102,7 +112,7 @@ class _Handler(BaseHTTPRequestHandler):
     # -- routes -----------------------------------------------------
 
     def do_GET(self):
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
         if path == "/healthz":
             return self._send_json(200, {"status": "alive",
                                          "uptime_s": round(
@@ -111,13 +121,44 @@ class _Handler(BaseHTTPRequestHandler):
             ready, probe = self.transport.readiness()
             return self._send_json(200 if ready else 503, probe)
         if path == "/statz":
-            return self._send_json(200, self.transport.backend.snapshot())
+            doc = self.transport.backend.snapshot()
+            registry = getattr(self.transport.backend, "metrics", None)
+            if registry is not None:
+                doc = dict(doc)
+                doc["metrics"] = registry.to_doc()
+            return self._send_json(200, doc)
+        if path == "/metricz":
+            # Prometheus text exposition (docs/observability.md)
+            registry = getattr(self.transport.backend, "metrics", None)
+            if registry is None:
+                return self._send_json(
+                    404, {"error": "backend has no metrics registry"})
+            return self._send_text(200, registry.render_prometheus())
+        if path == "/tracez":
+            ring = getattr(self.transport.backend, "trace_ring", None)
+            if ring is None:
+                return self._send_json(
+                    404, {"error": "backend has no trace ring"})
+            params = dict(parse_qsl(query))
+            try:
+                limit = int(params["limit"]) if "limit" in params \
+                    else None
+            except ValueError:
+                return self._send_json(
+                    400, {"error": f"bad limit {params['limit']!r}"})
+            spans = ring.spans(limit=limit,
+                               trace_id=params.get("trace_id"))
+            doc = {"spans": spans, "n_spans": len(spans)}
+            doc.update(ring.snapshot())
+            return self._send_json(200, doc)
         return self._send_json(404, {"error": f"no route {path}"})
 
     def do_POST(self):
         path, _, query = self.path.partition("?")
         if path == "/v1/sweep":
             return self._post_sweep()
+        if path == "/profilez":
+            return self._post_profilez()
         if path != "/v1/solve":
             return self._send_json(404, {"error": f"no route {path}"})
         if self.transport.draining:
@@ -140,7 +181,8 @@ class _Handler(BaseHTTPRequestHandler):
         stream = "stream=0" not in query
         try:
             handle = self.transport.backend.submit(
-                design, cases=cases, deadline_s=deadline_s)
+                design, cases=cases, deadline_s=deadline_s,
+                trace=wire.parse_trace(doc))
         except RuntimeError as e:           # backend already stopped
             return self._send_json(503, {"error": str(e)})
 
@@ -174,6 +216,28 @@ class _Handler(BaseHTTPRequestHandler):
             # handle (terminal-status guarantee is server-side).
             self.close_connection = True
 
+    def _post_profilez(self):
+        """``POST /profilez`` — arm a one-shot profiler capture around
+        the backend's next dispatch window (docs/observability.md).
+        Body is optional JSON ``{"log_dir": ...}``; with no body the
+        backend falls back to ``RAFT_TPU_PROFILE_DIR``."""
+        backend = self.transport.backend
+        capture = getattr(backend, "capture_profile", None)
+        if capture is None:
+            return self._send_json(
+                404, {"error": "backend has no profiler hook"})
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            if length > MAX_BODY_BYTES:
+                return self._send_json(413, {"error": "body too large"})
+            body = json.loads(self.rfile.read(length)) if length else {}
+        except Exception as e:  # noqa: BLE001 — bad body, keep serving
+            return self._send_json(
+                400, {"error": f"{type(e).__name__}: {e}"})
+        doc = capture(log_dir=body.get("log_dir"))
+        code = 200 if doc.get("armed", True) else 409
+        return self._send_json(code, doc)
+
     def _post_sweep(self):
         """``POST /v1/sweep`` — always streamed NDJSON: an ``accepted``
         line (rid, n_designs, n_chunks) as soon as admission takes the
@@ -201,7 +265,8 @@ class _Handler(BaseHTTPRequestHandler):
                 400, {"error": f"{type(e).__name__}: {e}"})
         try:
             handle = self.transport.backend.submit_sweep(
-                designs, cases=cases, chunk=chunk)
+                designs, cases=cases, chunk=chunk,
+                trace=wire.parse_trace(doc))
         except (RuntimeError, ValueError) as e:   # stopped / empty sweep
             return self._send_json(503, {"error": str(e)})
         self.transport.note_accept(handle.rid)
@@ -360,6 +425,34 @@ class WireClient:
         finally:
             conn.close()
 
+    def get_text(self, path, timeout=10.0):
+        """GET a text endpoint (``/metricz``) -> (status_code, str)."""
+        conn = self._conn(timeout)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            return resp.status, resp.read().decode()
+        finally:
+            conn.close()
+
+    def post_json(self, path, doc, timeout=30.0):
+        """POST a small JSON document (``/profilez``) -> response doc."""
+        body = wire.dumps(doc or {}).encode()
+        conn = self._conn(timeout)
+        try:
+            try:
+                conn.request("POST", path, body=body, headers={
+                    "Content-Type": "application/json"})
+                resp = conn.getresponse()
+                return json.loads(resp.read())
+            except (ConnectionError, http.client.HTTPException,
+                    TimeoutError, OSError, ValueError) as e:
+                raise ConnectionDropped(
+                    f"{self.host}:{self.port}: "
+                    f"{type(e).__name__}: {e}") from e
+        finally:
+            conn.close()
+
     def solve(self, doc, on_sent=None, slow_s=None):
         """POST a request document, stream the response, return the
         terminal result document.  ``on_sent`` fires after the request
@@ -392,12 +485,17 @@ class WireClient:
                             http.client.HTTPException):
                         err = {"error": f"HTTP {resp.status} "
                                         f"(unparseable error body)"}
-                    if err.get("error") == "draining":
-                        # refused before admission (drain-first
-                        # retirement): safe to re-attempt elsewhere
+                    if resp.status == 503:
+                        # refused before admission — the drain gate, or
+                        # submit() raising on an engine that finished
+                        # shutting down between the gate check and the
+                        # admission call (the retirement-window race).
+                        # Either way the request was never served, so it
+                        # is safe to re-attempt elsewhere.
                         raise ConnectionDropped(
                             f"{self.host}:{self.port} is draining; "
-                            f"request refused before admission")
+                            f"request refused before admission "
+                            f"({err.get('error', 'unavailable')})")
                     return {"event": "result", "rid": err.get("rid", -1),
                             "status": err.get("status", "failed"),
                             "http_status": resp.status,
@@ -450,10 +548,13 @@ class WireClient:
                             http.client.HTTPException):
                         err = {"error": f"HTTP {resp.status} "
                                         f"(unparseable error body)"}
-                    if err.get("error") == "draining":
+                    if resp.status == 503:
+                        # same retirement-window rule as solve(): a 503
+                        # is always refused-before-admission, retryable
                         raise ConnectionDropped(
                             f"{self.host}:{self.port} is draining; "
-                            f"sweep refused before admission")
+                            f"sweep refused before admission "
+                            f"({err.get('error', 'unavailable')})")
                     return ({"event": "sweep_result",
                              "rid": err.get("rid", -1),
                              "status": err.get("status", "failed"),

@@ -7,7 +7,9 @@ Request document::
     {"design": <design dict | path str>,   # required
      "cases":  [...],                      # optional case rows
      "deadline_s": 10.0,                   # optional admission deadline
-     "xi": true}                           # include complex amplitudes
+     "xi": true,                           # include complex amplitudes
+     "trace": {"trace_id": "…16 hex…",     # optional trace context
+               "parent_span_id": "…"}}     # (docs/observability.md)
 
 Terminal result document (one per request — the engine's exactly-once
 terminal-status guarantee means every accepted rid produces exactly one
@@ -94,6 +96,15 @@ def parse_request(doc):
     return design, cases, deadline_s, bool(doc.get("xi", False))
 
 
+def parse_trace(doc):
+    """The request document's trace context, or None.  Delegates to
+    obs.tracing's validation: a malformed trace section downgrades to
+    untraced, it never fails the request."""
+    from raft_tpu.obs.tracing import TraceContext
+
+    return TraceContext.from_doc(doc.get("trace"))
+
+
 def result_doc(res, include_xi=False):
     """RequestResult -> terminal result document (a superset of the
     legacy stdin-loop line, so existing consumers keep working)."""
@@ -111,6 +122,8 @@ def result_doc(res, include_xi=False):
         doc["bucket"] = res.bucket.as_dict()
     if res.replica is not None:
         doc["replica"] = res.replica
+    if getattr(res, "trace_id", None):
+        doc["trace_id"] = res.trace_id
     if res.status == "ok":
         std = np.asarray(res.std)
         doc["std"] = std.tolist()
@@ -159,6 +172,7 @@ def result_from_doc(doc, rid=None):
         batch_occupancy=float(doc.get("batch_occupancy", 0.0)),
         backend=doc.get("backend"),
         replica=doc.get("replica"),
+        trace_id=doc.get("trace_id"),
     )
 
 
@@ -259,6 +273,8 @@ def sweep_result_doc(res):
         doc["error"] = res.error
     if res.replica is not None:
         doc["replica"] = res.replica
+    if getattr(res, "trace_id", None):
+        doc["trace_id"] = res.trace_id
     return doc
 
 
@@ -305,6 +321,7 @@ def sweep_result_from_doc(doc, chunks=None, rid=None):
         latency_s=float(doc.get("latency_s", 0.0)),
         suspend_s=float(doc.get("suspend_s", 0.0)),
         replica=doc.get("replica"),
+        trace_id=doc.get("trace_id"),
     )
 
 

@@ -25,23 +25,53 @@ import json
 import os
 import threading
 import time
+from collections import deque
 from contextlib import contextmanager
 
-__all__ = ["Tracer", "trace_path_from_env"]
+__all__ = ["Tracer", "trace_path_from_env", "chrome_trace_from_spans",
+           "DEFAULT_MAX_SPANS"]
+
+#: span-buffer bound: a serve process with RAFT_TPU_TRACE set used to
+#: grow ``spans`` without limit; past this the oldest spans roll off
+#: and ``Tracer.dropped`` counts them
+DEFAULT_MAX_SPANS = 65536
+
+
+class _SpanBuffer(deque):
+    """Bounded append-only span store with a dropped-span counter —
+    list-compatible for every consumer in this module (append, iterate)
+    and for the tests that inject spans directly."""
+
+    def __init__(self, capacity):
+        super().__init__(maxlen=max(int(capacity), 1))
+        self.dropped = 0
+
+    def append(self, item):
+        if len(self) == self.maxlen:
+            self.dropped += 1
+        super().append(item)
 
 
 class Tracer:
     """Monotonic span recorder.  Thread-safe; negligible overhead
-    (one ``perf_counter`` pair and a dict per span)."""
+    (one ``perf_counter`` pair and a dict per span).  The span store is
+    BOUNDED (``max_spans``, default 65536): beyond it the oldest spans
+    are dropped and counted in :attr:`dropped` — a long-running serve
+    process with ``RAFT_TPU_TRACE`` set stays flat in memory."""
 
-    def __init__(self, label="raft_tpu"):
+    def __init__(self, label="raft_tpu", max_spans=DEFAULT_MAX_SPANS):
         self.label = label
-        self.spans = []
+        self.spans = _SpanBuffer(max_spans)
         self._lock = threading.Lock()
         # wall-clock anchor so chrome traces from different processes
         # can be lined up if needed
         self.t0_unix = time.time()
         self.t0 = time.perf_counter()
+
+    @property
+    def dropped(self):
+        """Spans lost to the bounded buffer (0 in any sane run)."""
+        return self.spans.dropped
 
     # ------------------------------------------------------------ recording
 
@@ -215,7 +245,8 @@ class Tracer:
         ]
         return {"traceEvents": meta + events,
                 "displayTimeUnit": "ms",
-                "otherData": {"t0_unix": self.t0_unix}}
+                "otherData": {"t0_unix": self.t0_unix,
+                              "dropped_spans": self.spans.dropped}}
 
     def dump(self, path):
         """Atomic (write-then-rename) chrome-trace dump."""
@@ -235,3 +266,41 @@ class Tracer:
 
 def trace_path_from_env():
     return os.environ.get("RAFT_TPU_TRACE") or None
+
+
+def chrome_trace_from_spans(spans, label="raft_tpu_trace"):
+    """Stitch cross-process span documents (raft_tpu/obs/tracing.py
+    shape: absolute unix ``t0`` + ``dur_s``, a ``proc`` tag per
+    process) into ONE chrome://tracing JSON object — one tid per proc,
+    timeline re-anchored at the earliest span.  This is what
+    ``Router.gather_trace`` emits: router-ingress, wire, and
+    replica-side stage spans of one trace_id on a single timeline."""
+    done = [s for s in spans if "t0" in s and "dur_s" in s]
+    if not done:
+        return {"traceEvents": [], "displayTimeUnit": "ms",
+                "otherData": {"label": label}}
+    anchor = min(s["t0"] for s in done)
+    tids = {}
+    events = []
+    for s in sorted(done, key=lambda x: x["t0"]):
+        proc = s.get("proc", "proc")
+        tid = tids.setdefault(proc, len(tids) + 1)
+        args = dict(s.get("meta") or {})
+        for key in ("trace_id", "span_id", "parent_span_id"):
+            if s.get(key):
+                args[key] = s[key]
+        events.append({
+            "name": s.get("name", "span"), "cat": proc, "ph": "X",
+            "ts": (s["t0"] - anchor) * 1e6, "dur": s["dur_s"] * 1e6,
+            "pid": 1, "tid": tid, "args": args,
+        })
+    meta = [
+        {"name": "process_name", "ph": "M", "pid": 1,
+         "args": {"name": label}},
+    ] + [
+        {"name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+         "args": {"name": proc}}
+        for proc, tid in tids.items()
+    ]
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms",
+            "otherData": {"label": label, "t0_unix": anchor}}

@@ -60,6 +60,7 @@ uninterrupted one (pinned in tests/test_serve_sweep.py).
 
 import dataclasses
 import os
+import time
 from functools import lru_cache
 
 import numpy as np
@@ -232,6 +233,9 @@ class SuspendedWaterfall:
     rungs: list
     yields: int = 1
     flops: float = 0.0              # executed-flops ledger so far
+    trace: object = None            # obs TraceContext — parked with the
+    span_ring: object = None        # lane state so a resumed dispatch
+                                    # keeps recording under ONE trace_id
 
     @property
     def survivors(self):
@@ -277,9 +281,26 @@ def last_dispatch_stats():
 def waterfall_dispatch(physics, nodes_slots, args_slots, relax=0.8,
                        block=None, kernel=None, slab=None,
                        shared_nodes=False, should_yield=None,
-                       resume=None):
+                       resume=None, trace=None, span_ring=None):
     """Run flattened (design x case) lanes through the iteration
     waterfall.
+
+    When ``RAFT_TPU_PROFILE_DIR`` is set, the FIRST dispatch of the
+    process runs under ``jax.profiler`` capture (obs/profiler.py
+    ``env_capture`` — the env read lives there, not here, so the flag
+    never touches this module's code-version hash).
+    """
+    from raft_tpu.obs.profiler import env_capture
+
+    return env_capture(lambda: _waterfall_entry(
+        physics, nodes_slots, args_slots, relax, block, kernel, slab,
+        shared_nodes, should_yield, resume, trace, span_ring))
+
+
+def _waterfall_entry(physics, nodes_slots, args_slots, relax,
+                     block, kernel, slab, shared_nodes, should_yield,
+                     resume, trace, span_ring):
+    """The dispatch body behind the profiler shim.
 
     physics : raft_tpu.serve.buckets.SlotPhysics (the scalars/frequency
         grid baked into the phase executables — same key the serve
@@ -331,9 +352,9 @@ def waterfall_dispatch(physics, nodes_slots, args_slots, relax=0.8,
             nodes_s = nodes_slots if shared_nodes else jax.tree.map(
                 lambda a: a[sl], nodes_slots)
             args_s = tuple(a[sl] for a in args_slots)
-            outs.append(waterfall_dispatch(
-                physics, nodes_s, args_s, relax=relax, block=block,
-                kernel=kernel, slab=S, shared_nodes=shared_nodes))
+            outs.append(_waterfall_entry(
+                physics, nodes_s, args_s, relax, block,
+                kernel, S, shared_nodes, None, None, trace, span_ring))
             st = last_dispatch_stats()
             if agg is None:
                 agg = st
@@ -371,7 +392,7 @@ def waterfall_dispatch(physics, nodes_slots, args_slots, relax=0.8,
         physics, float(relax), K, bool(kernel), bool(shared_nodes),
         L, Lq, nodes_p, operands, nodes_p, operands, state, ids,
         None, 0, 0, 0, [], 0, block_fn, finalize_fn, should_yield,
-        flops)
+        flops, trace=trace, span_ring=span_ring)
 
 
 def _waterfall_resume(sus, should_yield=None):
@@ -391,14 +412,15 @@ def _waterfall_resume(sus, should_yield=None):
         sus.L, sus.Lq, nodes_p, operands_full, nodes_cur, operands,
         state, np.array(sus.ids), sus.state_store, sus.trips,
         sus.blocks, sus.lane_iters, list(sus.rungs), sus.yields,
-        block_fn, finalize_fn, should_yield, sus.flops)
+        block_fn, finalize_fn, should_yield, sus.flops,
+        trace=sus.trace, span_ring=sus.span_ring)
 
 
 def _waterfall_loop(physics, relax, K, kernel, shared_nodes, L, Lq,
                     nodes_p, operands_full, nodes_cur, operands, state,
                     ids, state_store, trips, blocks, lane_iters, rungs,
                     yields, block_fn, finalize_fn, should_yield,
-                    flops=0.0):
+                    flops=0.0, trace=None, span_ring=None):
     """The block/retire/compact loop shared by fresh and resumed
     dispatches — one code path, so suspension cannot change the
     scheduler's decisions (same rung sequence, same retire trips)."""
@@ -416,12 +438,21 @@ def _waterfall_loop(physics, relax, K, kernel, shared_nodes, L, Lq,
 
     while True:
         rungs.append(len(ids))
+        b_wall = time.time()
+        b0 = time.perf_counter()
         state = block_fn(nodes_cur, *operands, state)
         flops += _fn_flops(block_fn, (nodes_cur,) + operands + (state,))
         blocks += 1
         trips += K
         lane_iters += len(ids) * K
         done = np.asarray(state[4])
+        if span_ring is not None:
+            # per-K-block span: the np.asarray above is the sync point,
+            # so the span covers dispatch -> device-ready for this block
+            span_ring.record(
+                "wf_block", trace, b_wall,
+                time.perf_counter() - b0,
+                rung=len(ids), block=blocks, k=K)
         retire = done | (trips >= max_trips)
         real = ids >= 0
         retiring = retire & real
@@ -461,7 +492,8 @@ def _waterfall_loop(physics, relax, K, kernel, shared_nodes, L, Lq,
                 state=tuple(np.asarray(leaf) for leaf in state),
                 ids=np.array(ids), state_store=state_store,
                 trips=trips, blocks=blocks, lane_iters=lane_iters,
-                rungs=list(rungs), yields=yields + 1, flops=flops)
+                rungs=list(rungs), yields=yields + 1, flops=flops,
+                trace=trace, span_ring=span_ring)
 
     # scatter the retired per-lane loop states back into original lane
     # order (exact: no arithmetic touches a state after its lane's last

@@ -307,6 +307,88 @@ def test_flags_catch_surface_key_and_stale_doc_row(tmp_path):
     assert "RAFT_TPU_GONE:doc-stale" in idents
 
 
+# ------------------------------------------------------ metrics-hygiene
+
+_METRICS_DOCS = """\
+    # serving
+
+    ## Metrics
+
+    | metric | kind |
+    | --- | --- |
+    | `raft_tpu_engine_<stat>_total` | counter family |
+    """
+
+_METRICS_ENGINE = """\
+    class Engine:
+        def __init__(self, registry):
+            self.stats = registry.stats_view(
+                "engine", {"requests": 0, "ok": 0})
+
+        def bump(self):
+            self.stats["requests"] += 1
+
+        def family(self, status):
+            self.stats[status] += 1
+    """
+
+
+def test_metrics_catch_undeclared_literal_stats_bump(tmp_path):
+    root = _tree(tmp_path, {
+        "raft_tpu/serve/engine.py": _METRICS_ENGINE + """\
+
+        def bad(self):
+            self.stats["surprise"] += 1
+        """,
+        "docs/serving.md": _METRICS_DOCS,
+    })
+    idents = _idents(_run(root, "metrics-hygiene", tmp_path))
+    # the undeclared literal bump fires; declared keys and the dynamic
+    # status-family subscript stay quiet
+    assert "Engine:surprise" in idents
+    assert "Engine:requests" not in idents
+
+
+def test_metrics_quiet_on_declared_keys_and_family_row(tmp_path):
+    root = _tree(tmp_path, {
+        "raft_tpu/serve/engine.py": _METRICS_ENGINE,
+        "docs/serving.md": _METRICS_DOCS,
+    })
+    assert not _run(root, "metrics-hygiene", tmp_path).findings
+
+
+def test_metrics_catch_undocumented_name_and_stale_row(tmp_path):
+    root = _tree(tmp_path, {
+        "raft_tpu/serve/engine.py": """\
+            class Engine:
+                def __init__(self, registry):
+                    self._h = registry.histogram(
+                        "raft_tpu_engine_latency_seconds", "latency")
+            """,
+        "docs/serving.md": """\
+            # serving
+
+            ## Metrics
+
+            | metric | kind |
+            | --- | --- |
+            | `raft_tpu_gone_total` | counter |
+            """,
+    })
+    idents = _idents(_run(root, "metrics-hygiene", tmp_path))
+    assert "raft_tpu_engine_latency_seconds" in idents    # no doc row
+    assert "raft_tpu_gone_total:doc-stale" in idents      # dead row
+
+
+def test_metrics_catch_missing_table(tmp_path):
+    root = _tree(tmp_path, {
+        "raft_tpu/serve/engine.py": _METRICS_ENGINE,
+        "docs/serving.md": "# serving — no metrics section\n",
+    })
+    idents = _idents(_run(root, "metrics-hygiene", tmp_path))
+    assert "missing-metrics-table" in idents
+
+
 # ------------------------------------------------------ legacy rules
 
 def test_bare_except_fixture(tmp_path):

@@ -202,6 +202,21 @@ def test_serve_load_section_pinned_in_compact_schema():
         assert key in bench._COMPACT_KEYS, key
 
 
+def test_serve_obs_section_pinned_in_compact_schema():
+    """The observability bench keys (ISSUE 15) stay wired: the load
+    section reports the engine-side (replica-merged) histogram
+    quantiles next to the loadgen-observed ones, and the span-recording
+    A/B section reports the instrumentation overhead on served solo p50
+    (budget <= 2%, docs/observability.md) — all on the compact driver
+    line."""
+    assert callable(bench.bench_serve_obs_overhead)
+    for key in ("serve_load_engine_p50_ms", "serve_load_engine_p95_ms",
+                "serve_load_engine_p99_ms",
+                "serve_obs_overhead_pct", "serve_obs_p50_on_ms",
+                "serve_obs_p50_off_ms", "serve_obs_error"):
+        assert key in bench._COMPACT_KEYS, key
+
+
 def test_analysis_section_pinned_in_compact_schema():
     """The static-analysis gate (docs/analysis.md) stays wired: the
     entry point exists and the rule/finding counts ride the compact

@@ -102,10 +102,11 @@ def test_retire_replica_drains_in_flight_to_terminal(router2):
     assert router2.retire_replica(cand)
     assert cand not in router2.replicas
     assert router2.stats["scale_ins"] == 1
-    statuses = [h.result(timeout=400).status for h in handles]
+    results = [h.result(timeout=400) for h in handles]
     # drain-first: every accepted rid reached a terminal status, and
     # none was lost to the retirement
-    assert statuses == ["ok"] * 4, statuses
+    assert [r.status for r in results] == ["ok"] * 4, \
+        [(r.rid, r.status, r.error) for r in results]
     assert router2.probe()["replicas_alive"] == 2
 
 
@@ -157,6 +158,16 @@ def test_midstream_kill_failover_recomputes_only_remaining_chunks(
         assert np.array_equal(ref.report[key], killed.report[key]), key
     assert killed.failed_idx == ref.failed_idx == []
     assert router2.probe()["replicas_alive"] == 1
+    # ONE trace_id spans the whole sweep, chunk-failover resubmit
+    # included: the resubmission re-sent the same id to the survivor
+    tid = killed.trace_id
+    assert isinstance(tid, str) and len(tid) == 16
+    assert tid != ref.trace_id
+    spans = router2.trace_ring.spans(trace_id=tid)
+    sweep_wire = [s for s in spans if s["name"] == "sweep_wire"]
+    assert len(sweep_wire) >= 2
+    assert any(s["meta"].get("outcome") == "retry" for s in sweep_wire)
+    assert len({s["meta"].get("replica") for s in sweep_wire}) == 2
 
 
 def test_engine_probe_counters_without_traffic():

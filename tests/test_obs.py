@@ -1,0 +1,406 @@
+"""Observability layer (raft_tpu/obs + serve endpoints): acceptance.
+
+Unit tier (no engine): registry instruments and their streaming
+quantiles, the Prometheus text exposition schema, the StatsView
+legacy-dict bridge, the bounded span ring + dropped counter, trace
+context wire round-trips, the ``RAFT_TPU_OBS_SPANS`` kill switch, and
+the one-shot profiler hook (env path included, via
+``RAFT_TPU_PROFILE_DIR``).
+
+Served tier (one module engine): ``GET /metricz`` parses as Prometheus
+text and carries the engine counters/histograms, ``GET /tracez``
+serves the bounded ring with ``limit``/``trace_id`` filters,
+``POST /profilez`` arms exactly one capture (second POST answers 409)
+and the next dispatch writes ``capture.json``, and a request served
+with span recording off is ``np.array_equal``-identical to the traced
+answer.
+"""
+
+import http.client
+import json
+import os
+import re
+
+import numpy as np
+import pytest
+
+from raft_tpu.designs import deep_spar
+from raft_tpu.obs.metrics import (
+    LATENCY_BUCKETS_S,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from raft_tpu.obs.tracing import SpanRing, TraceContext
+from raft_tpu.serve import Engine, EngineConfig, WireClient, serve_http
+
+NW = (0.05, 0.5)    # small frequency grid keeps compiles cheap
+
+
+def _spar(rho_fill=1800.0):
+    d = deep_spar(n_cases=2, nw_settings=NW)
+    d["platform"]["members"][0]["rho_fill"] = [float(rho_fill), 0.0, 0.0]
+    return d
+
+
+# ------------------------------------------------------------ instruments
+
+def test_counter_and_gauge_basics():
+    c = Counter("raft_tpu_test_total", help="a counter")
+    c.inc()
+    c.inc(3)
+    assert c.get() == 4
+    lines = c.render()
+    assert lines[0] == "# HELP raft_tpu_test_total a counter"
+    assert lines[1] == "# TYPE raft_tpu_test_total counter"
+    assert lines[2] == "raft_tpu_test_total 4"
+    g = Gauge("raft_tpu_test_depth")
+    g.set(2.5)
+    assert g.get() == 2.5
+    assert "# TYPE raft_tpu_test_depth gauge" in g.render()
+    with pytest.raises(ValueError, match="invalid metric name"):
+        Counter("bad name")
+
+
+def test_latency_buckets_are_log_spaced_and_ascending():
+    assert list(LATENCY_BUCKETS_S) == sorted(LATENCY_BUCKETS_S)
+    assert LATENCY_BUCKETS_S[0] == 1e-4
+    assert LATENCY_BUCKETS_S[-1] == 100.0
+    # four per decade: six decades + the closing bound
+    assert len(LATENCY_BUCKETS_S) == 25
+
+
+def test_histogram_quantiles_stream_from_bucket_counts():
+    h = Histogram("raft_tpu_test_seconds", buckets=(1.0, 2.0, 4.0, 8.0))
+    assert h.quantile(0.5) is None           # empty
+    for _ in range(100):
+        h.observe(1.5)                       # lands in (1, 2]
+    # rank interpolates linearly within the landing bucket
+    assert h.quantile(0.5) == pytest.approx(1.5)
+    assert h.quantile(0.99) == pytest.approx(1.99)
+    # beyond the top bound: +Inf bucket, quantile clamps to the bound
+    h2 = Histogram("raft_tpu_test2_seconds", buckets=(1.0, 2.0))
+    h2.observe(50.0)
+    assert h2.quantile(0.99) == 2.0
+    doc = h.to_doc()
+    assert doc["count"] == 100
+    assert doc["sum"] == pytest.approx(150.0)
+    assert doc["p50"] == pytest.approx(1.5)
+    with pytest.raises(ValueError, match="ascending"):
+        Histogram("raft_tpu_bad_seconds", buckets=(2.0, 1.0))
+
+
+def test_histogram_render_is_cumulative_prometheus():
+    h = Histogram("raft_tpu_test_seconds", buckets=(1.0, 2.0))
+    for v in (0.5, 1.5, 3.0):
+        h.observe(v)
+    lines = h.render()
+    assert 'raft_tpu_test_seconds_bucket{le="1"} 1' in lines
+    assert 'raft_tpu_test_seconds_bucket{le="2"} 2' in lines
+    assert 'raft_tpu_test_seconds_bucket{le="+Inf"} 3' in lines
+    assert "raft_tpu_test_seconds_sum 5" in lines
+    assert "raft_tpu_test_seconds_count 3" in lines
+
+
+def test_quantile_from_counts_merges_replica_histograms():
+    from raft_tpu.obs.metrics import quantile_from_counts
+
+    a = Histogram("raft_tpu_a_seconds", buckets=(1.0, 2.0, 4.0))
+    b = Histogram("raft_tpu_b_seconds", buckets=(1.0, 2.0, 4.0))
+    for _ in range(50):
+        a.observe(1.5)
+        b.observe(1.5)
+    merged = [x + y for x, y in zip(a.to_doc()["buckets"],
+                                    b.to_doc()["buckets"])]
+    # bucket-wise sum then quantile == the single-histogram answer
+    assert quantile_from_counts(merged, 0.5, bounds=(1.0, 2.0, 4.0)) \
+        == pytest.approx(a.quantile(0.5))
+    assert quantile_from_counts([0, 0, 0, 0], 0.5,
+                                bounds=(1.0, 2.0, 4.0)) is None
+
+
+def test_registry_get_or_create_and_kind_conflict():
+    reg = MetricsRegistry()
+    a = reg.counter("raft_tpu_x_total")
+    assert reg.counter("raft_tpu_x_total") is a
+    with pytest.raises(TypeError, match="already registered"):
+        reg.histogram("raft_tpu_x_total")
+    reg.gauge("raft_tpu_depth")
+    assert reg.names() == ["raft_tpu_depth", "raft_tpu_x_total"]
+    assert reg.get("raft_tpu_nope") is None
+
+
+# prometheus text lines: comments or `name[{labels}] value`
+_PROM_LINE = re.compile(
+    r"^(# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* .*"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? -?[0-9.eE+-]+(e[+-]?\d+)?"
+    r"|[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^{}]*\})? [+-]?Inf|NaN)$")
+
+
+def _assert_prometheus_text(text):
+    assert text.endswith("\n")
+    typed = set()
+    for line in text.splitlines():
+        assert _PROM_LINE.match(line), f"bad exposition line: {line!r}"
+        if line.startswith("# TYPE"):
+            typed.add(line.split()[2])
+    # every sample belongs to a typed family
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        name = line.split("{")[0].split(" ")[0]
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        assert name in typed or base in typed, name
+    return typed
+
+
+def test_registry_renders_parseable_prometheus_text():
+    reg = MetricsRegistry()
+    reg.counter("raft_tpu_req_total", help="requests").inc(2)
+    reg.gauge("raft_tpu_depth", help="queue depth").set(1.0)
+    reg.histogram("raft_tpu_lat_seconds", help="latency").observe(0.01)
+    typed = _assert_prometheus_text(reg.render_prometheus())
+    assert {"raft_tpu_req_total", "raft_tpu_depth",
+            "raft_tpu_lat_seconds"} <= typed
+
+
+def test_stats_view_keeps_legacy_dict_contract():
+    reg = MetricsRegistry()
+    stats = reg.stats_view("engine", {
+        "requests": 0, "ok": 0, "latency_s": [], "flag": False,
+        "note": None})
+    stats["requests"] += 1
+    stats["requests"] += 1
+    stats["latency_s"].append(0.5)
+    assert stats["requests"] == 2
+    assert stats.get("nope") is None
+    assert "ok" in stats and len(stats) == 5
+    assert list(stats) == ["requests", "ok", "latency_s", "flag", "note"]
+    assert dict(stats.items())["latency_s"] == [0.5]
+    # int keys became registry counters; list/bool/None stayed local
+    assert reg.get("raft_tpu_engine_requests_total").get() == 2
+    assert reg.get("raft_tpu_engine_flag_total") is None
+    # a runtime-created int key (status family) creates its counter
+    stats["watchdog_timeout"] = 1
+    stats["watchdog_timeout"] += 1
+    assert reg.get("raft_tpu_engine_watchdog_timeout_total").get() == 2
+
+
+# ------------------------------------------------------------- span ring
+
+def test_span_ring_is_bounded_and_counts_drops():
+    ring = SpanRing(capacity=8)
+    trace = TraceContext.new()
+    for i in range(20):
+        ring.record("stage", trace, float(i), 0.001, rid=i)
+    snap = ring.snapshot()
+    assert snap["capacity"] == 8
+    assert snap["held"] == 8
+    assert snap["recorded"] == 20
+    assert snap["dropped"] == 12
+    spans = ring.spans()
+    assert len(spans) == 8
+    assert [s["meta"]["rid"] for s in spans] == list(range(12, 20))
+    assert len(ring.spans(limit=3)) == 3
+    other = TraceContext.new()
+    ring.record("stage", other, 99.0, 0.001)
+    assert [s["trace_id"] for s in ring.spans(trace_id=other.trace_id)] \
+        == [other.trace_id]
+    # untraced work records nothing
+    assert ring.record("stage", None, 0.0, 0.0) is None
+    assert ring.snapshot()["recorded"] == 21
+
+
+def test_tracer_span_buffer_is_bounded():
+    from raft_tpu.trace import Tracer
+
+    tr = Tracer("test", max_spans=4)
+    for i in range(10):
+        tr.add(f"s{i}", 0.001)
+    assert len(tr.spans) == 4
+    assert tr.dropped == 6
+    chrome = tr.chrome_trace()
+    assert chrome["otherData"]["dropped_spans"] == 6
+
+
+def test_trace_context_wire_roundtrip():
+    t = TraceContext.new()
+    assert re.fullmatch(r"[0-9a-f]{16}", t.trace_id)
+    assert re.fullmatch(r"[0-9a-f]{8}", t.span_id)
+    doc = json.loads(json.dumps(t.to_doc()))
+    back = TraceContext.from_doc(doc)
+    assert back.trace_id == t.trace_id
+    assert back.span_id == t.span_id      # parent_span_id carries over
+    child = t.child()
+    assert child.trace_id == t.trace_id and child.span_id != t.span_id
+    # malformed sections never fail a request
+    assert TraceContext.from_doc(None) is None
+    assert TraceContext.from_doc("x") is None
+    assert TraceContext.from_doc({}) is None
+    assert TraceContext.from_doc({"trace_id": 7}) is None
+
+
+def test_obs_spans_env_kill_switch(monkeypatch):
+    ring = SpanRing(capacity=8)
+    trace = TraceContext.new()
+    monkeypatch.setenv("RAFT_TPU_OBS_SPANS", "0")
+    assert ring.record("stage", trace, 0.0, 0.001) is None
+    assert ring.snapshot()["held"] == 0
+    monkeypatch.setenv("RAFT_TPU_OBS_SPANS", "1")
+    assert ring.record("stage", trace, 0.0, 0.001) is not None
+    assert ring.snapshot()["held"] == 1
+
+
+# ------------------------------------------------------------- profiler
+
+def test_profiler_hook_is_one_shot_and_nonreentrant(tmp_path):
+    from raft_tpu.obs.profiler import ProfilerHook
+
+    hook = ProfilerHook()
+    assert hook.snapshot() == {"armed_dir": None, "last": None}
+    doc = hook.arm(tmp_path / "prof")
+    assert doc["armed"] is True
+    # arming while a capture is pending is refused (the /profilez 409)
+    again = hook.arm(tmp_path / "other")
+    assert again["armed"] is False and "already armed" in again["error"]
+    assert hook.run(lambda: 41 + 1) == 42
+    last = hook.snapshot()["last"]
+    assert last is not None and last["wall_s"] >= 0.0
+    assert hook.snapshot()["armed_dir"] is None    # disarmed itself
+    # disarmed: the fast path runs the fn untouched
+    assert hook.run(lambda: 7) == 7
+    assert hook.snapshot()["last"] is last
+
+
+def test_profiler_env_capture_is_once_per_process(tmp_path, monkeypatch):
+    from raft_tpu.obs import profiler
+
+    monkeypatch.setenv("RAFT_TPU_PROFILE_DIR", str(tmp_path / "env"))
+    was_done = profiler._ENV_DONE[0]
+    profiler._ENV_DONE[0] = False
+    try:
+        assert profiler.env_capture(lambda: 3) == 3
+        assert profiler._ENV_DONE[0]
+        # second window: no capture, just the fn
+        assert profiler.env_capture(lambda: 4) == 4
+    finally:
+        profiler._ENV_DONE[0] = was_done
+    monkeypatch.delenv("RAFT_TPU_PROFILE_DIR")
+    assert profiler.profile_dir_from_env() is None
+
+
+# ---------------------------------------------------- served endpoints
+
+@pytest.fixture(scope="module")
+def served_obs(tmp_path_factory):
+    """One engine + HTTP front end shared by the module (compiles
+    once); the warm solve seeds the histograms and the span ring."""
+    eng = Engine(EngineConfig(
+        precision="float64", window_ms=20.0,
+        cache_dir=str(tmp_path_factory.mktemp("serve_obs"))))
+    transport = serve_http(eng)
+    client = WireClient("127.0.0.1", transport.port)
+    warm = eng.evaluate(_spar(), timeout=600)
+    assert warm.status == "ok", warm.error
+    yield eng, transport, client, warm
+    transport.close()
+    eng.shutdown()
+
+
+def test_metricz_serves_prometheus_text(served_obs):
+    eng, _, client, _warm = served_obs
+    code, text = client.get_text("/metricz")
+    assert code == 200
+    typed = _assert_prometheus_text(text)
+    assert "raft_tpu_engine_requests_total" in typed
+    assert "raft_tpu_engine_request_latency_seconds" in typed
+    # the warm request landed in the counters and the histogram
+    sample = re.search(r"^raft_tpu_engine_requests_total (\d+)$",
+                       text, re.M)
+    assert sample and int(sample.group(1)) >= 1
+    count = re.search(
+        r"^raft_tpu_engine_request_latency_seconds_count (\d+)$",
+        text, re.M)
+    assert count and int(count.group(1)) >= 1
+
+
+def test_statz_carries_registry_section(served_obs):
+    eng, _, client, _warm = served_obs
+    code, doc = client.get("/statz")
+    assert code == 200
+    metrics = doc["metrics"]
+    assert metrics["raft_tpu_engine_requests_total"]["kind"] == "counter"
+    hist = metrics["raft_tpu_engine_request_latency_seconds"]
+    assert hist["kind"] == "histogram"
+    assert hist["value"]["count"] >= 1
+    assert hist["value"]["p50"] is not None
+    # legacy snapshot keys still read through the stats view
+    assert doc["requests"] == eng.snapshot()["requests"]
+    assert doc["trace_spans"]["recorded"] >= 1
+
+
+def test_tracez_serves_bounded_ring_with_filters(served_obs):
+    eng, _, client, warm = served_obs
+    code, doc = client.get("/tracez")
+    assert code == 200
+    for key in ("spans", "n_spans", "capacity", "held", "recorded",
+                "dropped"):
+        assert key in doc
+    assert doc["n_spans"] == len(doc["spans"]) >= 1
+    assert doc["held"] <= doc["capacity"]
+    code, doc = client.get("/tracez?limit=1")
+    assert code == 200 and doc["n_spans"] == 1
+    code, doc = client.get(f"/tracez?trace_id={warm.trace_id}")
+    assert code == 200 and doc["n_spans"] >= 1
+    assert {s["trace_id"] for s in doc["spans"]} == {warm.trace_id}
+    names = {s["name"] for s in doc["spans"]}
+    assert "dispatch" in names and "admission" in names
+    code, _doc = client.get("/tracez?limit=nope")
+    assert code == 400
+
+
+def test_profilez_arms_one_capture_then_409(served_obs, tmp_path):
+    eng, transport, client, _warm = served_obs
+    log_dir = str(tmp_path / "capture")
+    doc = client.post_json("/profilez", {"log_dir": log_dir})
+    assert doc["armed"] is True and doc["log_dir"] == log_dir
+    # second POST while armed: 409 on the wire, armed=False in the body
+    conn = http.client.HTTPConnection("127.0.0.1", transport.port,
+                                      timeout=30)
+    try:
+        conn.request("POST", "/profilez",
+                     body=json.dumps({"log_dir": log_dir}).encode(),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        assert resp.status == 409
+        assert json.loads(resp.read())["armed"] is False
+    finally:
+        conn.close()
+    # the next dispatch window runs under the capture and disarms
+    res = eng.evaluate(_spar(1750.0), timeout=600)
+    assert res.status == "ok", res.error
+    snap = eng.snapshot()["profiler"]
+    assert snap["armed_dir"] is None
+    assert snap["last"] is not None
+    assert snap["last"].get("error") is None, snap["last"]
+    cap_path = os.path.join(log_dir, "capture.json")
+    assert os.path.exists(cap_path)
+    cap = json.loads(open(cap_path).read())
+    assert cap["wall_s"] > 0.0
+    assert "device_memory" in cap and "waterfall" in cap
+
+
+def test_untraced_answer_is_bit_identical(served_obs, monkeypatch):
+    """RAFT_TPU_OBS_SPANS=0 (the bench A/B off-leg) changes telemetry
+    only: the served answer keeps the exact same bits."""
+    eng, _, _, warm = served_obs
+    recorded_before = eng.trace_ring.snapshot()["recorded"]
+    monkeypatch.setenv("RAFT_TPU_OBS_SPANS", "0")
+    quiet = eng.evaluate(_spar(), timeout=600)
+    monkeypatch.delenv("RAFT_TPU_OBS_SPANS")
+    assert quiet.status == "ok", quiet.error
+    assert np.array_equal(quiet.Xi, warm.Xi)
+    assert np.array_equal(quiet.std, warm.std)
+    # and no spans were recorded for it
+    assert eng.trace_ring.snapshot()["recorded"] == recorded_before

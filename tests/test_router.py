@@ -214,6 +214,26 @@ def test_same_physics_routes_to_same_replica(router2):
         assert res.replica == expected
 
 
+def test_gather_trace_stitches_cross_process_timeline(router2):
+    """A routed request's trace stitches router + replica spans into
+    one timeline whose root reconciles with the observed latency."""
+    res = router2.evaluate(_spar(2600.0), timeout=400)
+    assert res.status == "ok", res.error
+    doc = router2.gather_trace(res.trace_id)
+    spans = doc["spans"]
+    assert doc["n_spans"] == len(spans) >= 2
+    assert {s["trace_id"] for s in spans} == {res.trace_id}
+    procs = {s["proc"] for s in spans}
+    assert "router" in procs and "engine" in procs
+    # replica-side spans say which replica they came from
+    assert any(s["meta"].get("replica") for s in spans
+               if s["proc"] == "engine")
+    # the stitched root is the e2e latency (ISSUE acceptance: <= 5%)
+    assert abs(doc["e2e_s"] - res.latency_s) <= 0.05 * res.latency_s
+    assert 0.0 < doc["coverage"] <= 1.0 + 1e-9
+    assert len(doc["chrome"]["traceEvents"]) >= len(spans)
+
+
 def test_replica_kill_retries_on_other_replica_bit_identically(
         router2, monkeypatch):
     d = _spar()
@@ -230,6 +250,16 @@ def test_replica_kill_retries_on_other_replica_bit_identically(
     assert retried.replica != first.replica
     assert np.array_equal(retried.Xi, first.Xi)
     assert router2.probe()["replicas_alive"] == 1
+    # ONE trace_id spans both attempts: the retry re-sent the same id
+    tid = retried.trace_id
+    assert isinstance(tid, str) and len(tid) == 16
+    assert tid != first.trace_id       # distinct requests, distinct traces
+    spans = router2.trace_ring.spans(trace_id=tid)
+    assert {s["trace_id"] for s in spans} == {tid}
+    wire_spans = [s for s in spans if s["name"] == "wire"]
+    assert len(wire_spans) >= 2
+    assert any(s["meta"].get("outcome") == "retry" for s in wire_spans)
+    assert any(s["meta"].get("outcome") == "ok" for s in wire_spans)
 
 
 def test_warm_one_warm_all_via_shared_cache(router2, shared_cache):

@@ -142,6 +142,7 @@ def swept(tmp_path_factory):
         out["loaded"] = h.result(600)
         out["probes"] = probes
         out["snap"] = eng.snapshot()
+        out["spans"] = eng.trace_ring.spans()
 
         transport = serve_http(eng, port=0)
         try:
@@ -197,6 +198,16 @@ def test_preempted_sweep_bit_identical_to_uninterrupted(swept):
     for p in swept["probes"]:
         assert p.status == "ok"
         assert np.array_equal(p.Xi, swept["warm"].Xi)
+    # preemption kept ONE trace identity: every chunk span of the
+    # loaded run — suspended and resumed included — carries the
+    # handle's trace_id, and the probes traced separately
+    tid = loaded.trace_id
+    assert isinstance(tid, str) and len(tid) == 16
+    chunk_spans = [s for s in swept["spans"]
+                   if s["trace_id"] == tid and s["name"] == "sweep_chunk"]
+    assert len(chunk_spans) == loaded.n_chunks
+    assert any(s["meta"].get("preemptions", 0) >= 1 for s in chunk_spans)
+    assert tid not in {p.trace_id for p in swept["probes"]}
 
 
 def test_http_sweep_stream_reassembles_to_engine_bits(swept):

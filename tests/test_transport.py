@@ -258,3 +258,28 @@ def test_drain_resolves_inflight_to_terminal_lines(tmp_path):
     except Exception as e:  # noqa: BLE001 — any refusal proves closed
         code = type(e).__name__
     assert code is not None
+
+
+def test_any_503_is_refused_before_admission_and_retryable(tmp_path):
+    """The retirement-window race: an engine that finishes shutting
+    down between the transport's drain-gate check and ``submit()``
+    answers with a generic 503 ("engine is shut down"), not the
+    drain gate's ``{"error": "draining"}``.  The client must surface
+    EVERY 503 as ``ConnectionDropped`` — the request was never
+    admitted, so the router retries it on the next ring replica —
+    never as a terminal 'failed' (which would break the drain-first
+    "no accepted rid is lost to retirement" guarantee)."""
+    eng = Engine(EngineConfig(precision="float64", window_ms=20.0,
+                              cache_dir=str(tmp_path)))
+    transport = serve_http(eng)
+    client = WireClient("127.0.0.1", transport.port)
+    try:
+        eng.shutdown()      # transport gate still open: not draining
+        assert not transport.draining
+        with pytest.raises(ConnectionDropped, match="before admission"):
+            client.solve({"design": _spar()})
+        with pytest.raises(ConnectionDropped, match="before admission"):
+            client.sweep({"designs": [_spar()]})
+    finally:
+        transport.close()
+        eng.shutdown()
