@@ -110,6 +110,10 @@ _COMPACT_KEYS = (
     "serve_load_engine_p99_ms",
     "serve_obs_overhead_pct", "serve_obs_p50_on_ms",
     "serve_obs_p50_off_ms",
+    "serve_cache_hit_p50_ms", "serve_cache_warm_p50_ms",
+    "serve_cache_speedup", "serve_cache_zipf_hit_rate",
+    "serve_cache_corrupt_check",
+    "smoke_cache_ratio", "smoke_cache_bits",
     "smoke_load_goodput", "smoke_load_bits",
     "sweep_cold_start_s", "sweep_warm_start_s", "sweep_warm_vs_cold",
     "sweep_prep_wall_s", "sweep_prep_solo_wall_s", "sweep_prep_batched",
@@ -123,6 +127,7 @@ _COMPACT_KEYS = (
     "serve_sweep_error", "serve_sweep_smoke_error",
     "serve_load_error", "serve_load_smoke_error",
     "serve_obs_error",
+    "serve_cache_error", "serve_cache_smoke_error",
     "sweep_waterfall_error",
     "perf_docs_error", "sweep_scaling_error", "sweep1024_error",
     "sweep4096_error", "serve_multichip_error", "multichip_smoke_error",
@@ -403,6 +408,7 @@ def main(argv=None):
                     ("serve_http_smoke", bench_serve_http_smoke),
                     ("serve_sweep_smoke", bench_serve_sweep_smoke),
                     ("serve_load_smoke", bench_serve_load_smoke),
+                    ("serve_cache_smoke", bench_serve_cache_smoke),
                     ("chaos_smoke", bench_chaos_smoke),
                     ("prep_smoke", bench_batched_prep_smoke),
                     ("multichip_smoke", bench_multichip_smoke),
@@ -466,6 +472,7 @@ def main(argv=None):
             ("serve_http", bench_serve_http, 6.0),
             ("serve_sweep", bench_serve_sweep, 8.0),
             ("serve_load", bench_serve_load, 6.0),
+            ("serve_cache", bench_serve_cache, 3.0),
             ("serve_obs", bench_serve_obs_overhead, 2.0),
             ("serve_multichip", bench_serve_multichip, 0.5),
             ("kernel", bench_kernels, 0.5),
@@ -1547,6 +1554,208 @@ def bench_serve_load():
                                 if d["action"] == "heal"),
         "serve_load_decisions": decisions,
         "serve_load_s": round(time.perf_counter() - t0, 3),
+    }
+
+
+def _wait_cache_stores(eng, n, timeout=30.0):
+    """Result-cache population happens after the handle resolves; wait
+    for the stores counter so hit measurements never race the write."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if eng.snapshot()["result_cache_stores"] >= n:
+            return
+        time.sleep(0.01)
+    raise TimeoutError(f"result_cache_stores never reached {n}")
+
+
+def bench_serve_cache(n_requests=20):
+    """Exact-answer result cache (ISSUE 17): warm-solve vs cache-hit
+    p50 (acceptance: hit p50 <= 0.25x warm solve p50), the measured
+    hit-rate under the Zipfian loadgen popularity mode
+    (``RAFT_TPU_LOADGEN_ZIPF`` realism: repeat-heavy traffic over a
+    bounded variant pool), and the corrupt-entry recompute check — a
+    flipped entry under ``corrupt_result_cache`` must yield a counted
+    quarantine and bit-identical recomputed answers."""
+    import tempfile
+
+    from raft_tpu.designs import deep_spar
+    from raft_tpu.loadgen import LoadgenConfig, run_phase, warm_pool
+    from raft_tpu.serve import Engine, EngineConfig
+
+    t0 = time.perf_counter()
+    design = deep_spar(n_cases=2, nw_settings=(0.05, 0.5))
+
+    def p50(lats):
+        return sorted(lats)[len(lats) // 2]
+
+    with tempfile.TemporaryDirectory() as tmp:
+        with Engine(EngineConfig(precision="float64", window_ms=1.0,
+                                 cache_dir=tmp,
+                                 use_result_cache=True)) as eng:
+            cache = eng._result_cache
+
+            def purge():
+                for name in os.listdir(cache.dir):
+                    os.remove(os.path.join(cache.dir, name))
+
+            warm = eng.evaluate(design, timeout=560)
+            assert warm.status == "ok", warm.error
+            _wait_cache_stores(eng, 1)
+            # ---- warm SOLVE p50: prep + executable warm, but the
+            # stored entry purged before each round -> every evaluate
+            # takes the full dispatch path.  Population is async, so
+            # wait for the previous round's store to land before
+            # purging — a late store after purge() would turn the next
+            # "miss" into a hit and contaminate the solve p50.
+            base_stores = eng.snapshot()["result_cache_stores"]
+            solve_lats = []
+            for i in range(n_requests):
+                _wait_cache_stores(eng, base_stores + i)
+                purge()
+                t = time.perf_counter()
+                r = eng.evaluate(design, timeout=560)
+                solve_lats.append(time.perf_counter() - t)
+                assert r.status == "ok", r.error
+            _wait_cache_stores(eng, base_stores + n_requests)
+            ref = eng.evaluate(design, timeout=560)
+            # ---- cache-HIT p50 against the repopulated entry
+            hit_lats = []
+            for _ in range(n_requests):
+                t = time.perf_counter()
+                r = eng.evaluate(design, timeout=560)
+                hit_lats.append(time.perf_counter() - t)
+                assert r.status == "ok", r.error
+            assert np.array_equal(r.Xi, ref.Xi)     # hits: exact bits
+            snap_hits = eng.snapshot()
+            assert snap_hits["result_cache_hits"] >= n_requests
+
+            # ---- Zipfian hit-rate: popularity-skewed traffic over the
+            # bounded pool, cache populated by the traffic itself
+            cfg = LoadgenConfig(rate_hz=10.0, duration_s=4.0, seed=7,
+                                zipf=1.2, distinct=6, sweep_n=2,
+                                p_sweep=0.1, p_tight=0.0,
+                                canary_every=3)
+            for h in [eng.submit(b) for b in warm_pool(cfg, design)]:
+                r = h.result(timeout=560)
+                assert r.status == "ok", r.error
+            stores_now = eng.snapshot()["result_cache_stores"]
+            _wait_cache_stores(eng, stores_now)
+            purge()                    # hit-rate from popularity alone
+            before = eng.snapshot()
+            phase = run_phase(eng, cfg, design, name="zipf")
+            after = eng.snapshot()
+            assert phase["lost"] == 0, phase
+            assert phase["bits_identical"] is True, phase
+            hits = after["result_cache_hits"] - before["result_cache_hits"]
+            misses = (after["result_cache_misses"]
+                      - before["result_cache_misses"])
+            hit_rate = hits / max(1, hits + misses)
+
+            # ---- corrupt-entry recompute check: purge first so the
+            # evaluate is a miss whose store the fault can corrupt (a
+            # hit would never reach the store path)
+            stores_now = eng.snapshot()["result_cache_stores"]
+            _wait_cache_stores(eng, stores_now)
+            purge()
+            old_chaos = os.environ.get("RAFT_TPU_CHAOS")
+            os.environ["RAFT_TPU_CHAOS"] = "corrupt_result_cache*1:3"
+            try:
+                poisoned_entry = eng.evaluate(design, timeout=560)
+                assert poisoned_entry.status == "ok", poisoned_entry.error
+                _wait_cache_stores(eng, stores_now + 1)
+            finally:
+                if old_chaos is None:
+                    os.environ.pop("RAFT_TPU_CHAOS", None)
+                else:
+                    os.environ["RAFT_TPU_CHAOS"] = old_chaos
+            recomputed = eng.evaluate(design, timeout=560)
+            snap = eng.snapshot()
+            assert snap["result_cache_corrupt"] >= 1, snap
+            corrupt_check = (
+                "identical"
+                if recomputed.status == "ok"
+                and poisoned_entry.status == "ok"
+                and np.array_equal(recomputed.Xi, poisoned_entry.Xi)
+                else "WRONG BITS")
+            assert corrupt_check == "identical"
+
+    speedup = p50(solve_lats) / p50(hit_lats)
+    assert p50(hit_lats) <= 0.25 * p50(solve_lats), (
+        f"hit p50 {p50(hit_lats):.5f}s > 0.25x warm solve p50 "
+        f"{p50(solve_lats):.5f}s")
+    return {
+        "serve_cache_warm_p50_ms": round(p50(solve_lats) * 1e3, 3),
+        "serve_cache_hit_p50_ms": round(p50(hit_lats) * 1e3, 3),
+        "serve_cache_speedup": round(speedup, 2),
+        "serve_cache_zipf_hit_rate": round(hit_rate, 4),
+        "serve_cache_zipf_offered": phase["offered"],
+        "serve_cache_corrupt_check": corrupt_check,
+        "serve_cache_corrupt_refused": snap["result_cache_corrupt"],
+        "serve_cache_bytes": snap["result_cache_bytes"],
+        "serve_cache_s": round(time.perf_counter() - t0, 3),
+    }
+
+
+def bench_serve_cache_smoke():
+    """Tier-1-safe result-cache smoke: one engine, one design — a cold
+    solve, a bit-identical hit (ratio recorded), and the corrupt-entry
+    recompute check."""
+    import tempfile
+
+    from raft_tpu.designs import deep_spar
+    from raft_tpu.serve import Engine, EngineConfig
+
+    t0 = time.perf_counter()
+    design = deep_spar(n_cases=2, nw_settings=(0.05, 0.5))
+    with tempfile.TemporaryDirectory() as tmp:
+        with Engine(EngineConfig(precision="float64", window_ms=1.0,
+                                 cache_dir=tmp,
+                                 use_result_cache=True)) as eng:
+            t = time.perf_counter()
+            cold = eng.evaluate(design, timeout=560)
+            t_cold = time.perf_counter() - t        # prep + solve
+            assert cold.status == "ok", cold.error
+            _wait_cache_stores(eng, 1)
+            t = time.perf_counter()
+            warm = eng.evaluate(design, timeout=560)
+            t_hit = time.perf_counter() - t         # served from cache
+            assert warm.status == "ok", warm.error
+            bits = ("identical"
+                    if np.array_equal(warm.Xi, cold.Xi)
+                    and np.array_equal(warm.std, cold.std)
+                    else "DIFFERENT")
+            assert bits == "identical", bits
+            snap = eng.snapshot()
+            assert snap["result_cache_hits"] >= 1, snap
+            stores_before = snap["result_cache_stores"]
+            old_chaos = os.environ.get("RAFT_TPU_CHAOS")
+            os.environ["RAFT_TPU_CHAOS"] = "corrupt_result_cache*1:3"
+            try:
+                d2 = deep_spar(n_cases=2, nw_settings=(0.05, 0.5))
+                d2["platform"]["members"][0]["rho_fill"] = [
+                    1500.0, 0.0, 0.0]
+                ref = eng.evaluate(d2, timeout=560)
+                assert ref.status == "ok", ref.error
+                # population is async AND the entry is corrupted just
+                # after it becomes visible — wait for the store to
+                # finish so the next evaluate sees the corrupted bytes,
+                # not the brief valid window before corrupt_if lands
+                _wait_cache_stores(eng, stores_before + 1)
+            finally:
+                if old_chaos is None:
+                    os.environ.pop("RAFT_TPU_CHAOS", None)
+                else:
+                    os.environ["RAFT_TPU_CHAOS"] = old_chaos
+            recomputed = eng.evaluate(d2, timeout=560)
+            snap = eng.snapshot()
+            assert snap["result_cache_corrupt"] >= 1, snap
+            assert np.array_equal(recomputed.Xi, ref.Xi)
+    return {
+        "smoke_cache_ratio": round(t_cold / max(1e-9, t_hit), 1),
+        "smoke_cache_hit_ms": round(t_hit * 1e3, 3),
+        "smoke_cache_bits": bits,
+        "smoke_cache_corrupt_refused": snap["result_cache_corrupt"],
+        "smoke_cache_s": round(time.perf_counter() - t0, 3),
     }
 
 

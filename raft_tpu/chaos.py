@@ -62,6 +62,17 @@ Fault classes and their hook points:
                     (serve/transport.py, ``WireClient.solve``) — the
                     router must retry on the next ring replica,
                     bit-identically
+``corrupt_result_cache``  a just-written solve-RESULT cache entry
+                    (serve/result_cache.py) is overwritten with garbage
+                    — ``get`` must refuse + delete it and the engine
+                    recompute bit-identical answers, never serve the
+                    corrupt bytes
+``dup_inflight``    a single-flight COALESCING LEADER (serve/router.py)
+                    stalls ``value`` seconds (default 0.25, the window
+                    followers pile in during) and then fails WITHOUT
+                    forwarding — its coalesced followers must NOT
+                    inherit the failure: each retries with a fresh
+                    dispatch under its own rid, bit-identically
 ==================  ======================================================
 
 Per-rid targeting caveat: the engine deduplicates prep per design key,
@@ -89,10 +100,10 @@ CHAOS_ENV = "RAFT_TPU_CHAOS"
 
 FAULTS = ("prep_raise", "prep_slow", "nan_lane", "dispatch_stall",
           "backend_error", "corrupt_cache", "conn_drop", "replica_kill",
-          "replica_slow")
+          "replica_slow", "corrupt_result_cache", "dup_inflight")
 
 _DEFAULT_VALUES = {"prep_slow": 1.0, "dispatch_stall": 5.0,
-                   "replica_slow": 0.5}
+                   "replica_slow": 0.5, "dup_inflight": 0.25}
 
 
 class ChaosError(RuntimeError):

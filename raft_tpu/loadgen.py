@@ -54,7 +54,19 @@ figure, not a tax on every arrival.
 ``RAFT_TPU_LOADGEN_SWEEP_N``    3       designs per sweep request
 ``RAFT_TPU_LOADGEN_TIGHT_S``    2.0     deadline of ``tight`` requests
 ``RAFT_TPU_LOADGEN_DISTINCT``   8       variant-pool size per class
+``RAFT_TPU_LOADGEN_ZIPF``       0.0     Zipf exponent for variant
+                                        popularity (0 = round-robin)
 ==============================  ======  =============================
+
+Zipfian popularity (``zipf`` > 0): instead of cycling the variant
+pool round-robin, each request draws its variant index from a seeded
+Zipf(s) distribution over the SAME bounded pool (rank-k weight
+``k**-s``), so repeat-heavy real-world traffic — and therefore a
+result cache's achievable hit-rate — can be measured.  The index
+streams are a pure function of ``config.seed`` (``zipf_indices``),
+the pool stays bounded (``warm_pool`` is unchanged), and canaries
+still reuse the byte-identical base design so ``bits_identical``
+keeps asserting across cached and uncached serves.
 """
 
 import copy
@@ -97,6 +109,7 @@ class LoadgenConfig:
     p_tight: float = 0.15          # fraction with the tight deadline
     canary_every: int = 4          # every k-th solo reuses the base design
     distinct: int = 8              # variant-pool size (see warm_pool)
+    zipf: float = 0.0              # variant popularity skew (0 = cycle)
     collect_timeout_s: float = 120.0
 
     @classmethod
@@ -108,6 +121,7 @@ class LoadgenConfig:
             sweep_n=_env_int("RAFT_TPU_LOADGEN_SWEEP_N", 3),
             tight_deadline_s=_env_float("RAFT_TPU_LOADGEN_TIGHT_S", 2.0),
             distinct=_env_int("RAFT_TPU_LOADGEN_DISTINCT", 8),
+            zipf=_env_float("RAFT_TPU_LOADGEN_ZIPF", 0.0),
         )
         return dataclasses.replace(cfg, **overrides)
 
@@ -141,6 +155,22 @@ def request_mix(n, config):
         else:
             kinds.append("solo")
     return kinds
+
+
+def zipf_indices(n, config, stream):
+    """``n`` variant-pool indices drawn Zipf(``config.zipf``) over
+    ``config.distinct`` ranks — a pure function of ``(config.seed,
+    config.zipf, config.distinct, stream)``, so a phase's popularity
+    schedule replays exactly per seed.  ``stream`` decorrelates the
+    solo and sweep draws from each other and from the arrival/mix
+    streams.  Rank k (0-based index k-1) gets weight ``k**-zipf``:
+    higher exponents concentrate traffic on the head of the pool,
+    which is what makes a result cache's hit-rate measurable."""
+    distinct = max(1, int(config.distinct))
+    ranks = np.arange(1, distinct + 1, dtype=float)
+    w = ranks ** -float(config.zipf)
+    rng = np.random.default_rng(int(config.seed) + int(stream))
+    return rng.choice(distinct, size=int(n), p=w / w.sum())
 
 
 def _ballast_variant(design, i):
@@ -208,6 +238,11 @@ def run_phase(backend, config, design, name="load", chaos=None,
             float(at_frac) * config.duration_s, _arm_chaos, (spec,))
         chaos_timer.daemon = True
         chaos_timer.start()
+    solo_pick = sweep_pick = None
+    if config.zipf > 0.0:
+        solo_pick = zipf_indices(len(arrivals), config, 0x21BF)
+        sweep_pick = zipf_indices(
+            len(arrivals) * max(1, int(config.sweep_n)), config, 0x5EE9)
     t_start = clock()
     solo_seq = 0
     sweep_seq = 0
@@ -219,8 +254,12 @@ def run_phase(backend, config, design, name="load", chaos=None,
             try:
                 if kind == "sweep":
                     h = backend.submit_sweep(
-                        [_ballast_variant(design, 1000 + (sweep_seq + j)
-                                          % config.distinct)
+                        [_ballast_variant(
+                            design,
+                            1000 + int(sweep_pick[sweep_seq
+                                                  * config.sweep_n + j])
+                            if sweep_pick is not None
+                            else 1000 + (sweep_seq + j) % config.distinct)
                          for j in range(config.sweep_n)])
                     sweep_seq += 1
                     flights.append(_Flight("sweep", h,
@@ -229,8 +268,11 @@ def run_phase(backend, config, design, name="load", chaos=None,
                     canary = (kind == "solo"
                               and solo_seq % config.canary_every == 0)
                     body = design if canary \
-                        else _ballast_variant(design,
-                                              solo_seq % config.distinct)
+                        else _ballast_variant(
+                            design,
+                            int(solo_pick[solo_seq])
+                            if solo_pick is not None
+                            else solo_seq % config.distinct)
                     if kind == "solo":
                         solo_seq += 1
                     deadline = config.tight_deadline_s \

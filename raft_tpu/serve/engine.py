@@ -105,6 +105,11 @@ from raft_tpu.serve.cache import (
     topology_flags,
     warmup,
 )
+from raft_tpu.serve.result_cache import (
+    ResultCache,
+    result_key,
+    sweep_chunk_key,
+)
 from raft_tpu.utils.profiling import logger
 
 #: every status a RequestResult can carry; all are terminal.
@@ -171,6 +176,13 @@ class EngineConfig:
         cumulative wall-clock suspended, it stops yielding and runs to
         completion, so sweeps cannot starve under sustained interactive
         load.
+    use_result_cache / result_cache_mb : the exact-answer result cache
+        (serve/result_cache.py): a cache hit short-circuits admission
+        and returns the stored bits; only terminal ``ok`` results with
+        no NaN-quarantined lanes populate it.  Off by default
+        (``RAFT_TPU_RESULT_CACHE`` opts in); ``result_cache_mb`` caps
+        the on-disk bytes (LRU eviction,
+        ``RAFT_TPU_RESULT_CACHE_MB``).
     preempt_block : waterfall block size (K iterations) for PREEMPTIBLE
         sweep dispatches only — a finer K means more block boundaries,
         so interactive requests wait less before the sweep yields.
@@ -225,6 +237,13 @@ class EngineConfig:
     preempt_block: int = dataclasses.field(
         default_factory=lambda: _env_int(
             "RAFT_TPU_SERVE_PREEMPT_BLOCK", 1))
+    use_result_cache: bool = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "RAFT_TPU_RESULT_CACHE", "").strip().lower()
+        in ("1", "true", "on", "yes"))
+    result_cache_mb: float = dataclasses.field(
+        default_factory=lambda: _env_float(
+            "RAFT_TPU_RESULT_CACHE_MB", 256.0))
 
     def __post_init__(self):
         if self.low_water <= 0:
@@ -422,7 +441,7 @@ class _SweepJob:
                  "chunk_idx", "futs", "t_submit", "suspended",
                  "t_suspend", "suspend_wall", "suspend_total",
                  "seg_queue", "chunk_t0", "chunk_failed", "failed",
-                 "out", "preemptions", "trace")
+                 "out", "preemptions", "trace", "chunk_cached")
 
     def __init__(self, rid, designs, cases, handle, chunks, t_submit,
                  trace=None):
@@ -445,6 +464,7 @@ class _SweepJob:
         self.out = None              # aggregate arrays, lazily allocated
         self.preemptions = 0
         self.trace = trace           # TraceContext; rides preemptions too
+        self.chunk_cached = False    # current chunk served from cache
 
     @property
     def pend(self):
@@ -547,6 +567,12 @@ class Engine:
             max_workers=1, thread_name_prefix="raft-sweep-prep")
         self._prep_cache = (PrepCache(self.config.cache_dir)
                             if self.config.use_prep_cache else None)
+        # the exact-answer result cache (serve/result_cache.py): opt-in,
+        # integrity-verified on every read, populated on terminal ok only
+        self._result_cache = (
+            ResultCache(self.config.cache_dir,
+                        cap_mb=self.config.result_cache_mb)
+            if self.config.use_result_cache else None)
         # batched traced prep (RAFT_TPU_BATCHED_PREP): family programs
         # keyed by family_key; False marks a family that failed to build
         self._bp_families = OrderedDict()
@@ -605,8 +631,14 @@ class Engine:
             "batch_requests": [], "prep_cache_hits": 0,
             "prep_memo_hits": 0, "prep_batched_designs": 0,
             "prep_batched_groups": 0, "bucket_compiles": [],
+            "result_cache_hits": 0, "result_cache_misses": 0,
+            "result_cache_stores": 0, "result_cache_evictions": 0,
+            "result_cache_corrupt": 0,
             "first_result_s": None, "warmup": None,
         })
+        self._gauge_result_bytes = self.metrics.gauge(
+            "raft_tpu_engine_result_cache_bytes",
+            "bytes resident in the exact-answer result cache")
         self._t_start = time.perf_counter()
         if self.config.warm_on_start:
             self.stats["warmup"] = warmup(
@@ -638,6 +670,14 @@ class Engine:
         t_wall = time.time()
         if trace is None:
             trace = TraceContext.new()
+        # --- exact-answer result cache probe (off the lock: np.load +
+        # checksum verify must never convoy concurrent submitters) ---
+        cached, cache_refused = None, 0
+        if self._result_cache is not None:
+            cache_key = result_key(design, cases, self.config.precision,
+                                   flags=self._result_cache.flags)
+            cached, cache_refused = \
+                self._result_cache.get_result(cache_key)
         with self._lock:
             if self._stop:
                 raise RuntimeError("engine is shut down")
@@ -646,6 +686,31 @@ class Engine:
             self.stats["requests"] += 1
             pend = _Pending(rid)
             pend.trace_id = trace.trace_id
+            # --- result-cache hit short-circuits BEFORE admission: the
+            # stored bits are the exact answer a dispatch would produce
+            # (verified checksum + flag surface), so neither deadline
+            # rejection nor shedding applies to a ~free serve ---
+            if cache_refused:
+                self.stats["result_cache_corrupt"] += cache_refused
+            if cached is not None:
+                self.stats["result_cache_hits"] += 1
+                self.stats["ok"] += 1
+                self.trace_ring.record(
+                    "admission", trace, t_wall,
+                    time.perf_counter() - now,
+                    status="result_cache_hit", rid=rid)
+                pend._set(RequestResult(
+                    rid=rid, status="ok", Xi=cached["Xi"],
+                    std=cached["std"],
+                    solve_report=cached["solve_report"],
+                    bucket=cached["bucket"],
+                    trace_id=trace.trace_id,
+                    latency_s=time.perf_counter() - now,
+                    batch_requests=1, batch_occupancy=0.0,
+                    backend=cached["backend"]))
+                return pend
+            if self._result_cache is not None:
+                self.stats["result_cache_misses"] += 1
             # --- deadline admission (satellite: reject on submit) ---
             if deadline_s is not None:
                 predicted = self._predicted_wait_locked(now)
@@ -1417,6 +1482,8 @@ class Engine:
             if self._note_segment(job, seg, out):
                 return
         if job.seg_queue is None:
+            if self._try_cached_chunk(job):
+                return
             self._start_chunk(job)
         while job.seg_queue:
             seg = job.seg_queue[0]
@@ -1428,6 +1495,44 @@ class Engine:
             if self._note_segment(job, seg, out):
                 return
         self._finish_chunk(job)
+
+    def _try_cached_chunk(self, job):
+        """Serve the current chunk from the exact-answer result cache
+        when its verified entry exists: scatter the stored aggregate
+        slice (bit-identical to a dispatch — the sweep chunk key covers
+        the chunk's exact designs, cases, precision and flag surface)
+        and emit the normal checkpoint-schema chunk doc, skipping
+        dispatch entirely.  Returns True when the chunk was served."""
+        cache = self._result_cache
+        if cache is None:
+            return False
+        chunk = job.chunks[job.chunk_idx]
+        key = sweep_chunk_key([job.designs[di] for di in chunk],
+                              job.cases, self.config.precision,
+                              flags=cache.flags)
+        hit, refused = cache.get_chunk(key)
+        with self._lock:
+            if refused:
+                self.stats["result_cache_corrupt"] += refused
+            if hit is None:
+                self.stats["result_cache_misses"] += 1
+            else:
+                self.stats["result_cache_hits"] += 1
+        if hit is None:
+            return False
+        job.chunk_t0 = time.perf_counter()
+        job.chunk_failed = []
+        job.suspend_wall = 0.0
+        xr = np.asarray(hit["Xi_r"])
+        self._sweep_alloc_out(job, int(xr.shape[1]), xr[0])
+        sel = np.asarray(chunk, int)
+        job.out["Xi_r"][sel] = xr
+        job.out["Xi_i"][sel] = np.asarray(hit["Xi_i"])
+        for name in SWEEP_REPORT_KEYS:
+            job.out[name][sel] = np.asarray(hit[name])
+        job.chunk_cached = True
+        self._finish_chunk(job)
+        return True
 
     def _start_chunk(self, job):
         """Materialize the current chunk: harvest its prep futures (a
@@ -1545,6 +1650,22 @@ class Engine:
             for name in SWEEP_REPORT_KEYS:
                 doc[name] = job.out[name][sel]
         job.handle._push(doc)
+        # per-chunk population (terminal-ok rule, chunk granularity): a
+        # fully healthy dispatched chunk — no quarantined design, no
+        # NaN lane — is stored under its content key so an overlapping
+        # later sweep serves it without dispatch
+        if (self._result_cache is not None and not job.chunk_cached
+                and job.out is not None and not job.chunk_failed
+                and not np.asarray(doc["nonfinite"]).any()):
+            key = sweep_chunk_key([job.designs[di] for di in chunk],
+                                  job.cases, self.config.precision,
+                                  flags=self._result_cache.flags)
+            arrays = {"Xi_r": doc["Xi_r"], "Xi_i": doc["Xi_i"]}
+            for name in SWEEP_REPORT_KEYS:
+                arrays[name] = doc[name]
+            self._note_cache_store(
+                self._result_cache.put_chunk(key, arrays))
+        job.chunk_cached = False
         self.trace_ring.record(
             "sweep_chunk", job.trace, time.time() - wall, wall,
             rid=job.rid, chunk=job.chunk_idx,
@@ -1892,19 +2013,48 @@ class Engine:
                 self.stats["latency_s"].append(latency)
                 if self.stats["first_result_s"] is None:
                     self.stats["first_result_s"] = latency
-            if self._resolve(pend, RequestResult(
+            result = RequestResult(
                     rid=req.rid, status="ok", Xi=Xi, std=std,
                     solve_report=report_dict(rep), bucket=spec,
                     trace_id=_trace_id_of(req),
                     latency_s=latency, queue_s=t0 - req.t_submit,
                     batch_requests=len(members),
-                    batch_occupancy=occupancy, backend=backend)):
+                    batch_occupancy=occupancy, backend=backend)
+            if self._resolve(pend, result):
                 with self._lock:
                     self.stats["ok"] += 1
+            self._cache_result(req, result)
 
     def _count_dispatch_retry(self, _attempt, _exc):
         with self._lock:
             self.stats["dispatch_retries"] += 1
+
+    # ------------------------------------------------------- result cache
+
+    def _cache_result(self, req, result):
+        """Populate the exact-answer cache from one terminal ``ok`` —
+        the ONLY population point: failed/rejected/watchdog/shutdown
+        outcomes never reach here, and a result with NaN-quarantined
+        lanes is skipped so a degraded answer can never be replayed."""
+        cache = self._result_cache
+        if cache is None:
+            return
+        nonfinite = (result.solve_report or {}).get("nonfinite")
+        if nonfinite is not None and np.asarray(nonfinite).any():
+            return
+        key = result_key(req.design, req.cases, self.config.precision,
+                         flags=cache.flags)
+        self._note_cache_store(cache.put_result(key, result))
+
+    def _note_cache_store(self, evicted):
+        """Account one ``put_result``/``put_chunk`` outcome (``evicted``
+        is the eviction count, or -1 when the write failed)."""
+        with self._lock:
+            if evicted >= 0:
+                self.stats["result_cache_stores"] += 1
+            if evicted > 0:
+                self.stats["result_cache_evictions"] += evicted
+        self._gauge_result_bytes.set(self._result_cache.bytes_total)
 
     # ----------------------------------------------------------- watchdog
 
@@ -1998,6 +2148,13 @@ class Engine:
             "prep_batched_groups": self.stats["prep_batched_groups"],
             "in_flight": len(self._outstanding),
             "sweep_jobs": len(self._sweep_jobs),
+            # coalescing gauges (uniform with Router.probe): the engine
+            # itself never coalesces at the front door, so followers are
+            # 0 here; bytes_total is a plain-int GIL-atomic read
+            "inflight_followers": 0,
+            "result_cache_bytes": (
+                self._result_cache.bytes_total
+                if self._result_cache is not None else 0),
             "shedding": shedding,
             "stopped": stopped,
             "accepting": not (stopped or shedding),
@@ -2063,6 +2220,15 @@ class Engine:
             "prep_memo_hits": self.stats["prep_memo_hits"],
             "prep_batched_designs": self.stats["prep_batched_designs"],
             "prep_batched_groups": self.stats["prep_batched_groups"],
+            "result_cache_hits": self.stats["result_cache_hits"],
+            "result_cache_misses": self.stats["result_cache_misses"],
+            "result_cache_stores": self.stats["result_cache_stores"],
+            "result_cache_evictions":
+                self.stats["result_cache_evictions"],
+            "result_cache_corrupt": self.stats["result_cache_corrupt"],
+            "result_cache_bytes": (
+                self._result_cache.bytes_total
+                if self._result_cache is not None else 0),
             "first_result_s": self.stats["first_result_s"],
             "bucket_compiles": self.stats["bucket_compiles"],
             "warmup": self.stats["warmup"],

@@ -1,0 +1,379 @@
+"""Content-addressed solve-result cache: exact whole-answer memoization.
+
+Determinism makes result caching EXACT here: every request for one
+(design, cases, precision) tuple dispatches through the same fixed-shape
+canonical bucket executable, so the served ``Xi``/``std``/report bits do
+not depend on batch composition, mesh width, preemption, or failover
+(the repo's bit-identity pins).  A cache hit therefore returns the SAME
+bits a cold solve would — ``np.array_equal``, not approximately equal.
+
+What makes a cache on a served path safe is not the hit path but the
+refusal path, and this module is integrity-first:
+
+ - **Keying** — ``result_key`` = sha256 over ``routing_key(design)``
+   (the physics/bucket identity), the FULL design + case table +
+   precision (ballast knobs and all), and ``current_flags()`` (backend,
+   x64, jax/code version, pallas/mixed-precision/fixed-point mode,
+   device topology).  A flag mismatch is a different key — cross-flag
+   entries can never even alias.
+ - **Atomic writes** — one ``.npz`` per key, written to a
+   pid-suffixed tmp name and ``os.replace``d into place (the PR 2
+   checkpoint convention), so concurrent writers on a shared cache dir
+   can interleave freely and a reader can never open a half-written
+   file under the final name.
+ - **Verified reads** — every ``get`` re-derives the payload checksum
+   (sha256 over the raw array bytes) and compares it to the one
+   embedded at write time, re-checks the flag surface with
+   ``flags_mismatch`` and the schema version.  A corrupt, torn, stale,
+   or foreign entry is deleted with a logged reason and counted —
+   NEVER served; the caller recomputes.
+ - **LRU-by-bytes eviction** — ``RAFT_TPU_RESULT_CACHE_MB`` caps the
+   directory; over the cap the oldest-read entries (mtime; reads
+   ``os.utime``-touch their entry) are removed until under it.
+
+The ``corrupt_result_cache`` chaos fault (chaos.py) overwrites a
+just-written entry with garbage exactly like ``corrupt_cache`` does for
+prep entries, closing the loop end to end: a flipped byte yields a
+recompute with bit-identical answers and zero wrong-bit serves
+(tests/test_result_cache.py).
+
+Thread-safety: ``bytes_total`` and eviction run under a private lock;
+the counters and ``bytes_total`` are plain ints so the engine's
+lock-free ``probe()`` can read them GIL-atomically.
+"""
+
+import hashlib
+import itertools
+import json
+import os
+import threading
+import time
+from zipfile import BadZipFile
+
+import numpy as np
+
+from raft_tpu.chaos import get_injector
+from raft_tpu.serve.buckets import BucketSpec
+from raft_tpu.serve.cache import (
+    current_flags,
+    flags_mismatch,
+    serve_cache_dir,
+)
+from raft_tpu.utils.profiling import logger
+
+#: bump when the entry layout changes — an old-schema entry must be
+#: refused (deleted + recomputed), never reinterpreted
+RESULT_SCHEMA = 1
+
+#: per-process tmp-file sequence: the pid alone is NOT a unique writer
+#: id — two dispatch threads storing the same key would share one tmp
+#: path and interleave their writes into a garbage file that the rename
+#: then publishes (caught by the checksum gate, but a refusal where
+#: there should be a clean last-writer-wins overwrite)
+_tmp_seq = itertools.count()
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _flags_blob(flags):
+    return json.dumps(flags, sort_keys=True, default=str).encode()
+
+
+def result_key(design, cases, precision, flags=None):
+    """Content address of one solo request's exact answer.
+
+    ``routing_key`` pins the physics/bucket identity, the full
+    design/cases/precision json pins every remaining knob (ballast
+    fills included — they change bits, unlike the routing key's view),
+    and the flag surface pins the executable family.  Mirrors
+    ``cache.design_prep_key``'s json discipline so the key is stable
+    across processes."""
+    from raft_tpu.serve.router import routing_key
+
+    payload = json.dumps([design, cases, precision], sort_keys=True,
+                         default=float)
+    h = hashlib.sha256(b"result|")
+    h.update(routing_key(design, cases).encode())
+    h.update(payload.encode())
+    h.update(_flags_blob(flags or current_flags()))
+    return h.hexdigest()[:32]
+
+
+def sweep_chunk_key(designs, cases, precision, flags=None):
+    """Content address of one sweep chunk's aggregate slice (the PR 2
+    checkpoint schema arrays).  Keyed on the chunk's EXACT design list,
+    so overlapping sweeps share work only when their chunking lines up
+    on identical designs — never on a near-miss."""
+    payload = json.dumps([designs, cases, precision], sort_keys=True,
+                         default=float)
+    h = hashlib.sha256(b"sweep-chunk|")
+    h.update(payload.encode())
+    h.update(_flags_blob(flags or current_flags()))
+    return h.hexdigest()[:32]
+
+
+def coalesce_key(design, cases=None):
+    """Single-flight identity for router-level in-flight coalescing:
+    two requests with this key equal are guaranteed identical bits
+    (same full design + case table), so the second can ride the first's
+    dispatch.  Flags are deliberately absent — every replica of one
+    deployment shares them, and the router never serves bytes itself;
+    it only shares a *dispatch*."""
+    from raft_tpu.serve.router import routing_key
+
+    payload = json.dumps([design, cases], sort_keys=True, default=float)
+    h = hashlib.sha256(b"single-flight|")
+    h.update(routing_key(design, cases).encode())
+    h.update(payload.encode())
+    return h.hexdigest()[:32]
+
+
+def _payload_checksum(arrays):
+    """sha256 over the raw bytes (+ dtype/shape) of every payload array
+    in name order — the embedded integrity witness ``get`` re-derives."""
+    h = hashlib.sha256()
+    for name in sorted(arrays):
+        a = np.ascontiguousarray(arrays[name])
+        h.update(name.encode())
+        h.update(str(a.dtype).encode())
+        h.update(repr(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+class ResultCache:
+    """One ``result_<key>.npz`` per exact answer under
+    ``<cache_dir>/serve/results/``; see module docstring for the
+    integrity contract.  ``get_*`` returns ``(payload | None,
+    n_refused)`` so the caller can count corrupt-entry quarantines
+    without racing another thread's refusals."""
+
+    def __init__(self, cache_dir=None, cap_mb=None):
+        self.dir = os.path.join(serve_cache_dir(cache_dir), "results")
+        os.makedirs(self.dir, exist_ok=True)
+        if cap_mb is None:
+            cap_mb = _env_float("RAFT_TPU_RESULT_CACHE_MB", 256.0)
+        self.cap_bytes = int(float(cap_mb) * 1e6)
+        self._lock = threading.Lock()
+        # the flag surface is process-stable; freeze it once so the hot
+        # submit path never re-hashes the code-version file set
+        self.flags = current_flags()
+        self.bytes_total = self._scan_bytes()
+
+    # ------------------------------------------------------------ paths
+
+    def _path(self, key):
+        return os.path.join(self.dir, f"result_{key}.npz")
+
+    def _entries(self):
+        out = []
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return out
+        for name in names:
+            if not (name.startswith("result_") and name.endswith(".npz")):
+                continue
+            if ".tmp." in name:            # in-flight write, not an entry
+                continue
+            path = os.path.join(self.dir, name)
+            try:
+                st = os.stat(path)
+            except OSError:
+                continue                   # concurrently evicted: fine
+            out.append((st.st_mtime, st.st_size, path))
+        return out
+
+    def _scan_bytes(self):
+        return sum(size for _mtime, size, _path in self._entries())
+
+    # ------------------------------------------------------------ solo
+
+    def put_result(self, key, res):
+        """Store an ``ok`` RequestResult's answer arrays.  Returns the
+        number of LRU evictions the store forced (-1 when the write
+        itself failed — the cache degrades, the request already has its
+        answer)."""
+        Xi = np.asarray(res.Xi)
+        std = np.asarray(res.std)
+        arrays = {
+            "Xi_re": np.ascontiguousarray(Xi.real),
+            "Xi_im": np.ascontiguousarray(Xi.imag),
+            "std": std,
+        }
+        rep = res.solve_report or {}
+        for name in rep:
+            arrays[f"rep_{name}"] = np.asarray(rep[name])
+        meta = {
+            "kind": "result",
+            "xi_dtype": str(Xi.dtype),
+            "report_keys": sorted(rep),
+            "bucket": (res.bucket.as_dict()
+                       if res.bucket is not None else None),
+            "backend": res.backend,
+        }
+        return self._put(key, arrays, meta)
+
+    def get_result(self, key):
+        """-> (payload dict | None, n_refused).  The payload's ``Xi``/
+        ``std``/``solve_report`` arrays carry the exact stored bits
+        (npz round-trips dtypes; the complex Xi is rebuilt from its
+        re/im planes exactly as serve/wire.py does)."""
+        hit, refused = self._get(key, "result")
+        if hit is None:
+            return None, refused
+        arrays, meta = hit
+        re = arrays["Xi_re"]
+        Xi = np.empty(re.shape, dtype=np.dtype(
+            meta.get("xi_dtype", "complex128")))
+        Xi.real = re
+        Xi.imag = arrays["Xi_im"]
+        report = {name: arrays[f"rep_{name}"]
+                  for name in meta.get("report_keys", [])}
+        bucket = (BucketSpec(**meta["bucket"])
+                  if meta.get("bucket") else None)
+        return {"Xi": Xi, "std": arrays["std"],
+                "solve_report": report or None, "bucket": bucket,
+                "backend": meta.get("backend")}, refused
+
+    # ----------------------------------------------------------- sweeps
+
+    def put_chunk(self, key, arrays):
+        """Store one sweep chunk's aggregate arrays (``Xi_r``/``Xi_i``
+        + the PR 2 checkpoint report keys), already in their exact
+        engine dtypes.  Same return contract as ``put_result``."""
+        return self._put(
+            key, {name: np.asarray(a) for name, a in arrays.items()},
+            {"kind": "sweep_chunk"})
+
+    def get_chunk(self, key):
+        """-> (array dict | None, n_refused)."""
+        hit, refused = self._get(key, "sweep_chunk")
+        if hit is None:
+            return None, refused
+        arrays, _meta = hit
+        return dict(arrays), refused
+
+    # ------------------------------------------------------------- core
+
+    def _put(self, key, arrays, meta):
+        meta = dict(meta)
+        meta["schema"] = RESULT_SCHEMA
+        meta["flags"] = self.flags
+        meta["checksum"] = _payload_checksum(arrays)
+        meta["created"] = time.time()
+        payload = dict(arrays)
+        payload["meta"] = np.array(json.dumps(meta, default=str))
+        path = self._path(key)
+        tmp = path + f".tmp.{os.getpid()}.{next(_tmp_seq)}"
+        try:
+            np.savez(tmp, **payload)
+            # np.savez appends .npz to the tmp name; the rename is the
+            # commit point — readers only ever see whole files
+            os.replace(tmp + ".npz", path)
+        except OSError as e:
+            logger.warning(
+                "result cache: store %s failed (%s: %s); serving "
+                "uncached", key, type(e).__name__, e)
+            try:
+                os.remove(tmp + ".npz")
+            except OSError:
+                pass
+            return -1
+        inj = get_injector()
+        if inj is not None:
+            inj.corrupt_if("corrupt_result_cache", path)
+        with self._lock:
+            try:
+                self.bytes_total += os.path.getsize(path)
+            except OSError:
+                pass                       # already evicted by a peer
+            return self._evict_locked(exclude=path)
+
+    def _get(self, key, kind):
+        """-> ((arrays, meta) | None, n_refused) with every integrity
+        gate applied; an entry failing ANY gate is deleted + counted."""
+        path = self._path(key)
+        if not os.path.exists(path):
+            return None, 0
+        try:
+            with np.load(path, allow_pickle=False) as z:
+                meta = json.loads(str(z["meta"]))
+                if int(meta.get("schema", -1)) != RESULT_SCHEMA:
+                    return None, self._refuse(
+                        key, path, f"schema {meta.get('schema')!r} != "
+                                   f"{RESULT_SCHEMA}")
+                if meta.get("kind") != kind:
+                    return None, self._refuse(
+                        key, path,
+                        f"foreign kind {meta.get('kind')!r}")
+                reason = flags_mismatch(meta.get("flags", {}))
+                if reason:
+                    return None, self._refuse(key, path, reason)
+                arrays = {name: z[name] for name in z.files
+                          if name != "meta"}
+            if _payload_checksum(arrays) != meta.get("checksum"):
+                return None, self._refuse(
+                    key, path, "payload checksum mismatch")
+        except (OSError, ValueError, KeyError, BadZipFile) as e:
+            # np.load raises zipfile.BadZipFile on truncated archives
+            return None, self._refuse(
+                key, path, f"unreadable ({type(e).__name__}: {e})")
+        try:
+            os.utime(path)                 # LRU recency touch
+        except OSError:
+            pass
+        return (arrays, meta), 0
+
+    def _refuse(self, key, path, reason):
+        """Quarantine one entry: log why, delete it, shrink the byte
+        ledger.  Returns 1 (the refusal count the caller reports)."""
+        logger.warning(
+            "result cache: entry %s refused and deleted (%s) — "
+            "recomputing instead of serving suspect bits", key, reason)
+        size = 0
+        try:
+            size = os.path.getsize(path)
+        except OSError:
+            pass
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        with self._lock:
+            self.bytes_total = max(0, self.bytes_total - size)
+        return 1
+
+    def _evict_locked(self, exclude=None):
+        """LRU-by-bytes: while over the cap, remove the least-recently
+        read entries (never the one just written).  Rescans the dir so
+        the ledger self-corrects against concurrent writers sharing the
+        cache dir.  Returns the number of entries evicted."""
+        if self.cap_bytes <= 0 or self.bytes_total <= self.cap_bytes:
+            return 0
+        entries = sorted(self._entries())
+        total = sum(size for _m, size, _p in entries)
+        evicted = 0
+        for _mtime, size, path in entries:
+            if total <= self.cap_bytes:
+                break
+            if path == exclude:
+                continue
+            try:
+                os.remove(path)
+            except OSError:
+                continue                   # a peer evicted it first
+            total -= size
+            evicted += 1
+        if evicted:
+            logger.info(
+                "result cache: evicted %d LRU entr%s (%d bytes / cap "
+                "%d)", evicted, "y" if evicted == 1 else "ies", total,
+                self.cap_bytes)
+        self.bytes_total = total
+        return evicted

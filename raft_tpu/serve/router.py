@@ -31,6 +31,15 @@ replica mid-drain) retry on the next replica in ring-preference order
 forwarding (``deadline_s <= 0`` never crosses the wire) and the
 remaining deadline is re-checked per attempt.
 
+Single-flight dedup (``RAFT_TPU_ROUTER_COALESCE``): identical
+no-deadline requests (``result_cache.coalesce_key`` — full design +
+case table) submitted while one is in flight attach to that leader as
+followers and share its ``ok`` outcome bit-identically, one engine
+dispatch total.  Leader failure is NOT inherited: each follower
+re-dispatches independently under its own rid (the engine prep-dedup
+owner-failure semantics, lifted to the router tier), proven under the
+``dup_inflight`` chaos fault.
+
 Fault injection: the ``replica_kill`` chaos fault (chaos.py) SIGKILLs
 the replica a request was just forwarded to, forcing the
 retry-on-other-replica path (on the sweep path it fires after the first
@@ -59,6 +68,7 @@ accepted request with a terminal status, and forwards answered with
 ``/statz`` gauges.
 """
 
+import dataclasses
 import hashlib
 import json
 import os
@@ -77,6 +87,7 @@ from raft_tpu.obs.tracing import SpanRing, TraceContext
 from raft_tpu.resilience import BreakerBoard, TransientError
 from raft_tpu.serve import wire
 from raft_tpu.serve.engine import _Pending
+from raft_tpu.serve.result_cache import coalesce_key
 from raft_tpu.serve.transport import ConnectionDropped, WireClient
 from raft_tpu.utils.profiling import logger
 
@@ -315,6 +326,20 @@ class _RouterSweepHandle:
         return self._pend.result(timeout)
 
 
+class _Inflight:
+    """Single-flight table entry: followers that attached to one
+    in-flight leader while its forward was outstanding.  Each follower
+    is ``(rid, pend, t0, trace, t_wall)``; appends and the terminal pop
+    both happen under the router lock, so a follower can never attach
+    to an entry the leader has already settled."""
+
+    __slots__ = ("key", "followers")
+
+    def __init__(self, key):
+        self.key = key
+        self.followers = []
+
+
 class Router:
     """See module docstring.  Engine-compatible front surface."""
 
@@ -330,6 +355,10 @@ class Router:
         "replicas": "_lock",
         "_ring": "_lock",
         "_last_scrape_ok": "_lock",
+        # single-flight coalescing table + its follower gauge: attach
+        # (submit) and settle (_finish_coalesce) serialize on the lock
+        "_inflight": "_lock",
+        "_n_followers": "_lock",
     }
     # probe() is the readiness gauge: GIL-atomic len()/dict reads only,
     # so a wedged batcher holding _lock can never wedge the health check
@@ -340,12 +369,24 @@ class Router:
                  replica_argv=(), env_overrides=None,
                  endpoints=None, ready_timeout_s=DEFAULT_READY_TIMEOUT_S,
                  breaker_failures=3, breaker_cooldown_s=5.0,
-                 autoscale=None, autoscale_config=None):
+                 autoscale=None, autoscale_config=None, coalesce=None):
         self.cache_dir = str(cache_dir) if cache_dir else None
         self._lock = threading.Lock()
         self._rid = 0
         self._stop = False
         self._outstanding = {}
+        # single-flight dedup (serve/result_cache.coalesce_key):
+        # identical no-deadline requests submitted while one is in
+        # flight ride that leader's dispatch.  Opt-in
+        # (RAFT_TPU_ROUTER_COALESCE) — leader failure never propagates
+        # to followers (they re-dispatch under their own rid).
+        if coalesce is None:
+            coalesce = os.environ.get(
+                "RAFT_TPU_ROUTER_COALESCE", "").strip().lower() in (
+                "1", "true", "yes", "on")
+        self._coalesce = bool(coalesce)
+        self._inflight = {}          # coalesce key -> _Inflight
+        self._n_followers = 0        # lock-free probe gauge
         self._t_start = time.monotonic()
         # router-tier metrics registry + span ring
         # (docs/observability.md): the stats dict is a StatsView whose
@@ -369,6 +410,7 @@ class Router:
             "chaos_replica_kills": 0, "chaos_replica_slows": 0,
             "sweeps": 0, "sweep_chunk_failovers": 0,
             "scale_outs": 0, "scale_ins": 0, "reaps": 0,
+            "coalesced_followers": 0, "coalesce_leader_failures": 0,
         })
         # spawn recipe kept for scale_out (None in attach mode: the
         # router does not own attached processes, so it cannot grow or
@@ -455,8 +497,25 @@ class Router:
                     "error": f"deadline_s={deadline_s:.3f} already "
                              f"expired at router admission"}))
                 return pend
-        self._pool.submit(self._forward, rid, pend, design, cases,
-                          deadline_s, t0, trace, t_wall)
+            # --- single-flight coalescing (no-deadline requests only:
+            # a follower must be able to outlive a slow leader) ---
+            ckey = None
+            if self._coalesce and deadline_s is None:
+                ckey = coalesce_key(design, cases)
+                leader = self._inflight.get(ckey)
+                if leader is not None:
+                    leader.followers.append(
+                        (rid, pend, t0, trace, t_wall))
+                    self._n_followers += 1
+                    self.stats["coalesced_followers"] += 1
+                    self.trace_ring.record(
+                        "ingress", trace, t_wall,
+                        time.perf_counter() - t0, proc="router",
+                        status="coalesced")
+                    return pend
+                self._inflight[ckey] = _Inflight(ckey)
+        self._pool.submit(self._forward_leader, rid, pend, design,
+                          cases, deadline_s, t0, trace, t_wall, ckey)
         return pend
 
     def evaluate(self, design, cases=None, deadline_s=None, timeout=None):
@@ -501,6 +560,8 @@ class Router:
         return {
             "queue_depth": len(self._outstanding),
             "in_flight": len(self._outstanding),
+            # single-flight gauge: plain-int GIL-atomic read, lock-free
+            "inflight_followers": self._n_followers,
             "shedding": False,
             "stopped": stopped,
             "accepting": not stopped and alive > 0,
@@ -520,6 +581,8 @@ class Router:
         out = dict(self.stats)
         out["in_flight"] = len(self._outstanding)
         out["queue_depth"] = len(self._outstanding)
+        out["inflight_followers"] = self._n_followers
+        out["coalesce"] = self._coalesce
         out["uptime_s"] = round(time.monotonic() - self._t_start, 3)
         out["replicas"] = [r.info() for r in list(self.replicas.values())]
         out["breakers"] = self._breakers.snapshot()
@@ -805,6 +868,81 @@ class Router:
     def _resolve(self, rid, pend, res):
         with self._lock:
             self._resolve_locked(rid, pend, res)
+
+    def _forward_leader(self, rid, pend, design, cases, deadline_s, t0,
+                        trace, t_wall, ckey):
+        """Forward as the single-flight leader for ``ckey`` (None when
+        coalescing is off or the request carries a deadline).  Whatever
+        the leader's fate — served, failed, chaos-killed, or the thread
+        raising — ``_finish_coalesce`` settles every follower: an ``ok``
+        outcome is shared (same bits, the follower's own rid), anything
+        else triggers an independent fresh dispatch per follower."""
+        inj = get_injector()
+        try:
+            rule = (inj.should("dup_inflight", rid)
+                    if inj is not None and ckey is not None else None)
+            if rule is not None:
+                # chaos: stall (the window followers pile in during),
+                # then fail WITHOUT forwarding — the follower-isolation
+                # contract under test (tests/test_result_cache.py)
+                time.sleep(float(rule.value or 0.0))
+                with self._lock:
+                    self.stats["failed"] += 1
+                self._resolve(rid, pend, wire.result_from_doc({
+                    "rid": rid, "status": "failed",
+                    "trace_id": getattr(trace, "trace_id", None),
+                    "error": "chaos-injected dup_inflight: coalescing "
+                             "leader failed before forwarding"}))
+            else:
+                self._forward(rid, pend, design, cases, deadline_s, t0,
+                              trace, t_wall)
+        finally:
+            if ckey is not None:
+                self._finish_coalesce(ckey, pend, design, cases)
+
+    def _finish_coalesce(self, ckey, leader_pend, design, cases):
+        """Settle every follower of one finished leader.  Popping the
+        table entry under the lock closes the attach window: a later
+        identical submit becomes its own leader."""
+        with self._lock:
+            entry = self._inflight.pop(ckey, None)
+            followers = entry.followers if entry is not None else []
+            self._n_followers -= len(followers)
+        if not followers:
+            return
+        res = leader_pend._result if leader_pend.done() else None
+        for frid, fpend, ft0, ftrace, ft_wall in followers:
+            if res is not None and res.status == "ok":
+                copy = dataclasses.replace(
+                    res, rid=frid,
+                    latency_s=time.perf_counter() - ft0,
+                    trace_id=getattr(ftrace, "trace_id", None))
+                with self._lock:
+                    self.stats["ok"] += 1
+                self.trace_ring.record(
+                    "ingress", ftrace, ft_wall, copy.latency_s,
+                    proc="router", replica=res.replica,
+                    status="coalesced_ok")
+                self._resolve(frid, fpend, copy)
+                continue
+            # leader failure is NOT inherited: each follower retries
+            # with a fresh dispatch under its own rid (the prep-dedup
+            # owner-failure semantics, lifted to the router tier)
+            with self._lock:
+                self.stats["coalesce_leader_failures"] += 1
+            logger.warning(
+                "coalescing leader for key %s ended %s; follower "
+                "rid=%d re-dispatching independently", ckey[:8],
+                res.status if res is not None else "unresolved", frid)
+            try:
+                self._pool.submit(self._forward, frid, fpend, design,
+                                  cases, None, ft0, ftrace, ft_wall)
+            except RuntimeError:     # pool already shut down
+                self._resolve(frid, fpend, wire.result_from_doc({
+                    "rid": frid, "status": "shutdown",
+                    "trace_id": getattr(ftrace, "trace_id", None),
+                    "error": "router stopped before the coalesced "
+                             "retry could dispatch"}))
 
     def _forward(self, rid, pend, design, cases, deadline_s, t0,
                  trace=None, t_wall=None):

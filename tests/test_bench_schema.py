@@ -202,6 +202,23 @@ def test_serve_load_section_pinned_in_compact_schema():
         assert key in bench._COMPACT_KEYS, key
 
 
+def test_serve_cache_section_pinned_in_compact_schema():
+    """The exact-answer result-cache bench section (PR 17) stays wired:
+    both entry points exist and the headline keys — warm-solve vs hit
+    p50 (the section asserts hit p50 <= 0.25x warm solve p50), the
+    measured hit-rate under the Zipfian loadgen mode, and the
+    corrupt-entry recompute check (must stay \"identical\") — ride the
+    compact driver line."""
+    assert callable(bench.bench_serve_cache)
+    assert callable(bench.bench_serve_cache_smoke)
+    for key in ("serve_cache_hit_p50_ms", "serve_cache_warm_p50_ms",
+                "serve_cache_speedup", "serve_cache_zipf_hit_rate",
+                "serve_cache_corrupt_check",
+                "smoke_cache_ratio", "smoke_cache_bits",
+                "serve_cache_error", "serve_cache_smoke_error"):
+        assert key in bench._COMPACT_KEYS, key
+
+
 def test_serve_obs_section_pinned_in_compact_schema():
     """The observability bench keys (ISSUE 15) stay wired: the load
     section reports the engine-side (replica-merged) histogram

@@ -22,6 +22,7 @@ from raft_tpu.loadgen import (
     request_mix,
     run_phase,
     warm_pool,
+    zipf_indices,
 )
 
 
@@ -135,6 +136,61 @@ def test_warm_pool_covers_every_submitted_body():
     assert len(submitted) > len(pool)        # the pool actually cycles
     for d in submitted:
         assert d in pool, d
+
+
+def test_zipf_indices_replay_per_seed_and_skew_to_the_head():
+    """The Zipfian popularity stream is a pure function of (seed, zipf,
+    distinct, stream): it replays exactly, decorrelates across streams,
+    stays inside the bounded pool, and concentrates on low ranks."""
+    cfg = LoadgenConfig(seed=3, zipf=1.2, distinct=8)
+    a = zipf_indices(400, cfg, 0x21BF)
+    assert np.array_equal(a, zipf_indices(400, cfg, 0x21BF))
+    assert not np.array_equal(a, zipf_indices(400, cfg, 0x5EE9))
+    assert not np.array_equal(
+        a, zipf_indices(400, dataclasses.replace(cfg, seed=4), 0x21BF))
+    assert a.min() >= 0 and a.max() < cfg.distinct
+    counts = np.bincount(a, minlength=cfg.distinct)
+    assert counts[0] > counts[-1]            # rank-1 dominates the tail
+    assert counts[0] > 400 // cfg.distinct   # skewed, not uniform
+
+
+def test_zipf_env_knob_round_trips(monkeypatch):
+    monkeypatch.delenv("RAFT_TPU_LOADGEN_ZIPF", raising=False)
+    assert LoadgenConfig.from_env().zipf == 0.0
+    monkeypatch.setenv("RAFT_TPU_LOADGEN_ZIPF", "1.1")
+    assert LoadgenConfig.from_env().zipf == 1.1
+
+
+def test_zipf_phase_stays_in_pool_with_identical_canaries():
+    """A Zipfian phase submits only warm-pool bodies (the pool stays
+    bounded — only its popularity changes), repeats the popular variant
+    more than round-robin would, and its canaries remain the
+    byte-identical base design with bits still asserted."""
+    backend = FakeBackend()
+    base = {"base": True}
+    cfg = _fast_cfg(zipf=1.4, distinct=4)
+    report = run_phase(backend, cfg, base, name="zipf")
+    pool = warm_pool(cfg, base)
+    submitted = backend.solo + [d for s in backend.sweeps for d in s]
+    for d in submitted:
+        assert d in pool, d
+    # popularity skew: some variant repeats beyond its round-robin share
+    variants = [d["_loadgen_variant"] for d in backend.solo
+                if "_loadgen_variant" in d]
+    counts = sorted((variants.count(v) for v in set(variants)),
+                    reverse=True)
+    assert counts[0] > max(1, len(variants) // cfg.distinct)
+    # canaries untouched by the popularity mode
+    canaries = [d for d in backend.solo if "_loadgen_variant" not in d]
+    assert len(canaries) >= 2
+    assert all(d == base for d in canaries)
+    assert report["bits_identical"] is True
+    # and the schedule is replayable: a second phase submits the same
+    # bodies in the same order
+    backend2 = FakeBackend()
+    run_phase(backend2, cfg, base, name="zipf-replay")
+    assert backend2.solo == backend.solo
+    assert backend2.sweeps == backend.sweeps
 
 
 def test_lost_requests_are_counted_not_hidden():

@@ -1,0 +1,502 @@
+"""Exact-answer result cache (raft_tpu/serve/result_cache.py) and its
+engine wiring: integrity-first memoization that can never serve wrong
+bits.
+
+The contracts under test (ISSUE 17):
+
+ - a cache hit is ``np.array_equal``-IDENTICAL to a cold solve (solo
+   and sweep-chunk payloads round-trip bit-exactly, complex planes,
+   report dtypes and all);
+ - every integrity gate refuses by DELETING the entry with a logged
+   reason and counting it — corrupt bytes, torn (truncated) archives,
+   foreign kinds, stale flag surfaces and foreign schema versions are
+   never served;
+ - with the ``corrupt_result_cache`` chaos fault injected, the engine
+   recomputes bit-identical answers and counts the quarantine — zero
+   wrong-bit serves;
+ - only terminal ``ok`` answers populate: failed requests and
+   NaN-quarantined lanes are never cached;
+ - LRU-by-bytes eviction keeps the directory under the configured cap
+   and degrades to misses, never to wrong answers;
+ - concurrent writers/readers on a SHARED cache dir (threads in this
+   process plus a separate interpreter) never produce a torn read:
+   every get is a miss or the exact bits.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from raft_tpu.designs import deep_spar
+from raft_tpu.serve import Engine, EngineConfig
+from raft_tpu.serve.engine import RequestResult
+from raft_tpu.serve import result_cache as rc_mod
+from raft_tpu.serve.result_cache import (
+    ResultCache,
+    coalesce_key,
+    result_key,
+    sweep_chunk_key,
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NW = (0.05, 0.5)
+
+
+def _spar(rho_fill=1800.0):
+    d = deep_spar(n_cases=2, nw_settings=NW)
+    d["platform"]["members"][0]["rho_fill"] = [float(rho_fill), 0.0, 0.0]
+    return d
+
+
+def _engine(cache_dir, **kw):
+    kw.setdefault("precision", "float64")
+    kw.setdefault("window_ms", 1.0)
+    kw.setdefault("cache_dir", str(cache_dir))
+    kw.setdefault("use_result_cache", True)
+    return Engine(EngineConfig(**kw))
+
+
+def _wait_stat(eng, key, n, timeout=10.0):
+    """Population happens AFTER the handle resolves (the requester never
+    waits on the disk write), so tests poll the counter briefly."""
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout:
+        if eng.snapshot()[key] >= n:
+            return
+        time.sleep(0.01)
+    raise AssertionError(
+        f"{key} never reached {n}: {eng.snapshot()[key]}")
+
+
+def _fake_result(seed=0, nonfinite=False):
+    """A RequestResult-shaped ok answer with deterministic bits."""
+    rng = np.random.default_rng(seed)
+    Xi = (rng.standard_normal((4, 6, 32))
+          + 1j * rng.standard_normal((4, 6, 32)))
+    report = {
+        "converged": np.array([True, not nonfinite]),
+        "nonfinite": np.array([nonfinite, False]),
+        "iters": np.array([4, 5], dtype=np.int32),
+        "residual": rng.standard_normal(2).astype(np.float64),
+    }
+    return RequestResult(rid=1, status="ok", Xi=Xi,
+                         std=rng.standard_normal((2, 6)),
+                         solve_report=report, backend="cpu")
+
+
+def _assert_bits(payload, res):
+    assert np.array_equal(payload["Xi"], np.asarray(res.Xi))
+    assert payload["Xi"].dtype == np.asarray(res.Xi).dtype
+    assert np.array_equal(payload["std"], np.asarray(res.std))
+    assert sorted(payload["solve_report"]) == sorted(res.solve_report)
+    for name, a in res.solve_report.items():
+        b = payload["solve_report"][name]
+        assert np.array_equal(a, b) and np.asarray(a).dtype == b.dtype
+
+
+# ------------------------------------------------------------ unit: keys
+
+def test_keys_are_stable_and_discriminating():
+    d1, d2 = _spar(1800.0), _spar(1500.0)
+    flags = {"backend": "cpu", "x64": True}
+    k = result_key(d1, None, "float64", flags=flags)
+    assert k == result_key(d1, None, "float64", flags=flags)
+    # ballast knobs change bits -> change the key (unlike routing_key)
+    assert k != result_key(d2, None, "float64", flags=flags)
+    assert k != result_key(d1, None, "float32", flags=flags)
+    # the flag surface partitions the key space: no cross-flag aliasing
+    assert k != result_key(d1, None, "float64",
+                           flags={"backend": "tpu", "x64": True})
+    ck = sweep_chunk_key([d1, d2], None, "float64", flags=flags)
+    assert ck == sweep_chunk_key([d1, d2], None, "float64", flags=flags)
+    assert ck != sweep_chunk_key([d2, d1], None, "float64", flags=flags)
+    # the single-flight key ignores flags (one deployment shares them)
+    assert coalesce_key(d1) == coalesce_key(d1)
+    assert coalesce_key(d1) != coalesce_key(d2)
+
+
+# ------------------------------------------------- unit: round-trip bits
+
+def test_roundtrip_is_bit_identical(tmp_path):
+    cache = ResultCache(str(tmp_path))
+    res = _fake_result(seed=3)
+    key = "k" * 32
+    assert cache.put_result(key, res) == 0
+    payload, refused = cache.get_result(key)
+    assert refused == 0 and payload is not None
+    _assert_bits(payload, res)
+    assert payload["backend"] == "cpu"
+    assert cache.bytes_total > 0
+
+
+def test_chunk_roundtrip_is_bit_identical(tmp_path):
+    cache = ResultCache(str(tmp_path))
+    rng = np.random.default_rng(5)
+    arrays = {"Xi_r": rng.standard_normal((2, 2, 6, 3)),
+              "Xi_i": rng.standard_normal((2, 2, 6, 3)),
+              "converged": np.array([[True, True], [True, False]])}
+    assert cache.put_chunk("c" * 32, arrays) == 0
+    hit, refused = cache.get_chunk("c" * 32)
+    assert refused == 0
+    for name, a in arrays.items():
+        assert np.array_equal(hit[name], a)
+        assert hit[name].dtype == np.asarray(a).dtype
+
+
+# --------------------------------------------- unit: the refusal ladder
+
+def test_corrupt_entry_refused_deleted_counted(tmp_path, caplog):
+    cache = ResultCache(str(tmp_path))
+    cache.put_result("k" * 32, _fake_result())
+    path = cache._path("k" * 32)
+    with open(path, "wb") as fh:
+        fh.write(b"\x00chaos-corrupted\x00" * 4)
+    with caplog.at_level("WARNING", logger="raft_tpu"):
+        payload, refused = cache.get_result("k" * 32)
+    assert payload is None and refused == 1
+    assert not os.path.exists(path)          # quarantined, not retried
+    assert any("refused and deleted" in m for m in caplog.messages)
+    # the next read is a clean miss, not another refusal
+    assert cache.get_result("k" * 32) == (None, 0)
+
+
+def test_torn_write_refused(tmp_path):
+    """A truncated archive (what a non-atomic writer would leave) is
+    indistinguishable from corruption: refused + deleted."""
+    cache = ResultCache(str(tmp_path))
+    cache.put_result("k" * 32, _fake_result())
+    path = cache._path("k" * 32)
+    with open(path, "r+b") as fh:
+        fh.truncate(os.path.getsize(path) // 2)
+    payload, refused = cache.get_result("k" * 32)
+    assert payload is None and refused == 1
+    assert not os.path.exists(path)
+
+
+def test_flipped_payload_byte_fails_checksum(tmp_path):
+    """A single flipped byte INSIDE a structurally valid archive is
+    caught by the embedded payload checksum — the hard case a plain
+    np.load round-trip would happily serve."""
+    cache = ResultCache(str(tmp_path))
+    cache.put_result("k" * 32, _fake_result())
+    path = cache._path("k" * 32)
+    blob = bytearray(open(path, "rb").read())
+    # flip one bit mid-payload, keeping the zip structure plausible
+    blob[len(blob) // 3] ^= 0x40
+    with open(path, "wb") as fh:
+        fh.write(bytes(blob))
+    payload, refused = cache.get_result("k" * 32)
+    assert payload is None and refused == 1
+    assert not os.path.exists(path)
+
+
+def test_foreign_kind_refused(tmp_path):
+    cache = ResultCache(str(tmp_path))
+    cache.put_chunk("k" * 32, {"Xi_r": np.zeros(3)})
+    payload, refused = cache.get_result("k" * 32)
+    assert payload is None and refused == 1
+
+
+def test_stale_flags_refused(tmp_path):
+    cache = ResultCache(str(tmp_path))
+    stale = dict(cache.flags)
+    stale["code_version"] = "0" * 12         # an older build wrote this
+    cache.flags = stale
+    cache.put_result("k" * 32, _fake_result())
+    payload, refused = cache.get_result("k" * 32)
+    assert payload is None and refused == 1
+
+
+def test_foreign_schema_refused(tmp_path, monkeypatch):
+    cache = ResultCache(str(tmp_path))
+    monkeypatch.setattr(rc_mod, "RESULT_SCHEMA", 999)
+    cache.put_result("k" * 32, _fake_result())
+    monkeypatch.setattr(rc_mod, "RESULT_SCHEMA", 1)
+    payload, refused = cache.get_result("k" * 32)
+    assert payload is None and refused == 1
+
+
+# ------------------------------------------------------- unit: eviction
+
+def test_eviction_keeps_bytes_under_cap(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_RESULT_CACHE_MB", "0.02")  # 20 kB
+    cache = ResultCache(str(tmp_path))                      # env default
+    assert cache.cap_bytes == 20000
+    keys = [f"{i:032d}" for i in range(8)]
+    evicted = 0
+    for i, key in enumerate(keys):
+        evicted += max(0, cache.put_result(key, _fake_result(seed=i)))
+        time.sleep(0.01)                     # distinct mtimes for LRU
+    assert evicted >= 1
+    assert cache.bytes_total <= cache.cap_bytes
+    assert cache._scan_bytes() <= cache.cap_bytes
+    # oldest keys degraded to clean misses; the newest still hits, and
+    # what hits is still the exact bits
+    assert cache.get_result(keys[0]) == (None, 0)
+    payload, refused = cache.get_result(keys[-1])
+    assert refused == 0
+    _assert_bits(payload, _fake_result(seed=len(keys) - 1))
+
+
+def test_read_recency_protects_hot_entries(tmp_path):
+    cache = ResultCache(str(tmp_path), cap_mb=1000.0)
+    cache.put_result(f"{0:032d}", _fake_result(seed=0))
+    entry_bytes = cache.bytes_total
+    cache.cap_bytes = int(entry_bytes * 3.5)     # room for 3 entries
+    time.sleep(0.01)
+    for i in range(1, 3):
+        cache.put_result(f"{i:032d}", _fake_result(seed=i))
+        time.sleep(0.01)
+    cache.get_result(f"{0:032d}")            # touch the oldest entry
+    time.sleep(0.01)
+    assert cache.put_result(f"{3:032d}", _fake_result(seed=3)) == 1
+    payload, _ = cache.get_result(f"{0:032d}")
+    assert payload is not None               # the touched entry survived
+    assert cache.get_result(f"{1:032d}") == (None, 0)   # the LRU went
+
+
+# ------------------------------------------- shared-dir race (threads)
+
+def test_shared_dir_concurrent_readers_writers_never_torn(tmp_path):
+    """Two ResultCache instances (two replicas) hammering the same keys
+    on one dir: every get is a miss or the exact bits — the atomic
+    rename + checksum gates mean zero refusals and zero wrong bits."""
+    a, b = ResultCache(str(tmp_path)), ResultCache(str(tmp_path))
+    keys = [f"{i:032d}" for i in range(4)]
+    ref = {k: _fake_result(seed=i) for i, k in enumerate(keys)}
+    errors, refusals, hits = [], [], 0
+    stop = time.monotonic() + 1.5
+    lock = threading.Lock()
+
+    def worker(cache, wid):
+        nonlocal hits
+        n = 0
+        while time.monotonic() < stop:
+            k = keys[(n + wid) % len(keys)]
+            try:
+                if n % 3 == 0:
+                    cache.put_result(k, ref[k])
+                payload, refused = cache.get_result(k)
+                with lock:
+                    if refused:
+                        refusals.append(k)
+                    if payload is not None:
+                        hits += 1
+                        _assert_bits(payload, ref[k])
+            except AssertionError as exc:
+                with lock:
+                    errors.append(f"{wid}: {exc}")
+            n += 1
+
+    threads = [threading.Thread(target=worker, args=(c, i))
+               for i, c in enumerate([a, b, a, b])]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors[:3]
+    assert not refusals                      # atomic writes: never torn
+    assert hits > len(keys)
+
+
+_HAMMER = """
+import os, sys, time
+sys.path.insert(0, __REPO_ROOT__)
+sys.path.insert(0, os.path.join(__REPO_ROOT__, "tests"))
+import numpy as np
+from raft_tpu.serve.result_cache import ResultCache
+from test_result_cache import _fake_result
+cache = ResultCache(os.environ["RAFT_TPU_RESULT_CACHE_TEST_DIR"])
+keys = [f"{i:032d}" for i in range(4)]
+print("HAMMER-READY", flush=True)
+stop = time.monotonic() + 2.0
+n = 0
+while time.monotonic() < stop:
+    cache.put_result(keys[n % len(keys)], _fake_result(seed=n % len(keys)))
+    n += 1
+print("HAMMER-DONE", n, flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_shared_dir_cross_process_writer_never_torn(tmp_path):
+    """A SECOND INTERPRETER rewrites the same entries while this process
+    reads them: every read is a miss or the exact bits (the rename is
+    the commit point across processes too)."""
+    script = os.path.join(str(tmp_path), "hammer.py")
+    with open(script, "w") as fh:
+        fh.write(_HAMMER.replace("__REPO_ROOT__", repr(ROOT)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["RAFT_TPU_RESULT_CACHE_TEST_DIR"] = str(tmp_path)
+    proc = subprocess.Popen(
+        [sys.executable, script], stdout=subprocess.PIPE, text=True,
+        env=env, cwd=os.path.join(ROOT, "tests"))
+    try:
+        assert "HAMMER-READY" in proc.stdout.readline()
+        cache = ResultCache(str(tmp_path))
+        keys = [f"{i:032d}" for i in range(4)]
+        ref = {k: _fake_result(seed=i) for i, k in enumerate(keys)}
+        reads = refused_total = 0
+        while proc.poll() is None:
+            for k in keys:
+                payload, refused = cache.get_result(k)
+                refused_total += refused
+                if payload is not None:
+                    reads += 1
+                    _assert_bits(payload, ref[k])
+        out = proc.stdout.read()
+    finally:
+        proc.kill()
+        proc.wait()
+    assert "HAMMER-DONE" in out
+    assert reads > 0
+    assert refused_total == 0
+
+
+# ----------------------------------------------------- engine wiring e2e
+
+@pytest.fixture(scope="module")
+def cache_dir(tmp_path_factory):
+    """One shared serve cache dir for the module: prep artifacts warm
+    once, so each engine construction costs milliseconds."""
+    return str(tmp_path_factory.mktemp("result_cache"))
+
+
+def test_env_flags_gate_the_cache(cache_dir, monkeypatch):
+    monkeypatch.delenv("RAFT_TPU_RESULT_CACHE", raising=False)
+    assert EngineConfig().use_result_cache is False      # default OFF
+    monkeypatch.setenv("RAFT_TPU_RESULT_CACHE", "1")
+    assert EngineConfig().use_result_cache is True
+    monkeypatch.setenv("RAFT_TPU_RESULT_CACHE_MB", "1.5")
+    assert EngineConfig().result_cache_mb == 1.5
+
+
+def test_engine_hit_is_bit_identical_and_short_circuits(cache_dir):
+    design = _spar(2500.0)
+    with _engine(cache_dir) as eng:
+        cold = eng.evaluate(design, timeout=600)
+        _wait_stat(eng, "result_cache_stores", 1)
+        warm = eng.evaluate(design, timeout=600)
+        snap = eng.snapshot()
+        probe = eng.probe()
+    assert cold.status == "ok" and warm.status == "ok"
+    assert np.array_equal(warm.Xi, cold.Xi)
+    assert np.array_equal(warm.std, cold.std)
+    for name, a in cold.solve_report.items():
+        assert np.array_equal(warm.solve_report[name], a)
+    assert warm.bucket == cold.bucket
+    assert snap["result_cache_hits"] == 1
+    assert snap["result_cache_misses"] >= 1
+    assert snap["result_cache_stores"] == 1
+    assert snap["result_cache_corrupt"] == 0
+    assert snap["result_cache_bytes"] > 0
+    # the hit never touched the dispatch path
+    assert warm.batch_requests == 1 and warm.batch_occupancy == 0.0
+    assert warm.latency_s < cold.latency_s
+    # lock-free probe gauges (ISSUE 17 satellite)
+    assert probe["result_cache_bytes"] == snap["result_cache_bytes"]
+    assert probe["inflight_followers"] == 0
+
+
+def test_fresh_engine_serves_from_shared_dir(cache_dir):
+    """Cross-process semantics on one machine: a brand-new engine over
+    the same cache dir serves the answer without dispatching."""
+    design = _spar(2500.0)                   # cached by the test above
+    with _engine(cache_dir) as eng:
+        res = eng.evaluate(design, timeout=600)
+        snap = eng.snapshot()
+    assert res.status == "ok"
+    assert snap["result_cache_hits"] == 1
+    assert snap["result_cache_misses"] == 0
+    assert snap["ok"] == 1
+
+
+def test_corrupt_result_cache_chaos_recomputes_bit_identical(
+        cache_dir, monkeypatch, caplog):
+    """The tentpole acceptance loop: a flipped entry under the
+    ``corrupt_result_cache`` fault yields a counted quarantine and a
+    recompute with bit-identical answers — zero wrong-bit serves."""
+    design = _spar(2600.0)
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "corrupt_result_cache*1:3")
+    with _engine(cache_dir) as eng:
+        ref = eng.evaluate(design, timeout=600)   # entry corrupted on disk
+        _wait_stat(eng, "result_cache_stores", 1)
+        snap1 = eng.snapshot()
+    assert ref.status == "ok"                # corruption hits the DISK copy
+    assert snap1["chaos"]["fires"] == {"corrupt_result_cache": 1}
+    monkeypatch.delenv("RAFT_TPU_CHAOS")
+    with caplog.at_level("WARNING", logger="raft_tpu"):
+        with _engine(cache_dir) as eng:
+            r2 = eng.evaluate(design, timeout=600)
+            _wait_stat(eng, "result_cache_stores", 1)
+            r3 = eng.evaluate(design, timeout=600)
+            snap2 = eng.snapshot()
+    assert r2.status == "ok"
+    assert snap2["result_cache_corrupt"] >= 1    # refused, not trusted
+    assert any("refused and deleted" in m for m in caplog.messages)
+    assert np.array_equal(r2.Xi, ref.Xi)         # recomputed, same bits
+    # the recompute repopulated the entry; the next request hits it
+    assert r3.status == "ok"
+    assert snap2["result_cache_hits"] >= 1
+    assert np.array_equal(r3.Xi, ref.Xi)
+
+
+def test_failed_and_nan_quarantined_never_cached(cache_dir, monkeypatch):
+    """Population on terminal ``ok`` only: a failed request stores
+    nothing, and an answer with NaN-quarantined lanes stores nothing —
+    the poisoned bits must never be what the next request hits."""
+    design = _spar(2700.0)
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "prep_raise@1*1:7")
+    with _engine(cache_dir) as eng:
+        res = eng.submit(design).result(120)
+        time.sleep(0.2)                      # give a (buggy) store time
+        snap = eng.snapshot()
+    assert res.status == "failed"
+    assert snap["result_cache_stores"] == 0
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "nan_lane@1*1:5")
+    with _engine(cache_dir) as eng:
+        poisoned = eng.evaluate(design, timeout=600)
+        time.sleep(0.2)                      # give a (buggy) store time
+        clean = eng.evaluate(design, timeout=600)
+        _wait_stat(eng, "result_cache_stores", 1)
+        third = eng.evaluate(design, timeout=600)
+        snap = eng.snapshot()
+    assert poisoned.status == "ok"
+    assert poisoned.solve_report["nonfinite"].all()
+    assert not clean.solve_report["nonfinite"].any()
+    # the poisoned answer was NOT stored: the clean solve was a miss
+    # that stored, and only then did the third request hit
+    assert snap["result_cache_stores"] == 1
+    assert snap["result_cache_hits"] == 1
+    assert np.array_equal(third.Xi, clean.Xi)
+    assert not np.array_equal(third.Xi, poisoned.Xi)
+
+
+def test_sweep_chunks_cached_bit_identical(cache_dir):
+    designs = [_spar(2800.0), _spar(2850.0), _spar(2900.0)]
+    with _engine(cache_dir, window_ms=5.0) as eng:
+        ref = eng.submit_sweep(designs, chunk=2).result(600)
+        _wait_stat(eng, "result_cache_stores", 2)
+        again = eng.submit_sweep(designs, chunk=2).result(600)
+        snap = eng.snapshot()
+    assert ref.status == "ok" and again.status == "ok"
+    assert snap["result_cache_stores"] == 2      # one per chunk
+    assert snap["result_cache_hits"] == 2
+    assert np.array_equal(again.Xi_r, ref.Xi_r)
+    assert np.array_equal(again.Xi_i, ref.Xi_i)
+    for name, a in ref.report.items():
+        assert np.array_equal(again.report[name], a), name
+    # chunking is part of the key: a different chunk size recomputes
+    # (near-miss sharing would risk aliasing) but still matches bits
+    third = None
+    with _engine(cache_dir, window_ms=5.0) as eng:
+        third = eng.submit_sweep(designs, chunk=3).result(600)
+    assert third.status == "ok"
+    assert np.array_equal(third.Xi_r, ref.Xi_r)

@@ -366,6 +366,89 @@ def test_router_sigterm_terminal_status_for_every_accepted_rid(
     assert shutdown and json.loads(shutdown[0])["signal"] == 15
 
 
+# ------------------------------------- e2e: single-flight coalescing
+
+
+def test_router_coalesce_env_flag(monkeypatch):
+    monkeypatch.delenv("RAFT_TPU_ROUTER_COALESCE", raising=False)
+    router = _attached_router(n=1)
+    try:
+        assert router.snapshot()["coalesce"] is False    # default OFF
+    finally:
+        router.shutdown(wait=False)
+    monkeypatch.setenv("RAFT_TPU_ROUTER_COALESCE", "1")
+    router = _attached_router(n=1)
+    try:
+        assert router.snapshot()["coalesce"] is True
+    finally:
+        router.shutdown(wait=False)
+
+
+@pytest.mark.slow
+def test_coalesced_identical_requests_bit_identical(router2):
+    """Identical keyed requests submitted together collapse onto one
+    dispatch; every follower resolves ok with the leader's exact bits
+    (slow tier: the fresh ballast's cold prep IS the attach window; the
+    replicate path has a fast unit twin in
+    test_finish_coalesce_replicates_ok_result_per_follower)."""
+    d = _spar(3100.0)                  # fresh ballast: a cold-prep-wide
+    before = dict(router2.stats)       # attach window on the replica
+    router2._coalesce = True
+    try:
+        h1 = router2.submit(d)
+        h2 = router2.submit(d)
+        h3 = router2.submit(d)
+        r1 = h1.result(timeout=400)
+        r2 = h2.result(timeout=400)
+        r3 = h3.result(timeout=400)
+    finally:
+        router2._coalesce = False
+    assert (r1.status, r2.status, r3.status) == ("ok", "ok", "ok")
+    assert np.array_equal(r2.Xi, r1.Xi) and np.array_equal(r3.Xi, r1.Xi)
+    assert np.array_equal(r2.std, r1.std)
+    assert r1.rid != r2.rid != r3.rid  # own rid each, shared dispatch
+    coalesced = router2.stats["coalesced_followers"] \
+        - before["coalesced_followers"]
+    forwarded = router2.stats["forwarded"] - before["forwarded"]
+    assert coalesced >= 1
+    assert coalesced + forwarded == 3
+    assert router2.probe()["inflight_followers"] == 0
+
+
+def test_dup_inflight_leader_failure_isolated_bit_identical(
+        router2, monkeypatch):
+    """The ``dup_inflight`` chaos fault: the coalescing leader stalls
+    (followers pile in) and then fails WITHOUT forwarding.  Followers
+    must not inherit the failure — each re-dispatches fresh under its
+    own rid and lands the same bits an uncoalesced request gets."""
+    d = _spar(3200.0)
+    before = dict(router2.stats)
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "dup_inflight=1.0*1:21")
+    router2._coalesce = True
+    try:
+        leader = router2.submit(d)
+        time.sleep(0.2)                # attach inside the 1 s stall
+        follower = router2.submit(d)
+        r_lead = leader.result(timeout=400)
+        r_follow = follower.result(timeout=400)
+    finally:
+        router2._coalesce = False
+        monkeypatch.delenv("RAFT_TPU_CHAOS")
+    assert r_lead.status == "failed"
+    assert "dup_inflight" in r_lead.error
+    assert r_follow.status == "ok", r_follow.error
+    assert router2.stats["coalesced_followers"] \
+        - before["coalesced_followers"] >= 1
+    assert router2.stats["coalesce_leader_failures"] \
+        - before["coalesce_leader_failures"] >= 1
+    # the follower's retry served the exact bits of a clean dispatch
+    ref = router2.evaluate(d, timeout=400)
+    assert ref.status == "ok", ref.error
+    assert np.array_equal(r_follow.Xi, ref.Xi)
+    assert np.array_equal(r_follow.std, ref.std)
+    assert router2.probe()["inflight_followers"] == 0
+
+
 # --------------------------- unit: router shared-state lock regressions
 
 def _attached_router(n=2):
@@ -379,6 +462,44 @@ def _attached_router(n=2):
         endpoints.append(("127.0.0.1", s.getsockname()[1]))
         s.close()
     return Router(endpoints=endpoints)
+
+
+def test_finish_coalesce_replicates_ok_result_per_follower():
+    """Fast unit twin of the coalescing e2e: an ok leader result is
+    replicated to every attached follower under the follower's own rid
+    with the leader's exact arrays (dataclasses.replace — same objects,
+    no copy), the ok stat is bumped per follower, and the inflight
+    table entry + follower gauge are gone afterwards."""
+    from raft_tpu.serve.engine import RequestResult, _Pending
+    from raft_tpu.serve.router import _Inflight
+
+    router = _attached_router(n=1)
+    try:
+        router._coalesce = True
+        leader = _Pending(rid=1)
+        followers = [_Pending(rid=2), _Pending(rid=3)]
+        entry = _Inflight("k" * 32)
+        t0 = time.perf_counter()
+        with router._lock:
+            for p in followers:
+                entry.followers.append((p.rid, p, t0, None, time.time()))
+                router._n_followers += 1
+            router._inflight[entry.key] = entry
+        xi = np.full((2, 6, 4), 1.25 - 0.5j)
+        leader._set(RequestResult(rid=1, status="ok", Xi=xi,
+                                  std=np.ones((2, 6)), replica="r0"))
+        before_ok = router.stats["ok"]
+        router._finish_coalesce(entry.key, leader, {"d": 1}, None)
+        for p in followers:
+            res = p.result(timeout=5)
+            assert res.status == "ok"
+            assert res.rid == p.rid                  # own rid, not 1
+            assert res.Xi is xi                      # exact bits shared
+        assert router.stats["ok"] - before_ok == len(followers)
+        assert router.probe()["inflight_followers"] == 0
+        assert entry.key not in router._inflight
+    finally:
+        router.shutdown(wait=False)
 
 
 def test_retire_candidate_snapshots_replicas_under_lock():
