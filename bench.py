@@ -113,7 +113,12 @@ _COMPACT_KEYS = (
     "serve_cache_hit_p50_ms", "serve_cache_warm_p50_ms",
     "serve_cache_speedup", "serve_cache_zipf_hit_rate",
     "serve_cache_corrupt_check",
+    "serve_cache_router_hit_p50_ms", "serve_cache_forwarded_hit_p50_ms",
+    "serve_cache_router_speedup", "serve_cache_router_bits",
+    "serve_cache_sweep_dedup_ratio",
+    "serve_cache_handoff_hit_rate", "serve_cache_handoff_delta",
     "smoke_cache_ratio", "smoke_cache_bits",
+    "smoke_cache_router_hit_ms",
     "smoke_load_goodput", "smoke_load_bits",
     "sweep_cold_start_s", "sweep_warm_start_s", "sweep_warm_vs_cold",
     "sweep_prep_wall_s", "sweep_prep_solo_wall_s", "sweep_prep_batched",
@@ -1569,21 +1574,36 @@ def _wait_cache_stores(eng, n, timeout=30.0):
 
 
 def bench_serve_cache(n_requests=20):
-    """Exact-answer result cache (ISSUE 17): warm-solve vs cache-hit
-    p50 (acceptance: hit p50 <= 0.25x warm solve p50), the measured
-    hit-rate under the Zipfian loadgen popularity mode
+    """Exact-answer result cache (ISSUE 17 + 18): warm-solve vs
+    cache-hit p50 (acceptance: hit p50 <= 0.25x warm solve p50), the
+    measured hit-rate under the Zipfian loadgen popularity mode
     (``RAFT_TPU_LOADGEN_ZIPF`` realism: repeat-heavy traffic over a
     bounded variant pool), and the corrupt-entry recompute check — a
     flipped entry under ``corrupt_result_cache`` must yield a counted
-    quarantine and bit-identical recomputed answers."""
+    quarantine and bit-identical recomputed answers.
+
+    The ISSUE 18 router-tier figures ride the same populated dir:
+    forwarded-hit p50 (router -> replica HTTP hop, replica serves its
+    engine-tier hit) vs router-tier hit p50 (the router's own read-only
+    probe, zero forward hop; acceptance: <= 0.5x the forwarded hit
+    p50, bit-identical); the sweep chunk single-flight wall ratio
+    (identical overlapping sweeps coalesced vs two independent
+    sweeps); and the warm-handoff figure — a fresh replica spawned
+    with ``RAFT_TPU_WARM_HANDOFF`` must open within 0.15 of the
+    incumbent's steady-state hit-rate over its first 100 requests."""
     import tempfile
 
     from raft_tpu.designs import deep_spar
     from raft_tpu.loadgen import LoadgenConfig, run_phase, warm_pool
-    from raft_tpu.serve import Engine, EngineConfig
+    from raft_tpu.serve import Engine, EngineConfig, Router, serve_http
 
     t0 = time.perf_counter()
     design = deep_spar(n_cases=2, nw_settings=(0.05, 0.5))
+
+    def _variant(rho):
+        d = deep_spar(n_cases=2, nw_settings=(0.05, 0.5))
+        d["platform"]["members"][0]["rho_fill"] = [float(rho), 0.0, 0.0]
+        return d
 
     def p50(lats):
         return sorted(lats)[len(lats) // 2]
@@ -1679,14 +1699,180 @@ def bench_serve_cache(n_requests=20):
                 else "WRONG BITS")
             assert corrupt_check == "identical"
 
+            # ---- router tier (ISSUE 18): under PR 17 a fleet hit
+            # still paid the router->replica HTTP forward hop; the
+            # router now probes its own read-only view of the shared
+            # dir and a verified hit resolves with zero forward hop.
+            # Both paths measured over the SAME live replica: the
+            # forwarded leg's replica serves its engine-tier hit, so
+            # the delta is exactly the hop the probe removes.
+            _wait_cache_stores(eng, stores_now + 2)
+            transport = serve_http(eng)
+            endpoint = [("127.0.0.1", transport.port)]
+            fwd_router = Router(endpoints=endpoint, precision="float64",
+                                result_cache=False)
+            hit_router = Router(endpoints=endpoint, cache_dir=tmp,
+                                precision="float64")
+            try:
+                fwd_ref = fwd_router.evaluate(design, timeout=560)
+                assert fwd_ref.status == "ok", fwd_ref.error
+                fwd_lats = []
+                for _ in range(n_requests):
+                    t = time.perf_counter()
+                    r = fwd_router.evaluate(design, timeout=560)
+                    fwd_lats.append(time.perf_counter() - t)
+                    assert r.status == "ok", r.error
+                assert r.replica is not None          # paid the hop
+                router_lats = []
+                for _ in range(n_requests):
+                    t = time.perf_counter()
+                    r = hit_router.evaluate(design, timeout=560)
+                    router_lats.append(time.perf_counter() - t)
+                    assert r.status == "ok", r.error
+                assert r.replica is None              # zero forward hop
+                assert hit_router.stats["cache_hits"] >= n_requests
+                router_bits = (
+                    "identical"
+                    if np.array_equal(r.Xi, np.asarray(fwd_ref.Xi))
+                    and np.array_equal(r.std, np.asarray(fwd_ref.std))
+                    else "WRONG BITS")
+                assert router_bits == "identical"
+
+                # ---- sweep chunk single-flight: an identical sweep
+                # submitted while the first is in flight attaches to
+                # its chunks instead of forwarding its own.  Engine
+                # cache detached for the measurement so both legs pay
+                # real chunk solves (the dedup, not the cache, is the
+                # variable).  The attach window is the leader's chunk
+                # wall — retried with a fresh design family if the
+                # leader finishes before the follower lands.
+                saved_cache, eng._result_cache = eng._result_cache, None
+                fwd_router._coalesce = True
+                try:
+                    coalesced = 0
+                    for attempt in range(3):
+                        fam = 8100.0 + 100.0 * attempt
+                        sweep = [_variant(fam + 10.0 * i)
+                                 for i in range(4)]
+                        before_ch = fwd_router.stats[
+                            "sweep_coalesced_chunks"]
+                        t = time.perf_counter()
+                        lead = fwd_router.submit_sweep(sweep, chunk=2)
+                        spin = time.monotonic() + 5.0
+                        while (time.monotonic() < spin
+                               and len(fwd_router._inflight_chunks) < 2):
+                            time.sleep(0.0005)
+                        foll = fwd_router.submit_sweep(sweep, chunk=2)
+                        r_lead = lead.result(timeout=560)
+                        r_foll = foll.result(timeout=560)
+                        wall_on = time.perf_counter() - t
+                        assert r_lead.status == "ok", r_lead.error
+                        assert r_foll.status == "ok", r_foll.error
+                        assert np.array_equal(r_lead.Xi_r, r_foll.Xi_r)
+                        assert np.array_equal(r_lead.Xi_i, r_foll.Xi_i)
+                        coalesced = (fwd_router.stats[
+                            "sweep_coalesced_chunks"] - before_ch)
+                        if coalesced:
+                            break
+                    assert coalesced, "sweep follower never attached"
+                    fwd_router._coalesce = False
+                    # baseline: two non-overlapping families in flight
+                    # together — same concurrency, twice the compute
+                    sa = [_variant(8500.0 + 10.0 * i) for i in range(4)]
+                    sb = [_variant(8600.0 + 10.0 * i) for i in range(4)]
+                    t = time.perf_counter()
+                    ha = fwd_router.submit_sweep(sa, chunk=2)
+                    hb = fwd_router.submit_sweep(sb, chunk=2)
+                    ra = ha.result(timeout=560)
+                    rb = hb.result(timeout=560)
+                    wall_off = time.perf_counter() - t
+                    assert ra.status == "ok", ra.error
+                    assert rb.status == "ok", rb.error
+                finally:
+                    eng._result_cache = saved_cache
+                    fwd_router._coalesce = False
+                dedup_ratio = wall_on / max(1e-9, wall_off)
+            finally:
+                hit_router.shutdown(wait=False)
+                fwd_router.shutdown(wait=False)
+                transport.close()
+
+            # ---- warm-handoff manifest: the incumbent's steady-state
+            # Zipf hit-rate vs a fresh replica's FIRST-100-request
+            # hit-rate when spawned with RAFT_TPU_WARM_HANDOFF naming
+            # the incumbent's hottest entries (acceptance: within 0.15)
+            cfg100 = LoadgenConfig(rate_hz=50.0, duration_s=4.0, seed=7,
+                                   zipf=1.2, distinct=6, sweep_n=2,
+                                   p_sweep=0.1, p_tight=0.0,
+                                   canary_every=3, max_requests=100)
+            for h in [eng.submit(b) for b in warm_pool(cfg100, design)]:
+                r = h.result(timeout=560)
+                assert r.status == "ok", r.error
+            stores_now = eng.snapshot()["result_cache_stores"]
+            _wait_cache_stores(eng, stores_now)
+            before = eng.snapshot()
+            steady = run_phase(eng, cfg100, design, name="handoff_steady")
+            after = eng.snapshot()
+            assert steady["lost"] == 0, steady
+            s_hits = (after["result_cache_hits"]
+                      - before["result_cache_hits"])
+            s_miss = (after["result_cache_misses"]
+                      - before["result_cache_misses"])
+            steady_rate = s_hits / max(1, s_hits + s_miss)
+            handoff_path, shipped = cache.write_handoff("bench")
+            assert handoff_path is not None and shipped > 0
+            old_handoff = os.environ.get("RAFT_TPU_WARM_HANDOFF")
+            os.environ["RAFT_TPU_WARM_HANDOFF"] = handoff_path
+            try:
+                newcomer = Engine(EngineConfig(
+                    precision="float64", window_ms=1.0, cache_dir=tmp,
+                    use_result_cache=True))
+            finally:
+                if old_handoff is None:
+                    os.environ.pop("RAFT_TPU_WARM_HANDOFF", None)
+                else:
+                    os.environ["RAFT_TPU_WARM_HANDOFF"] = old_handoff
+            with newcomer:
+                snap_b = newcomer.snapshot()
+                assert snap_b["handoff_preloaded"] >= 1, snap_b
+                preloaded = snap_b["handoff_preloaded"]
+                first = run_phase(newcomer, cfg100, design,
+                                  name="handoff_first100")
+                after_b = newcomer.snapshot()
+                assert first["lost"] == 0, first
+                f_hits = after_b["result_cache_hits"]
+                f_miss = after_b["result_cache_misses"]
+                first_rate = f_hits / max(1, f_hits + f_miss)
+            handoff_delta = abs(steady_rate - first_rate)
+
     speedup = p50(solve_lats) / p50(hit_lats)
     assert p50(hit_lats) <= 0.25 * p50(solve_lats), (
         f"hit p50 {p50(hit_lats):.5f}s > 0.25x warm solve p50 "
         f"{p50(solve_lats):.5f}s")
+    assert p50(router_lats) <= 0.5 * p50(fwd_lats), (
+        f"router-tier hit p50 {p50(router_lats):.5f}s > 0.5x "
+        f"forwarded hit p50 {p50(fwd_lats):.5f}s")
+    assert handoff_delta <= 0.15, (
+        f"first-100 hit-rate {first_rate:.3f} more than 0.15 from "
+        f"steady-state {steady_rate:.3f}")
     return {
         "serve_cache_warm_p50_ms": round(p50(solve_lats) * 1e3, 3),
         "serve_cache_hit_p50_ms": round(p50(hit_lats) * 1e3, 3),
         "serve_cache_speedup": round(speedup, 2),
+        "serve_cache_forwarded_hit_p50_ms": round(
+            p50(fwd_lats) * 1e3, 3),
+        "serve_cache_router_hit_p50_ms": round(
+            p50(router_lats) * 1e3, 3),
+        "serve_cache_router_speedup": round(
+            p50(fwd_lats) / max(1e-9, p50(router_lats)), 2),
+        "serve_cache_router_bits": router_bits,
+        "serve_cache_sweep_dedup_ratio": round(dedup_ratio, 3),
+        "serve_cache_sweep_coalesced_chunks": coalesced,
+        "serve_cache_steady_hit_rate": round(steady_rate, 4),
+        "serve_cache_handoff_hit_rate": round(first_rate, 4),
+        "serve_cache_handoff_delta": round(handoff_delta, 4),
+        "serve_cache_handoff_shipped": shipped,
+        "serve_cache_handoff_preloaded": preloaded,
         "serve_cache_zipf_hit_rate": round(hit_rate, 4),
         "serve_cache_zipf_offered": phase["offered"],
         "serve_cache_corrupt_check": corrupt_check,
@@ -1698,12 +1884,14 @@ def bench_serve_cache(n_requests=20):
 
 def bench_serve_cache_smoke():
     """Tier-1-safe result-cache smoke: one engine, one design — a cold
-    solve, a bit-identical hit (ratio recorded), and the corrupt-entry
-    recompute check."""
+    solve, a bit-identical hit (ratio recorded), the corrupt-entry
+    recompute check, and a router-tier hit served with ZERO alive
+    replicas (the ISSUE 18 zero-forward-hop contract)."""
+    import socket
     import tempfile
 
     from raft_tpu.designs import deep_spar
-    from raft_tpu.serve import Engine, EngineConfig
+    from raft_tpu.serve import Engine, EngineConfig, Router
 
     t0 = time.perf_counter()
     design = deep_spar(n_cases=2, nw_settings=(0.05, 0.5))
@@ -1750,9 +1938,30 @@ def bench_serve_cache_smoke():
             snap = eng.snapshot()
             assert snap["result_cache_corrupt"] >= 1, snap
             assert np.array_equal(recomputed.Xi, ref.Xi)
+        # ---- router tier (ISSUE 18): the engine is gone — zero alive
+        # replicas — yet an attach-mode router over a just-freed port
+        # still serves the stored entry from its own read-only probe,
+        # bit-identical, with zero forward hop
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        router = Router(endpoints=[("127.0.0.1", port)],
+                        cache_dir=tmp, precision="float64")
+        try:
+            t = time.perf_counter()
+            rh = router.evaluate(design, timeout=120)
+            t_router = time.perf_counter() - t
+            assert rh.status == "ok", rh.error
+            assert rh.replica is None
+            assert np.array_equal(rh.Xi, np.asarray(cold.Xi))
+            assert router.stats["cache_hits"] == 1, router.stats
+        finally:
+            router.shutdown(wait=False)
     return {
         "smoke_cache_ratio": round(t_cold / max(1e-9, t_hit), 1),
         "smoke_cache_hit_ms": round(t_hit * 1e3, 3),
+        "smoke_cache_router_hit_ms": round(t_router * 1e3, 3),
         "smoke_cache_bits": bits,
         "smoke_cache_corrupt_refused": snap["result_cache_corrupt"],
         "smoke_cache_s": round(time.perf_counter() - t0, 3),

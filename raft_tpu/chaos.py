@@ -73,6 +73,16 @@ Fault classes and their hook points:
                     forwarding — its coalesced followers must NOT
                     inherit the failure: each retries with a fresh
                     dispatch under its own rid, bit-identically
+``corrupt_manifest``  a just-persisted popularity ledger / warm-handoff
+                    manifest (serve/result_cache.py) is overwritten with
+                    garbage — the refusing loader must log, delete and
+                    rebuild it empty; a replica spawn handed a corrupt
+                    manifest must come up clean, never crash
+``stale_handoff``   the warm-handoff manifest shipped to a freshly
+                    spawned replica names ``value`` (default 3) entries
+                    that no longer exist on disk (evicted / bogus keys)
+                    — the replica's preload must count them as plain
+                    misses and keep going
 ==================  ======================================================
 
 Per-rid targeting caveat: the engine deduplicates prep per design key,
@@ -100,10 +110,12 @@ CHAOS_ENV = "RAFT_TPU_CHAOS"
 
 FAULTS = ("prep_raise", "prep_slow", "nan_lane", "dispatch_stall",
           "backend_error", "corrupt_cache", "conn_drop", "replica_kill",
-          "replica_slow", "corrupt_result_cache", "dup_inflight")
+          "replica_slow", "corrupt_result_cache", "dup_inflight",
+          "corrupt_manifest", "stale_handoff")
 
 _DEFAULT_VALUES = {"prep_slow": 1.0, "dispatch_stall": 5.0,
-                   "replica_slow": 0.5, "dup_inflight": 0.25}
+                   "replica_slow": 0.5, "dup_inflight": 0.25,
+                   "stale_handoff": 3.0}
 
 
 class ChaosError(RuntimeError):

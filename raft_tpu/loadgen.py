@@ -110,6 +110,10 @@ class LoadgenConfig:
     canary_every: int = 4          # every k-th solo reuses the base design
     distinct: int = 8              # variant-pool size (see warm_pool)
     zipf: float = 0.0              # variant popularity skew (0 = cycle)
+    max_requests: int = 0          # 0 = unbounded; else truncate the
+    # arrival schedule after this many requests — measuring a "first N
+    # requests" window (e.g. a freshly scaled replica's warm-handoff
+    # hit-rate) needs an exact request count, not a duration guess
     collect_timeout_s: float = 120.0
 
     @classmethod
@@ -223,6 +227,12 @@ def run_phase(backend, config, design, name="load", chaos=None,
     arrivals = poisson_arrivals(config.rate_hz, config.duration_s,
                                 config.seed)
     kinds = request_mix(len(arrivals), config)
+    if config.max_requests and len(arrivals) > int(config.max_requests):
+        # truncate AFTER drawing both streams so a bounded phase offers
+        # the exact prefix of the unbounded schedule (same seed, same
+        # first-N requests)
+        arrivals = arrivals[:int(config.max_requests)]
+        kinds = kinds[:int(config.max_requests)]
     flights = []
     chaos_timer = None
     chaos_prev = os.environ.get("RAFT_TPU_CHAOS")

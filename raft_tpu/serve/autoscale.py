@@ -10,6 +10,14 @@ status.  This module adds the missing POLICY: a small deterministic
 loop that reads the fleet's gauges and spawns/retires replicas against
 high/low-water pressure thresholds with hysteresis.
 
+Two side effects ride the spawn path for free because both the heal
+and scale-out rules go through ``Router.scale_out``: the newcomer gets
+the result cache's warm-handoff manifest (PR 18 — it pre-loads the
+Zipf-head entries before its ready line, so a healed or scaled replica
+starts hot), and the router-tier cache probe keeps hit traffic off
+replica queues entirely, so the ``pressure`` signal below measures
+real (miss) work, not repeats a hit would have answered.
+
 Policy (``Autoscaler.step``, one evaluation per tick):
 
 * **pressure** = mean over alive replicas of (queue_depth + in_flight),

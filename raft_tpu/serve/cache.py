@@ -270,6 +270,14 @@ ENV_FLAG_SURFACE = {
     # prep lane-block padding is discarded after the batched solve;
     # outputs are block-size independent by the same parity tests
     "RAFT_TPU_PREP_BLOCK": None,
+    # NOTE: serving-tier flags (RAFT_TPU_RESULT_CACHE — default ON
+    # since PR 18 — RAFT_TPU_WARM_HANDOFF, RAFT_TPU_ROUTER_COALESCE,
+    # ...) deliberately have no row here: they are read outside the
+    # _CODE_VERSION_MODULES roster and cannot change bits — a result
+    # cache entry embeds this ENTIRE flag surface at write time and
+    # flags_mismatch refuses any cross-flag read, so serving-tier
+    # toggles only decide WHETHER the cache is consulted, never what
+    # bits it may serve.
 }
 
 

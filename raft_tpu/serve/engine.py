@@ -107,6 +107,8 @@ from raft_tpu.serve.cache import (
 )
 from raft_tpu.serve.result_cache import (
     ResultCache,
+    load_manifest,
+    result_cache_enabled,
     result_key,
     sweep_chunk_key,
 )
@@ -179,10 +181,19 @@ class EngineConfig:
     use_result_cache / result_cache_mb : the exact-answer result cache
         (serve/result_cache.py): a cache hit short-circuits admission
         and returns the stored bits; only terminal ``ok`` results with
-        no NaN-quarantined lanes populate it.  Off by default
-        (``RAFT_TPU_RESULT_CACHE`` opts in); ``result_cache_mb`` caps
-        the on-disk bytes (LRU eviction,
+        no NaN-quarantined lanes populate it.  ON by default — burn-in
+        complete, the chaos faults prove corrupt entries recompute
+        identical bits (``RAFT_TPU_RESULT_CACHE=0`` opts out);
+        ``result_cache_mb`` caps the on-disk bytes (LRU eviction,
         ``RAFT_TPU_RESULT_CACHE_MB``).
+    warm_handoff : path of a warm-handoff manifest
+        (``RAFT_TPU_WARM_HANDOFF``, shipped by ``Router.scale_out``):
+        the named cache entries are verified-read at startup — before
+        the ready line, so before the spawning router gives this
+        replica ring arcs — pulling the popular working set into the
+        hot path instead of cold-missing the head of the Zipf curve.
+        Missing/stale entries are plain misses; a corrupt manifest is
+        refused, deleted and ignored (never a failed spawn).
     preempt_block : waterfall block size (K iterations) for PREEMPTIBLE
         sweep dispatches only — a finer K means more block boundaries,
         so interactive requests wait less before the sweep yields.
@@ -238,12 +249,13 @@ class EngineConfig:
         default_factory=lambda: _env_int(
             "RAFT_TPU_SERVE_PREEMPT_BLOCK", 1))
     use_result_cache: bool = dataclasses.field(
-        default_factory=lambda: os.environ.get(
-            "RAFT_TPU_RESULT_CACHE", "").strip().lower()
-        in ("1", "true", "on", "yes"))
+        default_factory=result_cache_enabled)
     result_cache_mb: float = dataclasses.field(
         default_factory=lambda: _env_float(
             "RAFT_TPU_RESULT_CACHE_MB", 256.0))
+    warm_handoff: str = dataclasses.field(
+        default_factory=lambda: os.environ.get(
+            "RAFT_TPU_WARM_HANDOFF", "").strip() or None)
 
     def __post_init__(self):
         if self.low_water <= 0:
@@ -567,12 +579,20 @@ class Engine:
             max_workers=1, thread_name_prefix="raft-sweep-prep")
         self._prep_cache = (PrepCache(self.config.cache_dir)
                             if self.config.use_prep_cache else None)
-        # the exact-answer result cache (serve/result_cache.py): opt-in,
-        # integrity-verified on every read, populated on terminal ok only
+        # the exact-answer result cache (serve/result_cache.py): ON by
+        # default (PR 18) whenever a cache dir is explicitly configured
+        # (EngineConfig.cache_dir or RAFT_TPU_CACHE_DIR) — never against
+        # the implicit home-dir fallback, so ad-hoc engines stay
+        # side-effect-free; RAFT_TPU_RESULT_CACHE=0 opts the fleet out.
+        # Integrity-verified on every read, populated on terminal ok only
+        cache_dir_configured = bool(
+            self.config.cache_dir
+            or os.environ.get("RAFT_TPU_CACHE_DIR", "").strip())
         self._result_cache = (
             ResultCache(self.config.cache_dir,
                         cap_mb=self.config.result_cache_mb)
-            if self.config.use_result_cache else None)
+            if self.config.use_result_cache and cache_dir_configured
+            else None)
         # batched traced prep (RAFT_TPU_BATCHED_PREP): family programs
         # keyed by family_key; False marks a family that failed to build
         self._bp_families = OrderedDict()
@@ -634,12 +654,29 @@ class Engine:
             "result_cache_hits": 0, "result_cache_misses": 0,
             "result_cache_stores": 0, "result_cache_evictions": 0,
             "result_cache_corrupt": 0,
+            "handoff_preloaded": 0, "handoff_missing": 0,
             "first_result_s": None, "warmup": None,
         })
         self._gauge_result_bytes = self.metrics.gauge(
             "raft_tpu_engine_result_cache_bytes",
             "bytes resident in the exact-answer result cache")
         self._t_start = time.perf_counter()
+        # warm handoff (Router.scale_out ships the manifest): preload
+        # the popular entries BEFORE the batcher starts and the caller
+        # prints its ready line — a freshly scaled replica inherits the
+        # head of the popularity curve before it claims any ring arcs
+        if self._result_cache is not None and self.config.warm_handoff:
+            entries = load_manifest(self.config.warm_handoff,
+                                    "warm-handoff manifest")
+            loaded, missing = self._result_cache.preload(entries)
+            self.stats["handoff_preloaded"] += loaded
+            self.stats["handoff_missing"] += missing
+            if entries:
+                logger.info(
+                    "warm handoff: preloaded %d/%d cache entr%s (%d "
+                    "missing treated as plain misses)", loaded,
+                    len(entries), "y" if len(entries) == 1 else "ies",
+                    missing)
         if self.config.warm_on_start:
             self.stats["warmup"] = warmup(
                 manifest=self._manifest, precision=self.config.precision,
@@ -872,6 +909,10 @@ class Engine:
                     "serve shutdown: batcher still busy after %.1fs; "
                     "force-resolving outstanding handles", timeout)
             self._finalize_outstanding()
+        if self._result_cache is not None:
+            # persist the popularity ledger so the next spawn's
+            # warm-handoff manifest sees this process's hit history
+            self._result_cache.flush_popularity()
         return self
 
     def __enter__(self):
@@ -2229,6 +2270,11 @@ class Engine:
             "result_cache_bytes": (
                 self._result_cache.bytes_total
                 if self._result_cache is not None else 0),
+            # warm-handoff preload outcome (PR 18): rides /statz so the
+            # router can see a spawned replica's preload without a new
+            # endpoint
+            "handoff_preloaded": self.stats["handoff_preloaded"],
+            "handoff_missing": self.stats["handoff_missing"],
             "first_result_s": self.stats["first_result_s"],
             "bucket_compiles": self.stats["bucket_compiles"],
             "warmup": self.stats["warmup"],

@@ -65,6 +65,21 @@ from raft_tpu.utils.profiling import logger
 #: refused (deleted + recomputed), never reinterpreted
 RESULT_SCHEMA = 1
 
+#: popularity-ledger / warm-handoff manifest schema (same bump rule)
+MANIFEST_SCHEMA = 1
+
+#: hit-score half-life (seconds): a burst of hits an hour ago should
+#: not outrank steady traffic now.  A module constant, not an env knob —
+#: the warm-handoff contract only needs "recently popular", not tuning.
+POP_HALF_LIFE_S = 600.0
+
+#: ledger auto-persist cadence (hits between flushes); shutdown and
+#: ``write_handoff`` flush unconditionally
+POP_PERSIST_EVERY = 32
+
+#: entries a warm-handoff manifest ships by default
+HANDOFF_TOP_K = 16
+
 #: per-process tmp-file sequence: the pid alone is NOT a unique writer
 #: id — two dispatch threads storing the same key would share one tmp
 #: path and interleave their writes into a garbage file that the rename
@@ -78,6 +93,84 @@ def _env_float(name, default):
         return float(os.environ.get(name, default))
     except ValueError:
         return default
+
+
+def result_cache_enabled(environ=None):
+    """Default-ON parse of ``RAFT_TPU_RESULT_CACHE`` (``=0``/false/off/
+    no opts out) — the single source of truth for the engine config
+    default and the router-tier probe.  Burn-in complete (PR 17 chaos
+    faults prove a corrupt entry recomputes identical bits), so the
+    cache is now fleet infrastructure, on unless explicitly refused."""
+    env = os.environ if environ is None else environ
+    return env.get("RAFT_TPU_RESULT_CACHE", "").strip().lower() not in (
+        "0", "false", "off", "no")
+
+
+def _manifest_checksum(entries):
+    return hashlib.sha256(
+        json.dumps(entries, sort_keys=True).encode()).hexdigest()
+
+
+def _write_manifest(path, entries):
+    """Atomically persist one checksummed manifest document (the
+    popularity ledger or a warm-handoff manifest): tmp + ``os.replace``
+    exactly like the entry files, so concurrent ledger writers on a
+    shared cache dir interleave freely and a reader can never open a
+    half-written document.  Returns True on success; a failed write
+    degrades (the ledger is advisory), never raises."""
+    doc = {"schema": MANIFEST_SCHEMA, "entries": entries,
+           "checksum": _manifest_checksum(entries)}
+    tmp = f"{path}.tmp.{os.getpid()}.{next(_tmp_seq)}"
+    try:
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh)
+        os.replace(tmp, path)
+    except OSError as e:
+        logger.warning("result cache: manifest write %s failed (%s: %s)",
+                       path, type(e).__name__, e)
+        try:
+            os.remove(tmp)
+        except OSError:
+            pass
+        return False
+    inj = get_injector()
+    if inj is not None:
+        inj.corrupt_if("corrupt_manifest", path)
+    return True
+
+
+def load_manifest(path, what="manifest"):
+    """Refusing manifest load: -> the entries list, or ``[]`` after
+    DELETING the file when it is missing the schema, torn, truncated,
+    or fails its checksum — a corrupt ledger/handoff is rebuilt empty,
+    it never crashes a spawn (the ``corrupt_manifest`` chaos fault's
+    contract)."""
+    if not os.path.exists(path):
+        return []
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+        if not isinstance(doc, dict):
+            raise ValueError("not a JSON object")
+        if int(doc.get("schema", -1)) != MANIFEST_SCHEMA:
+            raise ValueError(f"schema {doc.get('schema')!r} != "
+                             f"{MANIFEST_SCHEMA}")
+        entries = doc.get("entries")
+        if not isinstance(entries, list):
+            raise ValueError("'entries' is not a list")
+        if _manifest_checksum(entries) != doc.get("checksum"):
+            raise ValueError("checksum mismatch")
+        return entries
+    except (OSError, ValueError, TypeError, KeyError,
+            UnicodeDecodeError) as e:
+        logger.warning(
+            "result cache: %s %s refused and deleted (%s: %s) — "
+            "rebuilding empty", what, path, type(e).__name__, e)
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        return []
 
 
 def _flags_blob(flags):
@@ -133,6 +226,19 @@ def coalesce_key(design, cases=None):
     return h.hexdigest()[:32]
 
 
+def sweep_coalesce_key(designs, cases=None):
+    """Single-flight identity of one sweep CHUNK (router chunk-level
+    coalescing): the chunk's exact ordered design list + case table.
+    Flags are deliberately absent, exactly as in ``coalesce_key`` — a
+    matching key guarantees identical bits from any replica of the
+    deployment, so a second sweep's chunk can ride the first's relayed
+    chunk doc."""
+    payload = json.dumps([designs, cases], sort_keys=True, default=float)
+    h = hashlib.sha256(b"sweep-chunk-flight|")
+    h.update(payload.encode())
+    return h.hexdigest()[:32]
+
+
 def _payload_checksum(arrays):
     """sha256 over the raw bytes (+ dtype/shape) of every payload array
     in name order — the embedded integrity witness ``get`` re-derives."""
@@ -164,6 +270,21 @@ class ResultCache:
         # submit path never re-hashes the code-version file set
         self.flags = current_flags()
         self.bytes_total = self._scan_bytes()
+        # popularity ledger: key -> [kind, score, t_last] with the score
+        # hit-count-decayed (half-life POP_HALF_LIFE_S).  Loaded with
+        # the refusing loader, persisted atomically beside the entries;
+        # each process persists its own view (last writer wins) — the
+        # ledger is advisory warm-handoff input, never a bits input.
+        self.pop_path = os.path.join(self.dir, "popularity.json")
+        self._pop = {}
+        self._pop_dirty = 0
+        for ent in load_manifest(self.pop_path, "popularity ledger"):
+            try:
+                key, kind, score, t_last = ent
+                self._pop[str(key)] = [str(kind), float(score),
+                                       float(t_last)]
+            except (TypeError, ValueError):
+                continue               # malformed row: skip, keep rest
 
     # ------------------------------------------------------------ paths
 
@@ -328,7 +449,100 @@ class ResultCache:
             os.utime(path)                 # LRU recency touch
         except OSError:
             pass
+        self._note_hit(key, kind)
         return (arrays, meta), 0
+
+    # ------------------------------------------- popularity / handoff
+
+    def _note_hit(self, key, kind):
+        """Bump one entry's decayed hit score and auto-persist the
+        ledger every POP_PERSIST_EVERY hits (the flush itself is atomic
+        and off the hot path's critical section)."""
+        now = time.time()
+        with self._lock:
+            ent = self._pop.get(key)
+            if ent is None:
+                self._pop[key] = [kind, 1.0, now]
+            else:
+                ent[1] = ent[1] * 2.0 ** (
+                    -max(0.0, now - ent[2]) / POP_HALF_LIFE_S) + 1.0
+                ent[2] = now
+            self._pop_dirty += 1
+            flush = self._pop_dirty >= POP_PERSIST_EVERY
+            if flush:
+                self._pop_dirty = 0
+        if flush:
+            self.flush_popularity()
+
+    def flush_popularity(self):
+        """Persist the popularity ledger now (atomic, checksummed).
+        Returns True on success."""
+        with self._lock:
+            entries = [[key, e[0], round(float(e[1]), 6), e[2]]
+                       for key, e in self._pop.items()]
+        return _write_manifest(self.pop_path, entries)
+
+    def top_entries(self, k=HANDOFF_TOP_K):
+        """The ledger head: up to ``k`` ``(key, kind)`` pairs, hottest
+        first by decayed score as of now."""
+        now = time.time()
+        with self._lock:
+            scored = sorted(
+                ((e[1] * 2.0 ** (-max(0.0, now - e[2]) / POP_HALF_LIFE_S),
+                  key, e[0]) for key, e in self._pop.items()),
+                reverse=True)
+        return [(key, kind) for _s, key, kind in scored[:max(0, int(k))]]
+
+    def write_handoff(self, tag, top_k=HANDOFF_TOP_K):
+        """Ship the popularity head to a spawning replica: persist the
+        ledger, then write ``handoff_<tag>.json`` naming the top-K
+        hottest entries (atomic + checksummed like everything else
+        here).  Returns ``(path, n_entries)``, or ``(None, 0)`` when the
+        ledger is empty or the write failed — a spawn without a handoff
+        is just a cold replica, never an error.
+
+        The ``stale_handoff`` chaos fault prepends ``value`` bogus keys
+        that name no entry on disk: the receiving replica's preload must
+        count them as plain misses and keep going."""
+        self.flush_popularity()
+        entries = [[key, kind] for key, kind in self.top_entries(top_k)]
+        inj = get_injector()
+        if inj is not None:
+            rule = inj.should("stale_handoff")
+            if rule is not None:
+                n = int(rule.value if rule.value is not None else 3)
+                entries = [[f"stale{i:03d}".ljust(32, "0"), "result"]
+                           for i in range(n)] + entries
+        if not entries:
+            return None, 0
+        path = os.path.join(self.dir, f"handoff_{tag}.json")
+        if not _write_manifest(path, entries):
+            return None, 0
+        return path, len(entries)
+
+    def preload(self, entries):
+        """Warm-handoff preload: one fully-verified read per named
+        entry (checksum + flag surface + schema — the standard gates),
+        which LRU-touches it, seeds this process's popularity view and
+        pulls the bytes through the OS page cache before the first
+        request lands.  Entries that are missing, evicted, or refused
+        count as plain misses.  Returns ``(n_loaded, n_missing)``."""
+        loaded = missing = 0
+        for ent in entries:
+            try:
+                key, kind = str(ent[0]), str(ent[1])
+            except (TypeError, IndexError):
+                missing += 1
+                continue
+            if kind == "sweep_chunk":
+                hit, _refused = self.get_chunk(key)
+            else:
+                hit, _refused = self.get_result(key)
+            if hit is None:
+                missing += 1
+            else:
+                loaded += 1
+        return loaded, missing
 
     def _refuse(self, key, path, reason):
         """Quarantine one entry: log why, delete it, shrink the byte

@@ -23,6 +23,25 @@ prep-npz cache and the warm-up manifest (serve/cache.py).  A bucket
 compiled or a design prepped by replica 1 is a disk hit for replica
 2's first request.
 
+Router-tier cache serving (PR 18): when the fleet shares a cache dir,
+the router keeps its own READ-ONLY ``ResultCache`` view of it and
+probes BEFORE choosing a replica — a verified hit (checksum + flag
+surface + schema, the full PR 17 refusal gate) resolves the pending
+handle with zero forward hop, so hit latency drops to the local
+read+verify floor and hit traffic never occupies a replica queue
+(a hit succeeds even with zero alive replicas; the autoscaler's
+pressure signal stays about real work).  A router miss populates
+nothing: replicas remain the only writers, so the single-writer
+atomicity story is untouched.  Sweeps probe per predicted chunk and
+are served router-side only when EVERY chunk has a verified entry.
+
+Warm handoff: ``scale_out`` (and therefore the autoscaler's scale-out
+and heal rules) ships the cache's popularity-ledger head as an atomic
+checksummed manifest (``RAFT_TPU_WARM_HANDOFF``) to the spawning
+replica, which pre-loads those entries before its ready line — a
+freshly scaled replica starts with the Zipf head hot instead of
+cold-missing it (pinned in tests/test_elastic.py).
+
 Resilience at the router tier (resilience.py, reused as designed in
 PR 5): a per-replica ``CircuitBreaker`` via ``BreakerBoard``; forwards
 that fail with a ``TransientError`` (dropped connection, dead replica,
@@ -38,7 +57,14 @@ followers and share its ``ok`` outcome bit-identically, one engine
 dispatch total.  Leader failure is NOT inherited: each follower
 re-dispatches independently under its own rid (the engine prep-dedup
 owner-failure semantics, lifted to the router tier), proven under the
-``dup_inflight`` chaos fault.
+``dup_inflight`` chaos fault.  The same flag extends coalescing to
+sweep CHUNKS (``result_cache.sweep_coalesce_key`` — a chunk's exact
+design list + cases): a sweep whose every chunk is already in flight
+attaches as a follower and receives each leader chunk doc remapped
+into its own design frame, zero forwards total; a chunk whose leader
+dies unfulfilled re-dispatches ONLY that follower's uncovered designs,
+seeded with the chunk docs it did receive — the leader-failure
+contract, preserved per chunk.
 
 Fault injection: the ``replica_kill`` chaos fault (chaos.py) SIGKILLs
 the replica a request was just forwarded to, forcing the
@@ -86,8 +112,15 @@ from raft_tpu.obs.metrics import MetricsRegistry
 from raft_tpu.obs.tracing import SpanRing, TraceContext
 from raft_tpu.resilience import BreakerBoard, TransientError
 from raft_tpu.serve import wire
-from raft_tpu.serve.engine import _Pending
-from raft_tpu.serve.result_cache import coalesce_key
+from raft_tpu.serve.engine import RequestResult, _Pending
+from raft_tpu.serve.result_cache import (
+    ResultCache,
+    coalesce_key,
+    result_cache_enabled,
+    result_key,
+    sweep_chunk_key,
+    sweep_coalesce_key,
+)
 from raft_tpu.serve.transport import ConnectionDropped, WireClient
 from raft_tpu.utils.profiling import logger
 
@@ -340,6 +373,51 @@ class _Inflight:
         self.followers = []
 
 
+class _InflightChunk:
+    """Sweep single-flight table entry: one chunk in flight, owned by
+    the leader sweep whose forward is expected to produce its doc.
+    ``followers`` holds the attached ``_SweepFollower`` sweeps waiting
+    on this chunk.  Attach, fulfill and abandon all serialize on the
+    router lock, so a follower can never attach to a chunk that has
+    already been fulfilled or abandoned."""
+
+    __slots__ = ("key", "owner_rid", "followers")
+
+    def __init__(self, key, owner_rid):
+        self.key = key
+        self.owner_rid = owner_rid
+        self.followers = []
+
+
+class _SweepFollower:
+    """One sweep riding other sweeps' in-flight chunks.  A sweep
+    attaches ONLY when every one of its predicted chunk keys is already
+    in flight, so a follower forwards nothing at all; ``waiting`` maps
+    each chunk key to ``(pos, idxs)`` — the chunk's position and design
+    indices in the FOLLOWER's own frame, what the leader's relayed doc
+    is remapped onto.  All mutation happens under the router lock."""
+
+    __slots__ = ("rid", "handle", "designs", "cases", "chunk",
+                 "n_chunks", "t0", "trace", "t_wall", "waiting",
+                 "docs", "done", "redispatched")
+
+    def __init__(self, rid, handle, designs, cases, chunk, n_chunks,
+                 t0, trace, t_wall):
+        self.rid = rid
+        self.handle = handle
+        self.designs = designs
+        self.cases = cases
+        self.chunk = chunk
+        self.n_chunks = n_chunks
+        self.t0 = t0
+        self.trace = trace
+        self.t_wall = t_wall
+        self.waiting = {}     # chunk key -> (pos, follower design idxs)
+        self.docs = []        # fulfilled chunk docs (follower frame)
+        self.done = set()     # follower design indices covered so far
+        self.redispatched = False
+
+
 class Router:
     """See module docstring.  Engine-compatible front surface."""
 
@@ -359,6 +437,10 @@ class Router:
         # (submit) and settle (_finish_coalesce) serialize on the lock
         "_inflight": "_lock",
         "_n_followers": "_lock",
+        # sweep chunk-level single-flight: attach (submit_sweep),
+        # fulfill (_fulfill_chunk) and abandon (_abandon_chunks) all
+        # serialize on the lock
+        "_inflight_chunks": "_lock",
     }
     # probe() is the readiness gauge: GIL-atomic len()/dict reads only,
     # so a wedged batcher holding _lock can never wedge the health check
@@ -369,8 +451,10 @@ class Router:
                  replica_argv=(), env_overrides=None,
                  endpoints=None, ready_timeout_s=DEFAULT_READY_TIMEOUT_S,
                  breaker_failures=3, breaker_cooldown_s=5.0,
-                 autoscale=None, autoscale_config=None, coalesce=None):
+                 autoscale=None, autoscale_config=None, coalesce=None,
+                 result_cache=None):
         self.cache_dir = str(cache_dir) if cache_dir else None
+        self._precision = precision
         self._lock = threading.Lock()
         self._rid = 0
         self._stop = False
@@ -386,7 +470,18 @@ class Router:
                 "1", "true", "yes", "on")
         self._coalesce = bool(coalesce)
         self._inflight = {}          # coalesce key -> _Inflight
+        self._inflight_chunks = {}   # sweep chunk key -> _InflightChunk
         self._n_followers = 0        # lock-free probe gauge
+        # router-tier result cache (module docstring): a READ-ONLY view
+        # of the fleet's shared cache dir — verified hits resolve with
+        # zero forward hop; misses populate nothing (replicas remain the
+        # only writers).  On by default whenever a shared cache dir
+        # exists; RAFT_TPU_RESULT_CACHE=0 opts the whole fleet out.
+        if result_cache is None:
+            result_cache = (self.cache_dir is not None
+                            and result_cache_enabled())
+        self._result_cache = (ResultCache(self.cache_dir)
+                              if result_cache else None)
         self._t_start = time.monotonic()
         # router-tier metrics registry + span ring
         # (docs/observability.md): the stats dict is a StatsView whose
@@ -411,6 +506,10 @@ class Router:
             "sweeps": 0, "sweep_chunk_failovers": 0,
             "scale_outs": 0, "scale_ins": 0, "reaps": 0,
             "coalesced_followers": 0, "coalesce_leader_failures": 0,
+            "cache_hits": 0, "cache_misses": 0, "cache_corrupt": 0,
+            "sweep_cache_hits": 0, "sweep_coalesced_chunks": 0,
+            "sweep_coalesce_leader_failures": 0,
+            "handoff_entries_shipped": 0,
         })
         # spawn recipe kept for scale_out (None in attach mode: the
         # router does not own attached processes, so it cannot grow or
@@ -475,6 +574,18 @@ class Router:
         t_wall = time.time()
         if trace is None:
             trace = TraceContext.new()
+        # --- router-tier result cache probe (off the lock, BEFORE any
+        # replica choice): a verified hit carries the exact bits a
+        # forwarded solve would return, so it resolves here with zero
+        # forward hop — before deadline admission (a ~free serve is
+        # never rejected) and independent of replica health (a hit
+        # succeeds with zero alive replicas) ---
+        cached, cache_refused = None, 0
+        if self._result_cache is not None:
+            cache_key = result_key(design, cases, self._precision,
+                                   flags=self._result_cache.flags)
+            cached, cache_refused = \
+                self._result_cache.get_result(cache_key)
         with self._lock:
             if self._stop:
                 raise RuntimeError("router is shut down")
@@ -484,6 +595,27 @@ class Router:
             pend = _Pending(rid)
             pend.trace_id = trace.trace_id
             self._outstanding[rid] = pend
+            if cache_refused:
+                self.stats["cache_corrupt"] += cache_refused
+            if cached is not None:
+                self.stats["cache_hits"] += 1
+                self.stats["ok"] += 1
+                self.trace_ring.record(
+                    "ingress", trace, t_wall,
+                    time.perf_counter() - t0, proc="router",
+                    status="result_cache_hit")
+                self._resolve_locked(rid, pend, RequestResult(
+                    rid=rid, status="ok", Xi=cached["Xi"],
+                    std=cached["std"],
+                    solve_report=cached["solve_report"],
+                    bucket=cached["bucket"],
+                    trace_id=trace.trace_id,
+                    latency_s=time.perf_counter() - t0,
+                    batch_requests=1, batch_occupancy=0.0,
+                    backend=cached["backend"]))
+                return pend
+            if self._result_cache is not None:
+                self.stats["cache_misses"] += 1
             # deadline admission before any forwarding
             if deadline_s is not None and deadline_s <= 0:
                 self.stats["rejected_deadline"] += 1
@@ -530,12 +662,27 @@ class Router:
         lands on the replica whose executables are already hot for that
         family.  Returns a handle with the engine ``SweepHandle``
         surface (``chunks()``/``result()``); chunk docs are relayed as
-        they stream off the replica."""
+        they stream off the replica.
+
+        With coalescing on, a sweep whose EVERY predicted chunk is
+        already in flight attaches as a chunk-level follower (zero
+        forwards: each leader chunk doc is remapped into this sweep's
+        design frame as it lands); otherwise it forwards as a leader,
+        registering its own chunks in the single-flight table."""
         designs = list(designs)
         if not designs:
             raise ValueError("submit_sweep needs at least one design")
         if trace is None:
             trace = TraceContext.new()
+        t0 = time.perf_counter()
+        t_wall = time.time()
+        # the predicted replica-side chunk partition keys both the
+        # router-tier chunk-cache probe and chunk-level single-flight
+        parts = keys = None
+        if self._result_cache is not None or self._coalesce:
+            parts = self._sweep_partition(designs, cases, chunk)
+            keys = [sweep_coalesce_key([designs[i] for i in part], cases)
+                    for part in parts]
         with self._lock:
             if self._stop:
                 raise RuntimeError("router is shut down")
@@ -548,10 +695,48 @@ class Router:
             handle._pend.trace_id = trace.trace_id
             handle._pend.router_sweep = handle
             self._outstanding[rid] = handle._pend
-        self._pool.submit(self._forward_sweep, rid, handle, designs,
-                          cases, chunk, time.perf_counter(), trace,
-                          time.time())
+            if (self._coalesce and keys
+                    and all(k in self._inflight_chunks for k in keys)):
+                fol = _SweepFollower(rid, handle, designs, cases, chunk,
+                                     len(parts), t0, trace, t_wall)
+                for pos, (part, k) in enumerate(zip(parts, keys)):
+                    fol.waiting[k] = (pos, [int(i) for i in part])
+                    self._inflight_chunks[k].followers.append(fol)
+                self.stats["sweep_coalesced_chunks"] += len(keys)
+                self.trace_ring.record(
+                    "sweep_ingress", trace, t_wall,
+                    time.perf_counter() - t0, proc="router",
+                    status="coalesced")
+                return handle
+        self._pool.submit(self._forward_sweep_entry, rid, handle,
+                          designs, cases, chunk, t0, trace, t_wall,
+                          parts, keys)
         return handle
+
+    def _sweep_partition(self, designs, cases, chunk):
+        """Predict the replica-side chunk partition of a sweep
+        (``sweep_buckets.chunk_designs`` with the same auto-chunk
+        inputs ``Engine.submit_sweep`` derives).  Replicas inherit the
+        router's environment, so prediction and replica chunking agree
+        in every fleet this router spawns; if they ever diverge (attach
+        mode to a foreign deployment) the predicted chunk keys simply
+        never match a cache entry or another sweep's — plain misses,
+        correctness untouched."""
+        from raft_tpu.sweep_buckets import chunk_designs
+
+        if cases:
+            n_cases = len(cases)
+        else:
+            n_cases = len((designs[0].get("cases") or {}).get("data")
+                          or []) or None
+        rung = None
+        if os.environ.get("RAFT_TPU_SERVE_PREEMPT",
+                          "").strip().lower() in ("1", "true", "on",
+                                                  "yes"):
+            from raft_tpu.waterfall import LANE_LADDER
+            rung = max(LANE_LADDER[0], LANE_LADDER[-1] // 4)
+        return chunk_designs(len(designs), n_cases=n_cases, chunk=chunk,
+                             rung=rung)
 
     def probe(self):
         alive = sum(1 for r in list(self.replicas.values())
@@ -583,6 +768,7 @@ class Router:
         out["queue_depth"] = len(self._outstanding)
         out["inflight_followers"] = self._n_followers
         out["coalesce"] = self._coalesce
+        out["result_cache"] = self._result_cache is not None
         out["uptime_s"] = round(time.monotonic() - self._t_start, 3)
         out["replicas"] = [r.info() for r in list(self.replicas.values())]
         out["breakers"] = self._breakers.snapshot()
@@ -723,7 +909,13 @@ class Router:
         """Spawn one more replica and claim only its vnode arcs on the
         ring (every other replica keeps its warmed buckets; the shared
         cache dir means the newcomer starts warm).  Returns the new
-        replica id."""
+        replica id.
+
+        Warm handoff: the popularity-ledger head is written as a
+        checksummed manifest and shipped via ``RAFT_TPU_WARM_HANDOFF``
+        so the newcomer pre-loads the Zipf-head entries before its
+        ready line — it joins the ring already hot.  An empty or
+        unwritable ledger just means a cold (but correct) spawn."""
         if self._spawn_kw is None:
             raise RuntimeError(
                 "cannot scale out an attached-endpoint router")
@@ -732,7 +924,20 @@ class Router:
                 raise RuntimeError("router is shut down")
             replica_id = f"r{self._next_replica}"
             self._next_replica += 1
-        rep = spawn_replica(replica_id, **self._spawn_kw)
+        spawn_kw = dict(self._spawn_kw)
+        if self._result_cache is not None:
+            path, shipped = self._result_cache.write_handoff(replica_id)
+            if path is not None:
+                env = dict(spawn_kw.get("env_overrides") or {})
+                env["RAFT_TPU_WARM_HANDOFF"] = path
+                spawn_kw["env_overrides"] = env
+                with self._lock:
+                    self.stats["handoff_entries_shipped"] += shipped
+                logger.info(
+                    "scale-out: shipping warm-handoff manifest "
+                    "(%d entr%s) to %s", shipped,
+                    "y" if shipped == 1 else "ies", replica_id)
+        rep = spawn_replica(replica_id, **spawn_kw)
         with self._lock:
             if self._stop:          # raced a shutdown: don't leak it
                 rep.proc.send_signal(signal.SIGTERM)
@@ -854,6 +1059,11 @@ class Router:
                                rep.id)
                 rep.proc.kill()
                 rep.proc.wait(5)
+        if self._result_cache is not None:
+            # persist the router's hit view of the popularity ledger
+            # (last writer wins; the ledger is advisory, never a bits
+            # input)
+            self._result_cache.flush_popularity()
 
     # -- forwarding -------------------------------------------------
 
@@ -1072,15 +1282,240 @@ class Router:
             "error": f"no replica served the request "
                      f"(tried {len(order)}; last: {last_err})"}))
 
+    def _forward_sweep_entry(self, rid, handle, designs, cases, chunk,
+                             t0, trace, t_wall, parts, keys):
+        """Sweep forwarding-thread entry.  Try to serve the whole sweep
+        from the router-tier cache (zero forward hop); otherwise forward
+        as a chunk-level single-flight leader: register this sweep's
+        not-yet-in-flight chunk keys so overlapping sweeps dedup per
+        chunk, and on exit abandon whatever this leader left unfulfilled
+        — a failed leader never fails its followers (they re-dispatch
+        their uncovered designs independently)."""
+        try:
+            if parts is not None and self._try_cached_sweep(
+                    rid, handle, designs, cases, parts, t0, trace,
+                    t_wall):
+                return
+            owned = []
+            if self._coalesce and keys:
+                with self._lock:
+                    for k in keys:
+                        if k not in self._inflight_chunks:
+                            self._inflight_chunks[k] = _InflightChunk(
+                                k, rid)
+                            owned.append(k)
+            try:
+                self._forward_sweep(rid, handle, designs, cases, chunk,
+                                    t0, trace, t_wall)
+            finally:
+                if owned:
+                    self._abandon_chunks(rid, owned)
+        except BaseException:
+            # the forwarding thread must never die with the handle
+            # unresolved — resolve terminally, then let the error log
+            logger.exception("sweep rid=%d forwarding raised", rid)
+            self._resolve(rid, handle._pend, wire.sweep_result_from_doc({
+                "rid": rid, "status": "failed",
+                "n_designs": len(designs),
+                "trace_id": getattr(trace, "trace_id", None),
+                "error": "router sweep forwarding raised"}))
+            handle._close()
+
+    def _try_cached_sweep(self, rid, handle, designs, cases, parts, t0,
+                          trace, t_wall):
+        """Serve a whole sweep from the router's cache when EVERY
+        predicted chunk has a verified entry: a cheap existence
+        pre-check over all chunk paths first (no verified read is spent
+        on a sweep with any cold chunk), then one fully-gated read per
+        chunk (checksum + flag surface + schema — refusals delete and
+        count, exactly the solo contract).  All verified -> synthesize
+        the checkpoint-schema chunk docs and terminal router-side with
+        zero forward hop; any miss or refusal -> forward the whole
+        sweep (the engine still serves whatever chunks it can from the
+        same shared dir).  Returns True when the sweep was served."""
+        cache = self._result_cache
+        if cache is None:
+            return False
+        ckeys = [sweep_chunk_key([designs[i] for i in part], cases,
+                                 self._precision, flags=cache.flags)
+                 for part in parts]
+        if not all(os.path.exists(cache._path(k)) for k in ckeys):
+            with self._lock:
+                self.stats["cache_misses"] += 1
+            return False
+        chunks = []
+        refused_total = 0
+        for k in ckeys:
+            hit, refused = cache.get_chunk(k)
+            refused_total += refused
+            if hit is None:
+                break
+            chunks.append(hit)
+        with self._lock:
+            if refused_total:
+                self.stats["cache_corrupt"] += refused_total
+            if len(chunks) < len(parts):
+                self.stats["cache_misses"] += 1
+        if len(chunks) < len(parts):
+            return False
+        docs = []
+        for pos, (part, arrays) in enumerate(zip(parts, chunks)):
+            doc = {"event": "sweep_chunk", "rid": rid, "chunk": pos,
+                   "n_chunks": len(parts),
+                   "designs": [int(i) for i in part],
+                   "wall_s": 0.0, "suspend_s": 0.0, "preemptions": 0,
+                   "mode": "cached", "failed_idx": [], "failed_msg": []}
+            doc.update(arrays)
+            docs.append(doc)
+            handle._push(doc)
+        with self._lock:
+            self.stats["sweep_cache_hits"] += 1
+            self.stats["ok"] += 1
+        res = wire.sweep_result_from_doc(
+            {"rid": rid, "status": "ok", "n_designs": len(designs),
+             "n_chunks": len(parts), "chunks_done": len(parts),
+             "mode": "cached",
+             "trace_id": getattr(trace, "trace_id", None)},
+            chunks=docs, rid=rid)
+        res.latency_s = time.perf_counter() - t0
+        self.trace_ring.record(
+            "sweep_ingress", trace, t_wall, res.latency_s,
+            proc="router", status="result_cache_hit")
+        self._resolve(rid, handle._pend, res)
+        handle._close()
+        return True
+
+    # -- sweep chunk-level single-flight ----------------------------
+
+    def _fulfill_chunk(self, rid, ch, designs, cases):
+        """Hand one relayed chunk doc to every follower waiting on its
+        single-flight key.  The key is recomputed from the doc's ACTUAL
+        design payloads, so a leader whose failover re-chunked can
+        never fulfill a key its doc does not exactly cover.  A chunk
+        with quarantined designs is not shared — its followers
+        re-dispatch (mirroring the cache's healthy-chunk-only
+        population rule)."""
+        key = sweep_coalesce_key(
+            [designs[i] for i in ch["designs"]], cases)
+        with self._lock:
+            entry = self._inflight_chunks.pop(key, None)
+            followers = list(entry.followers) if entry else []
+        if not followers:
+            return
+        if ch.get("failed_idx"):
+            for fol in followers:
+                self._redispatch_follower(fol)
+            return
+        for fol in followers:
+            self._serve_follower_chunk(fol, key, ch)
+
+    def _serve_follower_chunk(self, fol, key, ch):
+        """Push one fulfilled chunk into a follower's stream, remapped
+        to the follower's own design frame and rid; resolve the
+        follower when its last waited-on chunk lands."""
+        with self._lock:
+            if fol.redispatched or key not in fol.waiting:
+                return
+            pos, idxs = fol.waiting.pop(key)
+            doc = dict(ch)
+            doc["rid"] = fol.rid
+            doc["designs"] = list(idxs)
+            doc["failed_idx"] = []
+            doc["failed_msg"] = []
+            doc["chunk"] = pos
+            doc["n_chunks"] = fol.n_chunks
+            fol.docs.append(doc)
+            fol.done.update(idxs)
+            complete = not fol.waiting
+        fol.handle._push(doc)
+        if complete:
+            self._resolve_follower(fol)
+
+    def _resolve_follower(self, fol):
+        """Terminal for a fully-fulfilled follower: every chunk arrived
+        via leaders' relays, so the result reassembles from the
+        remapped docs exactly as a forwarded sweep's would."""
+        with self._lock:
+            self.stats["ok"] += 1
+        res = wire.sweep_result_from_doc(
+            {"rid": fol.rid, "status": "ok",
+             "n_designs": len(fol.designs),
+             "n_chunks": len(fol.docs), "chunks_done": len(fol.docs),
+             "trace_id": getattr(fol.trace, "trace_id", None)},
+            chunks=fol.docs, rid=fol.rid)
+        res.replica = fol.docs[-1].get("replica") if fol.docs else None
+        res.latency_s = time.perf_counter() - fol.t0
+        self._hist_latency.observe(res.latency_s)
+        self.trace_ring.record(
+            "sweep_ingress", fol.trace, fol.t_wall, res.latency_s,
+            proc="router", replica=res.replica, status="coalesced_ok")
+        self._resolve(fol.rid, fol.handle._pend, res)
+        fol.handle._close()
+
+    def _abandon_chunks(self, rid, owned):
+        """Leader exit: pop this leader's still-unfulfilled chunk keys
+        from the single-flight table.  Followers waiting on any popped
+        key re-dispatch independently — the leader-failure contract
+        (a failed leader never fails its followers), per chunk."""
+        victims = []
+        with self._lock:
+            for k in owned:
+                entry = self._inflight_chunks.get(k)
+                if entry is not None and entry.owner_rid == rid:
+                    del self._inflight_chunks[k]
+                    victims.extend(entry.followers)
+        for fol in victims:
+            self._redispatch_follower(fol)
+
+    def _redispatch_follower(self, fol):
+        """Re-dispatch one follower's not-yet-fulfilled designs as a
+        fresh forward under its own rid, seeded with the chunk docs it
+        DID receive (they are checkpoints: only the uncovered designs
+        cross the wire).  Idempotent — the first abandoned chunk
+        triggers it, later ones find the follower already detached."""
+        with self._lock:
+            if fol.redispatched:
+                return
+            fol.redispatched = True
+            for k in list(fol.waiting):
+                entry = self._inflight_chunks.get(k)
+                if entry is not None and fol in entry.followers:
+                    entry.followers.remove(fol)
+            fol.waiting.clear()
+            self.stats["sweep_coalesce_leader_failures"] += 1
+            pre = list(fol.docs)
+        logger.warning(
+            "sweep coalescing: rid=%d lost an in-flight chunk leader; "
+            "re-dispatching %d/%d designs independently", fol.rid,
+            len(fol.designs) - len(fol.done), len(fol.designs))
+        try:
+            self._pool.submit(self._forward_sweep, fol.rid, fol.handle,
+                              fol.designs, fol.cases, fol.chunk,
+                              fol.t0, fol.trace, fol.t_wall, pre)
+        except RuntimeError:          # pool already shut down
+            self._resolve(fol.rid, fol.handle._pend,
+                          wire.sweep_result_from_doc({
+                              "rid": fol.rid, "status": "shutdown",
+                              "n_designs": len(fol.designs),
+                              "error": "router stopped before the "
+                                       "coalesced sweep could retry"},
+                              chunks=pre))
+            fol.handle._close()
+
     def _forward_sweep(self, rid, handle, designs, cases, chunk, t0,
-                       trace=None, t_wall=None):
+                       trace=None, t_wall=None, pre_chunks=None):
         """Forward a sweep, checkpointing completed chunks: every chunk
         doc relayed off the stream is a durable partial result (the PR 2
         checkpoint schema), so when the serving replica dies mid-stream
         only the designs no completed chunk covers are resubmitted to
         the next ring replica — relayed failover chunks are remapped to
         original design indices, and the reassembled result is
-        bit-identical to an uninterrupted run."""
+        bit-identical to an uninterrupted run.
+
+        ``pre_chunks`` seeds the checkpoint set with chunk docs already
+        delivered to the handle (a coalescing follower re-dispatching
+        after its leader died): they count as completed chunks, so only
+        the uncovered designs are forwarded."""
         key = routing_key(designs[0], cases)
         order = self._ring.preference(key)
         inj = get_injector()
@@ -1088,8 +1523,13 @@ class Router:
         attempted = breaker_skips = 0
         if t_wall is None:
             t_wall = time.time()
-        streamed = []      # completed chunk docs (original design idx)
-        done = set()       # original design indices already answered
+        streamed = list(pre_chunks or [])
+        # streamed: completed chunk docs (original design idx);
+        # done: original design indices already answered
+        n_pre = len(streamed)
+        done = set()
+        for ch in streamed:
+            done.update(int(i) for i in ch.get("designs", []))
         for replica_id in order:
             rep = self.replicas.get(replica_id)
             if rep is None:                # retired mid-flight
@@ -1110,10 +1550,16 @@ class Router:
             # checkpoint restart: only the uncovered designs cross the
             # wire; idx_map carries sub-sweep index -> original index
             idx_map = [i for i in range(len(designs)) if i not in done]
-            failover = bool(streamed)
-            if failover:
+            # resumed: this attempt forwards a sub-sweep, so its
+            # terminal line must be rebuilt from the checkpoints;
+            # failover additionally means a replica died mid-stream
+            # (pre-seeded checkpoints alone are a coalesce re-dispatch,
+            # not a failover)
+            resumed = bool(streamed)
+            if len(streamed) > n_pre:
                 with self._lock:
                     self.stats["sweep_chunk_failovers"] += 1
+            if resumed:
                 logger.warning(
                     "sweep rid=%d: resuming on %s with %d/%d designs "
                     "remaining (%d chunk(s) checkpointed)", rid,
@@ -1143,6 +1589,10 @@ class Router:
                 streamed.append(ch)
                 done.update(ch["designs"])
                 handle._push(ch)
+                if self._coalesce and self._inflight_chunks:
+                    # chunk-level single-flight: this doc may be the
+                    # one a follower sweep is waiting on
+                    self._fulfill_chunk(rid, ch, designs, cases)
                 if inj is not None and not killed and inj.should(
                         "replica_kill", rid) is not None:
                     # mid-stream kill: fires AFTER a relayed chunk, so
@@ -1199,7 +1649,7 @@ class Router:
             breaker.record_success()
             rep.served += 1
             return self._resolve_sweep(rid, handle, designs, streamed,
-                                       terminal, replica_id, failover,
+                                       terminal, replica_id, resumed,
                                        t0, trace, t_wall)
         if streamed and len(done) == len(designs):
             # every design's chunk arrived but the terminal line was

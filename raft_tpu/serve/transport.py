@@ -16,6 +16,12 @@
   (``wire.parse_sweep_request``).  Always streamed NDJSON: ``accepted``,
   one ``sweep_chunk`` line per finished chunk (PR 2 checkpoint schema),
   then exactly one terminal ``sweep_result`` line (see ``_post_sweep``).
+  A router backend may answer either route straight from its own
+  result-cache tier (PR 18): the wire shape is unchanged — a solo hit
+  is a normal terminal line with ``replica`` absent, a fully-cached
+  sweep streams chunk lines with ``mode: "cached"`` — so clients never
+  see where the bits came from, only that they are the exact bits a
+  forwarded solve would have produced.
 * ``GET /healthz`` — liveness: 200 whenever the process can answer.
 * ``GET /readyz`` — readiness from ``backend.probe()`` (the cheap
   lock-free gauge): 503 while draining, stopped, or shedding

@@ -241,7 +241,8 @@ def test_engine_batched_prep_counters_and_probe(monkeypatch, tmp_path):
     monkeypatch.setenv("RAFT_TPU_BATCHED_PREP", "1")
     designs = [_spar(v) for v in (1800.0, 1500.0, 1200.0)]
     with Engine(EngineConfig(precision="float64", window_ms=5.0,
-                             cache_dir=str(tmp_path))) as eng:
+                             cache_dir=str(tmp_path),
+                             use_result_cache=False)) as eng:
         res = eng.submit_sweep(designs, chunk=2).result(600)
         probe = eng.probe()
         snap = eng.snapshot()

@@ -203,18 +203,28 @@ def test_serve_load_section_pinned_in_compact_schema():
 
 
 def test_serve_cache_section_pinned_in_compact_schema():
-    """The exact-answer result-cache bench section (PR 17) stays wired:
-    both entry points exist and the headline keys — warm-solve vs hit
-    p50 (the section asserts hit p50 <= 0.25x warm solve p50), the
-    measured hit-rate under the Zipfian loadgen mode, and the
-    corrupt-entry recompute check (must stay \"identical\") — ride the
-    compact driver line."""
+    """The exact-answer result-cache bench section (PR 17 + 18) stays
+    wired: both entry points exist and the headline keys — warm-solve
+    vs hit p50 (the section asserts hit p50 <= 0.25x warm solve p50),
+    the measured hit-rate under the Zipfian loadgen mode, the
+    corrupt-entry recompute check (must stay \"identical\"), and the
+    ISSUE 18 router-tier figures (router-tier hit p50 asserted <= 0.5x
+    the forwarded hit p50, bits \"identical\", the sweep single-flight
+    wall ratio, and the warm-handoff first-100 hit-rate delta asserted
+    <= 0.15) — ride the compact driver line."""
     assert callable(bench.bench_serve_cache)
     assert callable(bench.bench_serve_cache_smoke)
     for key in ("serve_cache_hit_p50_ms", "serve_cache_warm_p50_ms",
                 "serve_cache_speedup", "serve_cache_zipf_hit_rate",
                 "serve_cache_corrupt_check",
+                "serve_cache_router_hit_p50_ms",
+                "serve_cache_forwarded_hit_p50_ms",
+                "serve_cache_router_speedup", "serve_cache_router_bits",
+                "serve_cache_sweep_dedup_ratio",
+                "serve_cache_handoff_hit_rate",
+                "serve_cache_handoff_delta",
                 "smoke_cache_ratio", "smoke_cache_bits",
+                "smoke_cache_router_hit_ms",
                 "serve_cache_error", "serve_cache_smoke_error"):
         assert key in bench._COMPACT_KEYS, key
 

@@ -44,6 +44,14 @@ def _engine(cache_dir, **kw):
     kw.setdefault("precision", "float64")
     kw.setdefault("window_ms", 50.0)
     kw.setdefault("cache_dir", str(cache_dir))
+    # the chaos matrix must drive the REAL dispatch path every time: a
+    # result-cache hit (on by default since PR 18) on the shared module
+    # dir would short-circuit the very fault under injection.  The
+    # cache's own chaos contracts (corrupt_result_cache,
+    # corrupt_manifest, stale_handoff) live in
+    # tests/test_result_cache.py; default-on coexistence is covered by
+    # test_result_cache_default_on_coexists_with_faults below.
+    kw.setdefault("use_result_cache", False)
     return Engine(EngineConfig(**kw))
 
 
@@ -242,6 +250,34 @@ def test_corrupt_cache_entry_refused_and_rebuilt(cache_dir, baseline,
     assert snap["prep_cache_hits"] == 0     # refused, not trusted
     assert any("deleting unreadable entry" in m for m in caplog.messages)
     assert np.array_equal(r2.Xi, baseline.Xi)
+
+
+def test_result_cache_default_on_coexists_with_faults(cache_dir,
+                                                      monkeypatch):
+    """Default-ON coexistence (PR 18): an engine WITHOUT the cache
+    opt-out, on the shared chaos dir, under an injected transient
+    backend fault.  The first solve retries through the fault and
+    populates; the repeat serves from the cache bit-identically with
+    the chaos env still set — the fault surface and the cache tier
+    compose instead of masking each other."""
+    design = _spar(5500.0)
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "backend_error*1:9")
+    with Engine(EngineConfig(precision="float64", window_ms=10.0,
+                             cache_dir=str(cache_dir))) as eng:
+        assert eng._result_cache is not None     # on with zero opt-in
+        cold = eng.evaluate(design, timeout=600)
+        t0 = time.monotonic()
+        while (eng.snapshot()["result_cache_stores"] < 1
+               and time.monotonic() - t0 < 10.0):
+            time.sleep(0.01)
+        warm = eng.evaluate(design, timeout=600)
+        snap = eng.snapshot()
+    assert cold.status == "ok" and warm.status == "ok"
+    assert snap["dispatch_retries"] == 1         # the fault really fired
+    assert snap["result_cache_stores"] == 1
+    assert snap["result_cache_hits"] == 1
+    assert np.array_equal(warm.Xi, cold.Xi)
+    assert np.array_equal(warm.std, cold.std)
 
 
 # -------------------------------------------------- shedding and shutdown

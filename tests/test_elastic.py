@@ -22,6 +22,7 @@ One module-scoped 2-replica router keeps the subprocess bill at a
 single compile of the NW bucket; the destructive kill test runs LAST.
 """
 
+import contextlib
 import os
 import time
 
@@ -30,6 +31,19 @@ import pytest
 
 from raft_tpu.designs import deep_spar
 from raft_tpu.serve import Router
+
+
+@contextlib.contextmanager
+def _no_router_cache(router):
+    """Temporarily detach the router-tier result cache (on by default
+    since PR 18): the slow-abandon and mid-stream-kill tests repeat
+    designs to compare bits, and a router-tier hit would serve the
+    repeat with zero forward hop — no forwarding path left to test."""
+    saved, router._result_cache = router._result_cache, None
+    try:
+        yield
+    finally:
+        router._result_cache = saved
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 NW = (0.05, 0.5)
@@ -110,15 +124,53 @@ def test_retire_replica_drains_in_flight_to_terminal(router2):
     assert router2.probe()["replicas_alive"] == 2
 
 
+def test_scale_out_ships_warm_handoff_and_newcomer_preloads(router2):
+    """Scale-out warm handoff, end to end in a real subprocess fleet:
+    a design the router has served from its own cache tier is in its
+    popularity ledger, so the next scale-out ships a manifest naming it
+    and the newcomer pre-loads every named entry before its ready line
+    (visible on its /statz gauges).  Retires the newcomer after, so the
+    later destructive tests see the usual 2-replica fleet."""
+    d = _spar(2500.0)                # computed back in test_scale_out
+
+    def _router_tier_hit():
+        # population happens async on the serving replica; poll until
+        # the router's own probe serves it (replica is None on a hit)
+        res = router2.evaluate(d, timeout=400)
+        assert res.status == "ok", res.error
+        return res.replica is None
+
+    deadline = time.monotonic() + 60.0
+    while not _router_tier_hit():
+        assert time.monotonic() < deadline, \
+            "router-tier hit never materialized"
+        time.sleep(0.2)
+    shipped_before = router2.stats["handoff_entries_shipped"]
+    new_id = router2.scale_out()
+    try:
+        assert router2.stats["handoff_entries_shipped"] > shipped_before
+        gauges = router2.replica_gauges()[new_id]
+        assert gauges is not None, f"{new_id} unreachable"
+        assert gauges["handoff_preloaded"] >= 1
+        assert gauges["handoff_missing"] == 0
+        # the newcomer serves the shipped design from its warm cache
+        res = router2.evaluate(d, timeout=400)
+        assert res.status == "ok", res.error
+    finally:
+        assert router2.retire_replica(new_id)
+    assert router2.probe()["replicas_alive"] == 2
+
+
 def test_replica_slow_retries_next_replica_bit_identically(
         router2, monkeypatch):
     d = _spar(4000.0)
-    clean = router2.evaluate(d, timeout=400)
-    assert clean.status == "ok", clean.error
-    slows_before = router2.stats["chaos_replica_slows"]
-    monkeypatch.setenv("RAFT_TPU_CHAOS", "replica_slow=0.3*1:3")
-    slowed = router2.evaluate(d, timeout=400)
-    monkeypatch.delenv("RAFT_TPU_CHAOS")
+    with _no_router_cache(router2):
+        clean = router2.evaluate(d, timeout=400)
+        assert clean.status == "ok", clean.error
+        slows_before = router2.stats["chaos_replica_slows"]
+        monkeypatch.setenv("RAFT_TPU_CHAOS", "replica_slow=0.3*1:3")
+        slowed = router2.evaluate(d, timeout=400)
+        monkeypatch.delenv("RAFT_TPU_CHAOS")
     assert slowed.status == "ok", slowed.error
     assert router2.stats["chaos_replica_slows"] == slows_before + 1
     # abandoned the slow replica, answered by its ring successor, and
@@ -132,15 +184,16 @@ def test_midstream_kill_failover_recomputes_only_remaining_chunks(
         router2, monkeypatch):
     """LAST (kills a replica): the mid-stream chunk-failover contract."""
     designs = [_spar(1800.0 + 10 * i) for i in range(4)]
-    ref = router2.submit_sweep(designs, chunk=2).result(400)
-    assert ref.status == "ok", ref.error
-    assert ref.n_chunks == 2
-    kills_before = router2.stats["chaos_replica_kills"]
-    monkeypatch.setenv("RAFT_TPU_CHAOS", "replica_kill*1:7")
-    handle = router2.submit_sweep(designs, chunk=2)
-    chunks = list(handle.chunks(timeout=400))
-    killed = handle.result(timeout=10)
-    monkeypatch.delenv("RAFT_TPU_CHAOS")
+    with _no_router_cache(router2):
+        ref = router2.submit_sweep(designs, chunk=2).result(400)
+        assert ref.status == "ok", ref.error
+        assert ref.n_chunks == 2
+        kills_before = router2.stats["chaos_replica_kills"]
+        monkeypatch.setenv("RAFT_TPU_CHAOS", "replica_kill*1:7")
+        handle = router2.submit_sweep(designs, chunk=2)
+        chunks = list(handle.chunks(timeout=400))
+        killed = handle.result(timeout=10)
+        monkeypatch.delenv("RAFT_TPU_CHAOS")
     assert killed.status == "ok", killed.error
     assert router2.stats["chaos_replica_kills"] == kills_before + 1
     assert router2.stats["sweep_chunk_failovers"] >= 1

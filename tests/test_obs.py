@@ -298,7 +298,8 @@ def served_obs(tmp_path_factory):
     once); the warm solve seeds the histograms and the span ring."""
     eng = Engine(EngineConfig(
         precision="float64", window_ms=20.0,
-        cache_dir=str(tmp_path_factory.mktemp("serve_obs"))))
+        cache_dir=str(tmp_path_factory.mktemp("serve_obs")),
+        use_result_cache=False))
     transport = serve_http(eng)
     client = WireClient("127.0.0.1", transport.port)
     warm = eng.evaluate(_spar(), timeout=600)

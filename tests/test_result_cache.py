@@ -25,6 +25,7 @@ The contracts under test (ISSUE 17):
 
 import json
 import os
+import socket
 import subprocess
 import sys
 import threading
@@ -34,14 +35,17 @@ import numpy as np
 import pytest
 
 from raft_tpu.designs import deep_spar
-from raft_tpu.serve import Engine, EngineConfig
+from raft_tpu.serve import Engine, EngineConfig, Router
 from raft_tpu.serve.engine import RequestResult
 from raft_tpu.serve import result_cache as rc_mod
 from raft_tpu.serve.result_cache import (
     ResultCache,
     coalesce_key,
+    load_manifest,
+    result_cache_enabled,
     result_key,
     sweep_chunk_key,
+    sweep_coalesce_key,
 )
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -119,6 +123,11 @@ def test_keys_are_stable_and_discriminating():
     # the single-flight key ignores flags (one deployment shares them)
     assert coalesce_key(d1) == coalesce_key(d1)
     assert coalesce_key(d1) != coalesce_key(d2)
+    # the sweep-chunk single-flight key: flags-free like coalesce_key,
+    # order-sensitive like sweep_chunk_key, distinct from both spaces
+    assert sweep_coalesce_key([d1, d2]) == sweep_coalesce_key([d1, d2])
+    assert sweep_coalesce_key([d1, d2]) != sweep_coalesce_key([d2, d1])
+    assert sweep_coalesce_key([d1]) != coalesce_key(d1)
 
 
 # ------------------------------------------------- unit: round-trip bits
@@ -261,6 +270,185 @@ def test_read_recency_protects_hot_entries(tmp_path):
     assert cache.get_result(f"{1:032d}") == (None, 0)   # the LRU went
 
 
+# ------------------- unit: popularity ledger + warm-handoff manifest
+
+def test_manifest_roundtrip_and_refusals(tmp_path, caplog):
+    """The checksummed manifest writer/loader pair (popularity ledger
+    and warm-handoff documents): round-trips exactly, and every refusal
+    — torn JSON, edited entries failing the checksum, foreign schema —
+    deletes the file and rebuilds empty instead of trusting it."""
+    path = os.path.join(str(tmp_path), "m.json")
+    assert load_manifest(path) == []             # missing: clean empty
+    entries = [["k" * 32, "result", 2.5, 123.0]]
+    assert rc_mod._write_manifest(path, entries) is True
+    assert load_manifest(path) == entries
+    # torn write (what a non-atomic writer would leave): refused
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"schema": 1, "entries": entries})[:25])
+    with caplog.at_level("WARNING", logger="raft_tpu"):
+        assert load_manifest(path) == []
+    assert not os.path.exists(path)              # deleted, not retried
+    assert any("refused and deleted" in m for m in caplog.messages)
+    # edited entries no longer match the embedded checksum: refused
+    rc_mod._write_manifest(path, entries)
+    with open(path) as fh:
+        doc = json.load(fh)
+    doc["entries"] = [["x" * 32, "result", 1.0, 1.0]]
+    with open(path, "w") as fh:
+        json.dump(doc, fh)
+    assert load_manifest(path) == []
+    assert not os.path.exists(path)
+    # a foreign (future) schema is refused, never misparsed
+    with open(path, "w") as fh:
+        json.dump({"schema": 999, "entries": [],
+                   "checksum": rc_mod._manifest_checksum([])}, fh)
+    assert load_manifest(path) == []
+    assert not os.path.exists(path)
+
+
+def test_corrupt_manifest_chaos_rebuilds_empty(tmp_path, monkeypatch,
+                                               caplog):
+    """The ``corrupt_manifest`` chaos fault flips the ledger bytes
+    after the atomic replace: the next process refuses + deletes it and
+    starts with an empty ledger — a poisoned manifest can never crash a
+    spawn, and the ENTRY files it pointed at still serve their bits."""
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "corrupt_manifest*1:11")
+    cache = ResultCache(str(tmp_path))
+    cache.put_result("k" * 32, _fake_result(seed=4))
+    cache.get_result("k" * 32)                   # seeds the ledger
+    assert cache.flush_popularity() is True      # fault fires here
+    with caplog.at_level("WARNING", logger="raft_tpu"):
+        reborn = ResultCache(str(tmp_path))
+    assert reborn._pop == {}                     # rebuilt empty
+    assert not os.path.exists(cache.pop_path)
+    assert any("refused and deleted" in m for m in caplog.messages)
+    payload, refused = reborn.get_result("k" * 32)
+    assert refused == 0
+    _assert_bits(payload, _fake_result(seed=4))
+
+
+def test_popularity_decay_orders_top_entries(tmp_path, monkeypatch):
+    """The ledger ranks by DECAYED hit count (half-life
+    POP_HALF_LIFE_S): many hits long ago lose to one recent hit, and
+    the ordering (kinds included) survives a flush + reload."""
+    cache = ResultCache(str(tmp_path))
+
+    class _clock:
+        now = 1_000_000.0
+
+        @staticmethod
+        def time():
+            return _clock.now
+
+    monkeypatch.setattr(rc_mod, "time", _clock)
+    for _ in range(8):                           # 8 hits, score -> 8.0
+        cache._note_hit("a" * 32, "result")
+    _clock.now += 4 * rc_mod.POP_HALF_LIFE_S     # 8 decays to 0.5
+    cache._note_hit("b" * 32, "sweep_chunk")     # 1 fresh hit wins
+    want = [("b" * 32, "sweep_chunk"), ("a" * 32, "result")]
+    assert cache.top_entries(2) == want
+    assert cache.top_entries(1) == want[:1]
+    assert cache.top_entries(0) == []
+    assert cache.flush_popularity() is True
+    assert ResultCache(str(tmp_path)).top_entries(2) == want
+
+
+def test_write_handoff_and_preload(tmp_path):
+    """write_handoff ships the decayed-hottest K entries as a manifest;
+    a ledger-free receiver preloads it with fully-verified reads —
+    evicted entries and malformed rows count as plain misses, and what
+    it did verify seeds the receiver's own popularity view."""
+    src = ResultCache(str(tmp_path))
+    assert src.write_handoff("r9") == (None, 0)  # empty ledger: no-op
+    keys = [f"{i:032d}" for i in range(3)]
+    for i, k in enumerate(keys):
+        src.put_result(k, _fake_result(seed=i))
+        src.get_result(k)
+    src.put_chunk("c" * 32, {"Xi_r": np.zeros((1, 2))})
+    src.get_chunk("c" * 32)
+    path, n = src.write_handoff("r9", top_k=3)
+    assert n == 3 and path.endswith("handoff_r9.json")
+    entries = load_manifest(path, "handoff")
+    assert len(entries) == 3
+    assert ["c" * 32, "sweep_chunk"] in entries  # kinds ride along
+    os.remove(src._path(entries[0][0]))          # evict one shipped key
+    rows = entries + [["short"], None]           # + 2 malformed rows
+    os.remove(src.pop_path)                      # receiver starts cold
+    dst = ResultCache(str(tmp_path))
+    assert dst.preload(rows) == (2, 3)           # 1 evicted + 2 bad
+    assert ({k for k, _kind in dst.top_entries(10)}
+            == {e[0] for e in entries[1:]})
+
+
+def test_stale_handoff_chaos_entries_are_plain_misses(tmp_path,
+                                                      monkeypatch):
+    """The ``stale_handoff`` chaos fault prepends bogus keys naming no
+    entry on disk: the receiving preload counts them as misses, loads
+    every real entry anyway, and the spawn never fails."""
+    src = ResultCache(str(tmp_path))
+    src.put_result("k" * 32, _fake_result(seed=6))
+    src.get_result("k" * 32)
+    monkeypatch.setenv("RAFT_TPU_CHAOS", "stale_handoff=2*1:13")
+    path, n = src.write_handoff("r7")
+    assert n == 3                                # 2 bogus + 1 real
+    entries = load_manifest(path, "handoff")
+    assert [e[0] for e in entries[:2]] == [
+        "stale000".ljust(32, "0"), "stale001".ljust(32, "0")]
+    assert ResultCache(str(tmp_path)).preload(entries) == (1, 2)
+
+
+def test_concurrent_ledger_writers_never_torn(tmp_path, caplog):
+    """Several replicas' caches flushing the popularity ledger on one
+    shared dir while readers reload it: every load is one writer's
+    COMPLETE checksummed view (last writer wins, 4 well-formed rows),
+    never a torn read, a refusal, or a crash."""
+    caches = [ResultCache(str(tmp_path)) for _ in range(3)]
+    for i, c in enumerate(caches):
+        for j in range(4):
+            c._note_hit(f"w{i}h{j}".ljust(32, "0"), "result")
+    stop = time.monotonic() + 1.5
+    errors, n_loads = [], [0]
+    lock = threading.Lock()
+
+    def writer(c, wid):
+        try:
+            while time.monotonic() < stop:
+                if not c.flush_popularity():
+                    raise AssertionError("flush reported failure")
+        except Exception as exc:                  # pragma: no cover
+            with lock:
+                errors.append(f"writer {wid}: {exc!r}")
+
+    def reader(wid):
+        try:
+            while time.monotonic() < stop:
+                entries = load_manifest(caches[0].pop_path,
+                                        "popularity ledger")
+                if not entries:
+                    continue                      # pre-first-flush only
+                if len(entries) != 4 or any(
+                        len(row) != 4 for row in entries):
+                    raise AssertionError(f"torn view: {entries}")
+                with lock:
+                    n_loads[0] += 1
+        except Exception as exc:                  # pragma: no cover
+            with lock:
+                errors.append(f"reader {wid}: {exc!r}")
+
+    threads = [threading.Thread(target=writer, args=(c, i))
+               for i, c in enumerate(caches)]
+    threads += [threading.Thread(target=reader, args=(i,))
+                for i in range(2)]
+    with caplog.at_level("WARNING", logger="raft_tpu"):
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+    assert not errors, errors[:3]
+    assert n_loads[0] > 0
+    assert not any("refused and deleted" in m for m in caplog.messages)
+
+
 # ------------------------------------------- shared-dir race (threads)
 
 def test_shared_dir_concurrent_readers_writers_never_torn(tmp_path):
@@ -371,11 +559,37 @@ def cache_dir(tmp_path_factory):
 
 def test_env_flags_gate_the_cache(cache_dir, monkeypatch):
     monkeypatch.delenv("RAFT_TPU_RESULT_CACHE", raising=False)
-    assert EngineConfig().use_result_cache is False      # default OFF
+    assert result_cache_enabled() is True
+    assert EngineConfig().use_result_cache is True    # default ON (PR 18)
+    for off in ("0", "false", "off", "no"):
+        monkeypatch.setenv("RAFT_TPU_RESULT_CACHE", off)
+        assert result_cache_enabled() is False        # explicit opt-out
+        assert EngineConfig().use_result_cache is False
     monkeypatch.setenv("RAFT_TPU_RESULT_CACHE", "1")
     assert EngineConfig().use_result_cache is True
     monkeypatch.setenv("RAFT_TPU_RESULT_CACHE_MB", "1.5")
     assert EngineConfig().result_cache_mb == 1.5
+
+
+def test_default_on_requires_an_explicit_cache_dir(cache_dir,
+                                                   monkeypatch):
+    """Default-ON engages only against an EXPLICITLY configured cache
+    dir (EngineConfig.cache_dir or RAFT_TPU_CACHE_DIR): an ad-hoc
+    engine with neither must stay side-effect-free — it never writes
+    result entries into the implicit home-dir fallback."""
+    monkeypatch.delenv("RAFT_TPU_RESULT_CACHE", raising=False)
+    monkeypatch.delenv("RAFT_TPU_CACHE_DIR", raising=False)
+    eng = Engine(EngineConfig(precision="float64"))
+    try:
+        assert eng._result_cache is None
+    finally:
+        eng.shutdown()
+    monkeypatch.setenv("RAFT_TPU_CACHE_DIR", str(cache_dir))
+    eng = Engine(EngineConfig(precision="float64"))
+    try:
+        assert eng._result_cache is not None
+    finally:
+        eng.shutdown()
 
 
 def test_engine_hit_is_bit_identical_and_short_circuits(cache_dir):
@@ -500,3 +714,127 @@ def test_sweep_chunks_cached_bit_identical(cache_dir):
         third = eng.submit_sweep(designs, chunk=3).result(600)
     assert third.status == "ok"
     assert np.array_equal(third.Xi_r, ref.Xi_r)
+
+
+# ------------------------------------------- engine warm-handoff e2e
+
+def test_engine_preloads_warm_handoff_manifest(cache_dir, monkeypatch):
+    """``RAFT_TPU_WARM_HANDOFF`` names a handoff manifest: the spawning
+    engine preloads every named entry with fully-verified reads BEFORE
+    taking traffic, so its very first request hits like a warm
+    replica's — the scale-out half of the warm-handoff contract."""
+    design = _spar(3000.0)
+    with _engine(cache_dir) as eng:
+        ref = eng.evaluate(design, timeout=600)
+        _wait_stat(eng, "result_cache_stores", 1)
+        eng.evaluate(design, timeout=600)        # ledger hit for 3000.0
+        path, n = eng._result_cache.write_handoff("spawned")
+    assert ref.status == "ok"
+    assert path is not None and n >= 1
+    monkeypatch.setenv("RAFT_TPU_WARM_HANDOFF", path)
+    assert EngineConfig().warm_handoff == path   # env -> config default
+    with _engine(cache_dir) as warm:
+        first = warm.snapshot()                  # before any request
+        res = warm.evaluate(design, timeout=600)
+        snap = warm.snapshot()
+    assert first["handoff_preloaded"] >= 1       # preloaded at birth
+    assert first["handoff_missing"] == 0
+    assert res.status == "ok"
+    assert snap["result_cache_hits"] == 1        # first request: a hit
+    assert snap["result_cache_misses"] == 0
+    assert np.array_equal(res.Xi, ref.Xi)
+    assert np.array_equal(res.std, ref.std)
+
+
+# ----------------------------------- router-tier cache serving (ISSUE 18)
+
+def _dead_router(cache_dir):
+    """Attach-mode router over a just-freed port — zero ALIVE replicas,
+    nothing spawned — sharing the engines' cache dir.  Anything this
+    router serves can only have come from its own read-only cache
+    probe.  Precision must match the populating engine's: it is part of
+    every result key."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return Router(endpoints=[("127.0.0.1", port)],
+                  cache_dir=str(cache_dir), precision="float64")
+
+
+def test_router_hit_bit_identical_zero_forward_zero_replicas(cache_dir):
+    """The tentpole: the router probes its own read-only cache BEFORE
+    choosing a replica, so a verified hit resolves with zero forward
+    hop — bit-identical to the engine's answer, before deadline
+    admission, and with zero alive replicas.  A miss still fails on the
+    dead wire, and the router never populates the cache."""
+    design = _spar(3100.0)
+    with _engine(cache_dir) as eng:
+        ref = eng.evaluate(design, timeout=600)
+        _wait_stat(eng, "result_cache_stores", 1)
+    assert ref.status == "ok"
+    router = _dead_router(cache_dir)
+    try:
+        assert router.snapshot()["result_cache"] is True
+        hit = router.evaluate(design, timeout=120)
+        assert hit.status == "ok"
+        assert hit.replica is None               # zero forward hop
+        assert hit.backend == ref.backend
+        assert np.array_equal(hit.Xi, np.asarray(ref.Xi))
+        assert np.array_equal(hit.std, np.asarray(ref.std))
+        for name, a in ref.solve_report.items():
+            assert np.array_equal(hit.solve_report[name],
+                                  np.asarray(a)), name
+        # a hit is a ~free serve: it resolves BEFORE deadline admission
+        rush = router.evaluate(design, deadline_s=0.0, timeout=120)
+        assert rush.status == "ok"
+        assert router.stats["cache_hits"] == 2
+        assert router.stats["rejected_deadline"] == 0
+        # the miss path still walks the (dead) wire and fails — and the
+        # router populates NOTHING (replicas remain the only writers)
+        miss_design = _spar(3141.0)
+        miss = router.evaluate(miss_design, timeout=120)
+        assert miss.status == "failed"
+        assert router.stats["cache_misses"] >= 1
+    finally:
+        router.shutdown(wait=False)
+    probe_cache = ResultCache(str(cache_dir))
+    miss_key = result_key(_spar(3141.0), None, "float64",
+                          flags=probe_cache.flags)
+    assert not os.path.exists(probe_cache._path(miss_key))
+
+
+def test_router_sweep_served_only_when_every_chunk_verified(cache_dir):
+    """Router-tier sweep serving is all-or-nothing: with EVERY
+    predicted chunk verified the sweep resolves cached (mode 'cached',
+    zero forward hop, bit-identical); re-chunking so any chunk is cold
+    forwards the WHOLE sweep — no partial router serves."""
+    designs = [_spar(3200.0), _spar(3210.0), _spar(3220.0)]
+    with _engine(cache_dir, window_ms=5.0) as eng:
+        ref = eng.submit_sweep(designs, chunk=2).result(600)
+        _wait_stat(eng, "result_cache_stores", 2)
+    assert ref.status == "ok"
+    router = _dead_router(cache_dir)
+    try:
+        handle = router.submit_sweep(designs, chunk=2)
+        streamed = list(handle.chunks(timeout=120))
+        res = handle.result(timeout=120)
+        assert res.status == "ok"
+        assert res.mode == "cached"
+        assert res.replica is None
+        assert len(streamed) == 2                # relayed per chunk
+        assert all(ch["mode"] == "cached" for ch in streamed)
+        assert np.array_equal(res.Xi_r, ref.Xi_r)
+        assert np.array_equal(res.Xi_i, ref.Xi_i)
+        for name, a in ref.report.items():
+            assert np.array_equal(res.report[name], a), name
+        assert router.stats["sweep_cache_hits"] == 1
+        # chunk=3 partitions differently: its single chunk key is cold,
+        # so the sweep forwards (and fails on the dead wire) instead of
+        # serving any partial answer
+        cold = router.submit_sweep(designs, chunk=3).result(timeout=240)
+        assert cold.status == "failed"
+        assert router.stats["sweep_cache_hits"] == 1   # unchanged
+        assert router.stats["cache_misses"] >= 1
+    finally:
+        router.shutdown(wait=False)

@@ -180,9 +180,16 @@ def shared_cache(tmp_path_factory):
 def router2(shared_cache):
     """One 2-replica router shared by the module — the replicas compile
     the NW bucket once into the shared cache; every later test (and the
-    spawned third replica) starts warm from it."""
+    spawned third replica) starts warm from it.
+
+    The router-tier result cache (on by default since PR 18) is pinned
+    OFF: this module tests the FORWARDING tier — retries, kills,
+    coalesced dispatches — and a router-tier hit on a repeated design
+    would serve it with zero forward hops.  The router-tier serving
+    contracts live in tests/test_result_cache.py."""
     router = Router(n_replicas=2, cache_dir=shared_cache,
-                    precision="float64", window_ms=20.0)
+                    precision="float64", window_ms=20.0,
+                    result_cache=False)
     yield router
     router.shutdown()
 
@@ -272,8 +279,12 @@ def test_warm_one_warm_all_via_shared_cache(router2, shared_cache):
     assert os.path.exists(manifest), "module replicas wrote no manifest"
     d = _spar()    # the design family the module fixture already served
     t0 = time.monotonic()
+    # the module replicas also cached this design's exact ANSWER in the
+    # shared dir; opt the fresh replica's result cache out so its first
+    # request exercises the prep-manifest path this test is about
     rep = spawn_replica("fresh", cache_dir=shared_cache,
-                        precision="float64", window_ms=20.0)
+                        precision="float64", window_ms=20.0,
+                        env_overrides={"RAFT_TPU_RESULT_CACHE": "0"})
     try:
         doc = rep.client.solve({"design": d, "xi": True})
         first_request_s = time.monotonic() - t0
@@ -500,6 +511,197 @@ def test_finish_coalesce_replicates_ok_result_per_follower():
         assert entry.key not in router._inflight
     finally:
         router.shutdown(wait=False)
+
+
+def _chunk_doc(rng, rid, pos, n_chunks, designs, replica="r0"):
+    """A checkpoint-schema chunk doc with deterministic arrays (the
+    payload shape wire.sweep_result_from_doc scatters)."""
+    n = len(designs)
+    return {"event": "sweep_chunk", "rid": rid, "chunk": pos,
+            "n_chunks": n_chunks, "designs": list(designs),
+            "failed_idx": [], "failed_msg": [], "replica": replica,
+            "Xi_r": rng.standard_normal((n, 2, 6, 3)),
+            "Xi_i": rng.standard_normal((n, 2, 6, 3)),
+            "converged": np.ones((n, 2), bool),
+            "iters": np.full((n, 2), 4, np.int64),
+            "nonfinite": np.zeros((n, 2), bool),
+            "recovery_tier": np.zeros((n, 2), np.int64),
+            "residual": rng.standard_normal((n, 2)),
+            "cond": np.ones((n, 2), np.float64)}
+
+
+def test_fulfill_chunk_replicates_to_follower_and_resolves():
+    """Fast unit twin of sweep chunk-level coalescing: a leader's
+    relayed chunk docs fulfill an attached follower sweep — remapped to
+    the follower's own rid and design frame — and the follower resolves
+    with the leader's exact arrays once its last waited-on chunk
+    lands."""
+    from raft_tpu.serve.result_cache import sweep_coalesce_key
+    from raft_tpu.serve.router import (_InflightChunk,
+                                       _RouterSweepHandle,
+                                       _SweepFollower)
+
+    router = _attached_router(n=1)
+    try:
+        router._coalesce = True
+        designs = [_spar(1800.0 + i) for i in range(3)]
+        parts = [[0, 1], [2]]
+        keys = [sweep_coalesce_key([designs[i] for i in p], None)
+                for p in parts]
+        handle = _RouterSweepHandle(9, len(designs))
+        fol = _SweepFollower(9, handle, designs, None, None, len(parts),
+                             time.perf_counter(), None, time.time())
+        with router._lock:
+            router._outstanding[9] = handle._pend
+            for pos, (p, k) in enumerate(zip(parts, keys)):
+                fol.waiting[k] = (pos, list(p))
+                entry = _InflightChunk(k, 1)
+                entry.followers.append(fol)
+                router._inflight_chunks[k] = entry
+        rng = np.random.default_rng(11)
+        docs = [_chunk_doc(rng, 1, pos, len(parts), p)
+                for pos, p in enumerate(parts)]
+        for doc in docs:
+            router._fulfill_chunk(1, doc, designs, None)
+        res = handle.result(timeout=10)
+        assert res.status == "ok"
+        assert res.rid == 9                        # own rid, not 1
+        streamed = list(handle.chunks(timeout=5))
+        assert [ch["rid"] for ch in streamed] == [9, 9]
+        assert sorted(i for ch in streamed
+                      for i in ch["designs"]) == [0, 1, 2]
+        # the follower's reassembled planes are the leader's exact bits
+        for pos, p in enumerate(parts):
+            sel = np.asarray(p)
+            assert np.array_equal(res.Xi_r[sel], docs[pos]["Xi_r"])
+            assert np.array_equal(res.Xi_i[sel], docs[pos]["Xi_i"])
+        assert res.replica == "r0"
+        assert router.stats["ok"] == 1
+        assert not router._inflight_chunks         # table fully drained
+        assert not fol.waiting
+    finally:
+        router.shutdown(wait=False)
+
+
+def test_fulfill_chunk_with_quarantined_designs_is_not_shared():
+    """A chunk carrying failed (quarantined) designs never fulfills a
+    follower: the follower re-dispatches independently instead of
+    inheriting the leader's poisoned rows."""
+    from raft_tpu.serve.result_cache import sweep_coalesce_key
+    from raft_tpu.serve.router import (_InflightChunk,
+                                       _RouterSweepHandle,
+                                       _SweepFollower)
+
+    router = _attached_router(n=1)        # dead endpoint: forwards fail
+    try:
+        router._coalesce = True
+        designs = [_spar(1900.0), _spar(1901.0)]
+        key = sweep_coalesce_key(designs, None)
+        handle = _RouterSweepHandle(7, len(designs))
+        fol = _SweepFollower(7, handle, designs, None, None, 1,
+                             time.perf_counter(), None, time.time())
+        with router._lock:
+            router._outstanding[7] = handle._pend
+            fol.waiting[key] = (0, [0, 1])
+            entry = _InflightChunk(key, 1)
+            entry.followers.append(fol)
+            router._inflight_chunks[key] = entry
+        doc = _chunk_doc(np.random.default_rng(3), 1, 0, 1, [0, 1])
+        doc["failed_idx"] = [1]
+        doc["failed_msg"] = ["prep KeyError"]
+        router._fulfill_chunk(1, doc, designs, None)
+        assert fol.redispatched
+        res = handle.result(timeout=120)   # re-dispatch hits a dead port
+        assert res.rid == 7
+        assert res.status == "failed"      # its OWN wire failure
+        assert router.stats["sweep_coalesce_leader_failures"] == 1
+    finally:
+        router.shutdown(wait=False)
+
+
+def test_abandon_chunks_redispatches_follower_under_own_rid():
+    """The per-chunk leader-failure contract: a leader exiting with
+    unfulfilled chunk keys re-dispatches its followers independently
+    (idempotently — one re-dispatch even when several of its chunks are
+    abandoned), and nothing of the leader's failure is inherited."""
+    from raft_tpu.serve.result_cache import sweep_coalesce_key
+    from raft_tpu.serve.router import (_InflightChunk,
+                                       _RouterSweepHandle,
+                                       _SweepFollower)
+
+    router = _attached_router(n=1)        # dead endpoint: forwards fail
+    try:
+        router._coalesce = True
+        designs = [_spar(1910.0), _spar(1911.0)]
+        keys = [sweep_coalesce_key([designs[0]], None),
+                sweep_coalesce_key([designs[1]], None)]
+        handle = _RouterSweepHandle(5, len(designs))
+        fol = _SweepFollower(5, handle, designs, None, None, len(keys),
+                             time.perf_counter(), None, time.time())
+        with router._lock:
+            router._outstanding[5] = handle._pend
+            for pos, k in enumerate(keys):
+                fol.waiting[k] = (pos, [pos])
+                entry = _InflightChunk(k, 1)
+                entry.followers.append(fol)
+                router._inflight_chunks[k] = entry
+        router._abandon_chunks(1, keys)
+        res = handle.result(timeout=120)
+        assert res.rid == 5
+        assert res.status == "failed"      # its OWN wire failure
+        # two abandoned chunks, ONE re-dispatch (idempotent)
+        assert router.stats["sweep_coalesce_leader_failures"] == 1
+        assert not router._inflight_chunks
+        assert fol.redispatched and not fol.waiting
+    finally:
+        router.shutdown(wait=False)
+
+
+def test_abandon_chunks_respects_other_leaders_entries():
+    """_abandon_chunks only pops entries the exiting leader OWNS: a key
+    re-registered by (or belonging to) another live leader survives."""
+    from raft_tpu.serve.router import _InflightChunk
+
+    router = _attached_router(n=1)
+    try:
+        with router._lock:
+            router._inflight_chunks["k1"] = _InflightChunk("k1", 1)
+            router._inflight_chunks["k2"] = _InflightChunk("k2", 2)
+        router._abandon_chunks(1, ["k1", "k2"])
+        assert list(router._inflight_chunks) == ["k2"]
+    finally:
+        router.shutdown(wait=False)
+
+
+@pytest.mark.slow
+def test_overlapping_sweeps_coalesce_per_chunk_bit_identical(router2):
+    """E2E chunk-level single-flight over real replicas: a second
+    identical sweep submitted while the first's chunks are in flight
+    attaches as a follower (zero extra forwards) and resolves with the
+    leader's exact bits under its own rid."""
+    designs = [_spar(5000.0 + 10 * i) for i in range(4)]
+    before = dict(router2.stats)
+    router2._coalesce = True
+    try:
+        h1 = router2.submit_sweep(designs, chunk=2)
+        _wait_for(lambda: len(router2._inflight_chunks) == 2, 60,
+                  "leader chunk registration")
+        h2 = router2.submit_sweep(designs, chunk=2)
+        r1 = h1.result(timeout=400)
+        r2 = h2.result(timeout=400)
+    finally:
+        router2._coalesce = False
+    assert r1.status == "ok", r1.error
+    assert r2.status == "ok", r2.error
+    assert r1.rid != r2.rid
+    assert np.array_equal(r2.Xi_r, r1.Xi_r)
+    assert np.array_equal(r2.Xi_i, r1.Xi_i)
+    for key in r1.report:
+        assert np.array_equal(r2.report[key], r1.report[key]), key
+    assert router2.stats["sweep_coalesced_chunks"] \
+        - before["sweep_coalesced_chunks"] == 2
+    assert not router2._inflight_chunks
+    assert router2.probe()["inflight_followers"] == 0
 
 
 def test_retire_candidate_snapshots_replicas_under_lock():

@@ -36,6 +36,11 @@ def _engine(tmp_path, **kw):
     kw.setdefault("precision", "float64")
     kw.setdefault("window_ms", 100.0)
     kw.setdefault("cache_dir", str(tmp_path))
+    # this module tests the DISPATCH tier (batching, admission,
+    # shedding); the exact-answer cache (on by default since PR 18)
+    # would serve repeats without dispatching — its own contracts live
+    # in tests/test_result_cache.py
+    kw.setdefault("use_result_cache", False)
     return Engine(EngineConfig(**kw))
 
 

@@ -40,7 +40,8 @@ phase = sys.argv[1]
 report = warmup(designs=[design] if phase == "cold" else None,
                 precision="float64", cache_dir=cache_dir)
 eng = Engine(EngineConfig(precision="float64", window_ms=1.0,
-                          cache_dir=cache_dir))
+                          cache_dir=cache_dir,
+                          use_result_cache=False))
 t0 = time.perf_counter()
 res = eng.evaluate(design, timeout=600)
 t_first = time.perf_counter() - t0

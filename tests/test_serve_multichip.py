@@ -52,6 +52,9 @@ def _engine(tmp_path, **kw):
     kw.setdefault("precision", "float64")
     kw.setdefault("window_ms", 100.0)
     kw.setdefault("cache_dir", str(tmp_path))
+    # lane-mesh dispatch is what's under test; the result cache (on by
+    # default since PR 18) would serve repeats without dispatching
+    kw.setdefault("use_result_cache", False)
     return Engine(EngineConfig(**kw))
 
 

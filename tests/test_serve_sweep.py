@@ -124,8 +124,8 @@ def swept(tmp_path_factory):
     tmp = tmp_path_factory.mktemp("serve_sweep")
     out = {"designs": designs}
     with Engine(EngineConfig(precision="float64", window_ms=5.0,
-                             cache_dir=str(tmp),
-                             preempt=True)) as eng:
+                             cache_dir=str(tmp), preempt=True,
+                             use_result_cache=False)) as eng:
         out["warm"] = eng.evaluate(base, timeout=600)
         # no interactive load -> the yield predicate never fires: this
         # IS the uninterrupted reference
@@ -222,7 +222,8 @@ def test_prep_raiser_quarantined_without_failing_sweep_mates(tmp_path):
     raiser = _spar(1400.0)
     del raiser["mooring"]                            # prep KeyError
     with Engine(EngineConfig(precision="float64", window_ms=5.0,
-                             cache_dir=str(tmp_path))) as eng:
+                             cache_dir=str(tmp_path),
+                             use_result_cache=False)) as eng:
         res = eng.submit_sweep([healthy, raiser], chunk=2).result(600)
         solo = eng.evaluate(healthy, timeout=600)
     assert res.status == "ok"
@@ -241,7 +242,8 @@ def test_aging_rule_stops_yielding_after_age_budget(swept,
     base = _spar(1700.0)
     with Engine(EngineConfig(precision="float64", window_ms=5.0,
                              cache_dir=str(tmp), preempt=True,
-                             preempt_age_s=0.0)) as eng:
+                             preempt_age_s=0.0,
+                             use_result_cache=False)) as eng:
         eng.evaluate(base, timeout=600)
         h = eng.submit_sweep(swept["designs"], chunk=2)
         while not h.done():
@@ -265,7 +267,8 @@ def test_omdao_engine_mode_solver_matches_slotted_dispatch(swept,
 
     d = swept["designs"][0]
     with Engine(EngineConfig(precision="float64", window_ms=5.0,
-                             cache_dir=str(tmp_path))) as eng:
+                             cache_dir=str(tmp_path),
+                             use_result_cache=False)) as eng:
         solver = RAFT_OMDAO._engine_solver(None, eng, None, {})
         m_eng = Model(d, precision="float64")
         m_eng.analyze_unloaded()

@@ -44,7 +44,8 @@ def served_http(tmp_path_factory):
     """One engine + HTTP front end shared by the module (compiles once)."""
     eng = Engine(EngineConfig(
         precision="float64", window_ms=20.0,
-        cache_dir=str(tmp_path_factory.mktemp("serve_http"))))
+        cache_dir=str(tmp_path_factory.mktemp("serve_http")),
+        use_result_cache=False))
     transport = serve_http(eng)
     client = WireClient("127.0.0.1", transport.port)
     yield eng, transport, client
@@ -233,7 +234,8 @@ def test_drain_resolves_inflight_to_terminal_lines(tmp_path):
     import threading
 
     eng = Engine(EngineConfig(precision="float64", window_ms=200.0,
-                              cache_dir=str(tmp_path)))
+                              cache_dir=str(tmp_path),
+                              use_result_cache=False))
     transport = serve_http(eng)
     client = WireClient("127.0.0.1", transport.port)
     docs = []
@@ -270,7 +272,8 @@ def test_any_503_is_refused_before_admission_and_retryable(tmp_path):
     never as a terminal 'failed' (which would break the drain-first
     "no accepted rid is lost to retirement" guarantee)."""
     eng = Engine(EngineConfig(precision="float64", window_ms=20.0,
-                              cache_dir=str(tmp_path)))
+                              cache_dir=str(tmp_path),
+                              use_result_cache=False))
     transport = serve_http(eng)
     client = WireClient("127.0.0.1", transport.port)
     try:
