@@ -86,6 +86,9 @@ _COMPACT_KEYS = (
     "bem_stream_A_within_5pct", "bem_stream_error",
     "bem_shard_devices", "bem_shard_speedup", "bem_shard_s",
     "grad_metrics", "grad_fd_rel_err",
+    "grad_adjoint_rel_err", "grad_adjoint_ms", "grad_fd_ms",
+    "grad_adjoint_speedup",
+    "smoke_grad_rel_err", "smoke_grad_adjoint_ms", "smoke_grad_axes",
     "serve_multichip_devices", "serve_multichip_speedup_max",
     "serve_multichip_bit_identical",
     "multichip_smoke_ratio", "multichip_smoke_bits",
@@ -126,7 +129,8 @@ _COMPACT_KEYS = (
     "serve_cold_prep_p50_ms", "serve_cold_prep_solo_p50_ms",
     "smoke_prep_ratio", "smoke_prep_bits",
     "rao_error", "sweep_error", "sweep243_error", "bem_error",
-    "bem_sharded_error", "grad_error", "serve_error",
+    "bem_sharded_error", "grad_error", "grad_smoke_error",
+    "serve_error",
     "chaos_smoke_error", "kernel_error", "sweep_warm_error",
     "serve_http_error", "serve_http_smoke_error",
     "serve_sweep_error", "serve_sweep_smoke_error",
@@ -415,6 +419,7 @@ def main(argv=None):
                     ("serve_load_smoke", bench_serve_load_smoke),
                     ("serve_cache_smoke", bench_serve_cache_smoke),
                     ("chaos_smoke", bench_chaos_smoke),
+                    ("grad_smoke", bench_grad_smoke),
                     ("prep_smoke", bench_batched_prep_smoke),
                     ("multichip_smoke", bench_multichip_smoke),
                     ("analysis", bench_analysis),
@@ -828,11 +833,120 @@ def bench_gradients(params=(1, 3), eps=1e-4):
             ad = float(tang[k])
             worst = max(worst, abs(ad - fd) / (
                 abs(fd) + 1e-9 * max(abs(float(v0[k])), 1.0)))
-    return {
+    out = {
         "grad_metrics": len(METRIC_NAMES),
         "grad_params_checked": len(params),
         "grad_fd_rel_err": worst,
         "grad_wall_s": round(time.perf_counter() - t0, 1),
+    }
+
+    # reverse-mode adjoint (raft_tpu/grad, ISSUE 19): one evaluation
+    # prices EVERY knob at once, where central FD needs 2 forward evals
+    # per knob.  Parity checked on the same axes as the jvp loop
+    # (one-sided axes like draft are pinned in tests/test_grad.py);
+    # the speedup is reported, not asserted — at 4 knobs the expected
+    # warm ratio is ~2x and grows linearly with the knob count.
+    from raft_tpu.grad.response import (build_design_objective,
+                                        build_value_and_grad)
+
+    metric = "rao_pitch_peak"
+    vg, _ = build_value_and_grad(design, metric)
+    value, g = vg(th0)
+    value = float(value)
+    g = np.asarray(g)
+    t0 = time.perf_counter()
+    _v, _g = vg(th0)
+    np.asarray(_g)
+    adjoint_s = time.perf_counter() - t0
+    obj, _ = build_design_objective(design, metric)
+    fobj = jax.jit(obj)
+    float(fobj(th0))                    # compile the forward objective
+    worst_adj = 0.0
+    t0 = time.perf_counter()
+    for i in range(4):
+        e = jax.device_put(np.eye(4)[i], cpu0)
+        fp = float(fobj(th0 + eps * e))
+        fm = float(fobj(th0 - eps * e))
+        if i in params:
+            fd = (fp - fm) / (2 * eps)
+            worst_adj = max(worst_adj, abs(float(g[i]) - fd) / (
+                abs(fd) + 1e-9 * max(abs(value), 1.0)))
+    fd_s = time.perf_counter() - t0
+    out.update({
+        "grad_adjoint_rel_err": worst_adj,
+        "grad_adjoint_ms": round(adjoint_s * 1e3, 1),
+        "grad_fd_ms": round(fd_s * 1e3, 1),
+        "grad_adjoint_speedup": round(fd_s / max(adjoint_s, 1e-9), 2),
+    })
+    return out
+
+
+def bench_grad_smoke(eps=1e-4):
+    """Tier-1-safe adjoint smoke: reverse mode through the dynamics IFT
+    rule (raft_tpu/grad/fixed_point.py) on a tiny synthetic solve — a
+    broken ``custom_vjp`` is caught by ``bench.py --smoke`` in CI
+    without waiting for a full round.  Deliberately NOT the full
+    design→response adjoint: tracing that pipeline twice is ~2 min of
+    host work that no compile cache skips and would eat the whole smoke
+    budget — full-pipeline parity lives in tests/test_grad.py and the
+    honest adjoint-vs-FD speedup in bench_gradients."""
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.geometry import HydroNodes
+    from raft_tpu.grad import implicit_solve_dynamics
+
+    N, nw = 2, 6
+    w = np.arange(1, nw + 1) * 0.25
+    z1, o1 = np.zeros(N), np.ones(N)
+    eye3 = np.broadcast_to(np.eye(3), (N, 3, 3)).copy()
+    nodes = HydroNodes(
+        r=np.zeros((N, 3)), q=np.tile([0.0, 0.0, 1.0], (N, 1)),
+        qMat=eye3, p1Mat=eye3, p2Mat=eye3, v_side=o1, v_end=z1,
+        a_end=z1, a_q=o1, a_p1=o1, a_p2=o1, a_end_abs=z1,
+        Ca_p1=o1, Ca_p2=o1, Ca_End=z1,
+        Cd_q=z1, Cd_p1=z1, Cd_p2=z1, Cd_End=z1,
+        submerged=o1.astype(bool), strip_mask=o1.astype(bool))
+    u = jnp.zeros((N, 3, nw), jnp.complex128)
+    M = jnp.broadcast_to(jnp.eye(6), (nw, 6, 6))
+    B = jnp.zeros((nw, 6, 6))
+    # stiffness clear of the band's max omega^2: no undamped resonance
+    C = jnp.diag(jnp.asarray([3.0, 4.0, 5.0, 6.0, 7.0, 8.0]))
+    F_r = jnp.ones((nw, 6))
+    F_i = jnp.zeros((nw, 6))
+
+    def scalar(fr):
+        xr, xi, _ = implicit_solve_dynamics(
+            nodes, u, w, 0.25, 1025.0, M, B, C, fr, F_i,
+            XiStart=0.1, nIter=15)
+        return jnp.sum(xr * xr) + jnp.sum(xi * xi)
+
+    vg = jax.jit(jax.value_and_grad(scalar))
+    value, g = vg(F_r)
+    value, g = float(value), np.asarray(g)
+    t0 = time.perf_counter()
+    _, _g = vg(F_r)
+    np.asarray(_g)
+    adjoint_s = time.perf_counter() - t0
+    # central-FD parity on a few forcing axes, via the same executable
+    axes = [(0, 0), (nw // 2, 2), (nw - 1, 5)]
+    worst = 0.0
+    for (k, j) in axes:
+        e = np.zeros((nw, 6))
+        e[k, j] = eps
+        e = jnp.asarray(e)
+        fp, _ = vg(F_r + e)
+        fm, _ = vg(F_r - e)
+        fd = (float(fp) - float(fm)) / (2 * eps)
+        worst = max(worst, abs(float(g[k, j]) - fd) / (
+            abs(fd) + 1e-9 * max(abs(value), 1.0)))
+    if not (worst < 0.005):
+        raise AssertionError(
+            f"adjoint-vs-FD smoke parity {worst:.2e} exceeds 5e-3")
+    return {
+        "smoke_grad_rel_err": worst,
+        "smoke_grad_adjoint_ms": round(adjoint_s * 1e3, 1),
+        "smoke_grad_axes": len(axes),
     }
 
 

@@ -13,7 +13,7 @@ from raft_tpu.analysis.rules.metrics import MetricsHygiene
 from raft_tpu.analysis.rules.hygiene import AllowlistHygiene
 from raft_tpu.analysis.rules.legacy import (
     BareExcept, FixedPorts, PallasParityRegistered,
-    BatchedPrepRegistered, ChaosRegistered)
+    BatchedPrepRegistered, ChaosRegistered, CustomVjpRegistered)
 
 ALL_RULES = [
     TracedPurity(),
@@ -25,6 +25,7 @@ ALL_RULES = [
     PallasParityRegistered(),
     BatchedPrepRegistered(),
     ChaosRegistered(),
+    CustomVjpRegistered(),
     AllowlistHygiene(),
 ]
 

@@ -1,10 +1,13 @@
-"""The five pre-framework lints, migrated onto the Rule protocol.
+"""The five pre-framework lints, migrated onto the Rule protocol,
+plus later registration lints that follow the same pattern.
 
-Their bespoke test-module walkers are gone; the test files remain as
-thin shims (same test names, so tier-1 history stays comparable) that
-assert the framework rule reports nothing.  Semantics are unchanged —
-same detection logic, same allowlist keys (``path::qualname`` for the
-bare-except rule) — only the plumbing moved.
+The pre-framework rules' bespoke test-module walkers are gone; the test
+files remain as thin shims (same test names, so tier-1 history stays
+comparable) that assert the framework rule reports nothing.  Semantics
+are unchanged — same detection logic, same allowlist keys
+(``path::qualname`` for the bare-except rule) — only the plumbing
+moved.  ``custom-vjp-registered`` was born on the framework (PR 19)
+and lives here with its registration-lint siblings.
 """
 
 import ast
@@ -193,7 +196,7 @@ class FixedPorts(Rule):
         return findings
 
 
-# ------------------------------------------- registration lints (3 of them)
+# ------------------------------------------- registration lints (4 of them)
 
 def _test_registry(project, marker):
     """(imported modules, marker-test names) per tests/*.py module."""
@@ -390,4 +393,70 @@ class ChaosRegistered(Rule):
                     ident=fault,
                     message=f"chaos fault {fault!r} has no test "
                             "injecting it (add a RAFT_TPU_CHAOS test)"))
+        return findings
+
+
+class CustomVjpRegistered(Rule):
+    """Every module registering a ``custom_vjp`` rule must be covered
+    by a registered ``test_*grad*`` / ``test_*adjoint*`` test that
+    imports it.
+
+    A ``custom_vjp`` silently replaces autodiff with hand-written
+    math: nothing in the forward pass breaks when the adjoint rots,
+    so the only guard is an adjoint-vs-finite-difference parity test.
+    Intentional exceptions go in
+    ``raft_tpu/analysis/allowlists/custom-vjp-registered.txt`` with a
+    reason (reasons are REQUIRED — allowlist-hygiene rejects bare
+    entries).
+    """
+
+    name = "custom-vjp-registered"
+    scope = ()
+    describe = ("every custom_vjp module needs a registered "
+                "test_*grad*/test_*adjoint* test")
+    #: the probe must keep finding these modules, else it went stale
+    expected_modules = ("raft_tpu.grad.fixed_point",)
+
+    def _vjp_modules(self, project):
+        # `@jax.custom_vjp` on a nested def is an ast.Attribute in the
+        # decorator list, not a Call — match any reference to the name
+        mods = []
+        for module in project.package_modules():
+            for node in ast.walk(module.tree):
+                hit = (isinstance(node, ast.Attribute)
+                       and node.attr == "custom_vjp") \
+                    or (isinstance(node, ast.Name)
+                        and node.id == "custom_vjp")
+                if hit:
+                    mods.append(module)
+                    break
+        return mods
+
+    def finalize(self, project):
+        findings = []
+        mods = self._vjp_modules(project)
+        dotted = {m.dotted for m in mods}
+        for expected in self.expected_modules:
+            if project.module_by_dotted(expected) is not None \
+                    and expected not in dotted:
+                findings.append(Finding(
+                    rule=self.name, path="raft_tpu/analysis/rules/"
+                    "legacy.py", line=1, ident=f"stale-probe:{expected}",
+                    message=f"{expected} exists but the custom_vjp "
+                            "probe no longer finds it — update the "
+                            "rule"))
+        # a test counts under either marker: parity tests are named
+        # test_*grad*, quarantine-adjoint pins test_*adjoint*
+        registry = _test_registry(project, "grad") \
+            + _test_registry(project, "adjoint")
+        for module in mods:
+            covered = any(module.dotted in imports and marked
+                          for _, imports, marked in registry)
+            if not covered:
+                findings.append(Finding(
+                    rule=self.name, path=module.rel, line=1,
+                    ident=module.dotted,
+                    message=f"{module.dotted} registers a custom_vjp "
+                            "but no tests/*.py imports it and defines "
+                            "a test_*grad*/test_*adjoint* function"))
         return findings

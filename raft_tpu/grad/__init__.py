@@ -1,0 +1,60 @@
+"""Exact adjoints for the solve stack (`raft_tpu/grad`).
+
+The forward stack iterates two data-dependent fixed points — the
+drag-linearization loop (raft_tpu/dynamics.py) and the mooring
+equilibrium Newton (raft_tpu/mooring.py) — both expressed as
+``lax.while_loop``, which JAX can forward-differentiate (the traced
+parametric twin's ``jacfwd`` path, PR 12) but not reverse-differentiate.
+This package supplies the implicit-function-theorem ``custom_vjp`` rules
+that make ``jax.grad`` of any response/fatigue/RAO scalar w.r.t. design
+knobs work end-to-end:
+
+ - :mod:`raft_tpu.grad.fixed_point` — the two IFT rules.  Primals call
+   the unmodified legacy solves (bit-identical forward), and the adjoint
+   is one extra linear solve against the converged state instead of
+   backprop-through-iterations;
+ - :mod:`raft_tpu.grad.response` — the differentiable design→response
+   composition: implicit variants of the case-dynamics /
+   case-mooring builders injected into
+   :func:`raft_tpu.parametric.build_design_response`, plus the
+   objective-spec surface (`metric` × `knobs`) that the served grad
+   request type (Engine.submit_grad / POST /v1/grad) and the OpenMDAO
+   ``derivatives`` mode consume.
+
+See docs/differentiation.md for the rule derivations, the supported
+objective list, the fixed-point mode matrix, and the wire schema.
+"""
+
+from raft_tpu.grad.fixed_point import (
+    ADJOINT_ITERS_ENV,
+    adjoint_iters,
+    grad_axis,
+    implicit_solve_dynamics,
+    implicit_solve_equilibrium,
+)
+from raft_tpu.grad.response import (
+    GRAD_KNOBS,
+    GRAD_METRICS,
+    build_design_objective,
+    build_value_and_grad,
+    design_value_and_grad,
+    make_implicit_case_dynamics,
+    implicit_case_mooring,
+    parse_objective,
+)
+
+__all__ = [
+    "ADJOINT_ITERS_ENV",
+    "adjoint_iters",
+    "grad_axis",
+    "implicit_solve_dynamics",
+    "implicit_solve_equilibrium",
+    "GRAD_KNOBS",
+    "GRAD_METRICS",
+    "build_design_objective",
+    "build_value_and_grad",
+    "design_value_and_grad",
+    "make_implicit_case_dynamics",
+    "implicit_case_mooring",
+    "parse_objective",
+]

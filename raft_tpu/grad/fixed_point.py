@@ -1,0 +1,340 @@
+"""Implicit-function-theorem adjoints for the stack's two fixed points.
+
+Both iterative solves in the forward stack are ``lax.while_loop``s, which
+JAX forward-differentiates but cannot reverse-differentiate.  The rules
+here make them reverse-differentiable *without* touching their forward
+arithmetic:
+
+:func:`implicit_solve_dynamics`
+    ``custom_vjp`` around the drag-linearization fixed point.  The
+    primal calls the unmodified :func:`raft_tpu.dynamics.solve_dynamics`
+    (legacy traced while_loop), so forward bits are untouched; because
+    the waterfall engine drives the SAME per-lane phase closures (its
+    bit-parity contract) and the fused sweep agrees to solver tolerance,
+    legacy, waterfall, and fused forward modes all route through this
+    one adjoint rule.  The backward pass applies the implicit function
+    theorem at the converged state: with the per-frequency solve map
+    ``T(X) = Z(X)^-1 F(X)`` (assemble drag linearization at X -> complex
+    6x6 solves), the response satisfies ``X* = T(X*)`` and the adjoint
+    is ``ct_theta = (dT/dtheta)^T q`` where ``(I - A^T) q = v`` with
+    ``A = dT/dX`` — one extra *linear* solve against the converged
+    state, not backprop-through-iterations.  The transposed solve runs
+    the same under-relaxed damped iteration as the forward loop
+    (``p <- v + ((1-r) I + r A^T) p``, ``q = r p``), so it converges
+    whenever the forward fixed point does, and each step is one
+    ``jax.vjp`` of ``T`` (cost of a single forward iteration).
+
+:func:`implicit_solve_equilibrium`
+    ``custom_vjp`` around the mooring-equilibrium damped Newton: the
+    pose solves ``F(r6*, theta) = 0``, so
+    ``ct_theta = -(dF/dtheta)^T J^-T v`` with ``J = dF/dr6`` at the
+    converged pose — a single transposed 6x6 solve with the same tiny
+    Tikhonov damping as the forward Newton.
+
+NaN-quarantine contract (adjoint mirror of the forward freeze,
+:func:`raft_tpu.health.quarantine_cotangents`): a lane whose forward
+solve quarantined (``SolveReport.nonfinite``) returns *flagged zeros*
+as its adjoint — incoming cotangents are scaled to exactly 0.0 before
+the transposed solve, so one bad lane cannot poison a batched gradient
+and callers detect it by the same ``nonfinite`` flag as the forward.
+
+Accuracy note: the forward loop stops at its 1% amplitude tolerance,
+but the IFT linearization wants the *exact* fixed point, so the forward
+rule polishes the converged iterate (residual-only extra iterations of
+``T``; the returned primal bits are the legacy solve's, untouched)
+before linearizing.  The polish/adjoint iteration cap is
+``RAFT_TPU_GRAD_ADJOINT_ITERS`` (default 200) — part of the cached-flag
+surface (the ``grad`` axis, raft_tpu/serve/cache.py) because it bounds
+gradient accuracy.
+"""
+
+import os
+from functools import lru_cache
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.dynamics import assemble_impedance, solve_dynamics
+from raft_tpu.health import quarantine_cotangents
+from raft_tpu.hydro import linearized_drag
+from raft_tpu.mooring import (
+    body_hydrostatic_force,
+    line_forces,
+    solve_equilibrium,
+)
+
+ADJOINT_ITERS_ENV = "RAFT_TPU_GRAD_ADJOINT_ITERS"
+_DEFAULT_ADJOINT_ITERS = 200
+
+
+def adjoint_iters():
+    """Iteration cap of the transposed fixed-point solve and of the
+    residual-only polish (``RAFT_TPU_GRAD_ADJOINT_ITERS``, default 200).
+    Read at trace time, like the other solver-mode env switches."""
+    raw = os.environ.get("RAFT_TPU_GRAD_ADJOINT_ITERS", "").strip()
+    return int(raw) if raw else _DEFAULT_ADJOINT_ITERS
+
+
+def grad_axis():
+    """The grad axis of the serving flag surface: a string identifying
+    the adjoint rule revision and its accuracy-bounding configuration.
+    Two executables/results with different grad axes never alias in the
+    serving caches (raft_tpu/serve/cache.py folds this into
+    ``current_flags()``)."""
+    return "ift1;adjoint_iters=%d" % adjoint_iters()
+
+
+# =====================================================================
+# dynamics: the drag-linearization fixed point
+# =====================================================================
+
+def _dynamics_T(w, dw, rho):
+    """The per-case fixed-point solve map over (real, imag) amplitude
+    parts: ``T(x) = Z(x)^-1 F(x)`` with the drag linearization assembled
+    at x.  Same operand flow as one body iteration of
+    :func:`raft_tpu.dynamics.fixed_point_phases` (baseline precision; the
+    adjoint runs f64 on CPU, where the exact complex LU is available and
+    the mixed-precision/Pallas forward tiers don't apply)."""
+
+    def T(xr, xi, nodes, u, M_lin, B_lin, C_lin, Fr, Fi):
+        with jax.default_matmul_precision("highest"):
+            XiL = (xr + 1j * xi).astype(u.dtype)            # [6, nw]
+            B_drag, F_drag = linearized_drag(nodes, XiL, u, w, dw, rho)
+            Zr, Zi = assemble_impedance(w, M_lin, B_lin + B_drag[None],
+                                        C_lin)
+            F = F_drag + (Fr + 1j * Fi).astype(u.dtype)     # [nw, 6]
+            Z = (Zr + 1j * Zi).astype(u.dtype)
+            X = jnp.linalg.solve(Z, F[..., None])[..., 0].T  # [6, nw]
+        return jnp.real(X), jnp.imag(X)
+
+    return T
+
+
+@lru_cache(maxsize=64)
+def _dynamics_rule(w_bytes, nw, w_dtype, dw, rho, XiStart, nIter, tol,
+                   refine, relax, cap):
+    """Build (and cache) the custom_vjp rule for one frequency-grid /
+    solver-scalar configuration.  ``w`` travels as bytes so the rule is
+    hashable-keyed; everything else is a float/int literal."""
+    w = np.frombuffer(w_bytes, dtype=w_dtype, count=nw)
+    T = _dynamics_T(w, dw, rho)
+    relax_f = float(relax)
+    w_old = round(1.0 - relax_f, 12)
+
+    @jax.custom_vjp
+    def solve(nodes, u, M_lin, B_lin, C_lin, Fr, Fi):
+        return solve_dynamics(
+            nodes, u, w, dw, rho, M_lin, B_lin, C_lin, Fr, Fi,
+            XiStart, nIter=nIter, tol=tol, refine=refine, relax=relax,
+        )
+
+    def fwd(nodes, u, M_lin, B_lin, C_lin, Fr, Fi):
+        out = solve_dynamics(
+            nodes, u, w, dw, rho, M_lin, B_lin, C_lin, Fr, Fi,
+            XiStart, nIter=nIter, tol=tol, refine=refine, relax=relax,
+        )
+        xr, xi, report = out
+        ops = (nodes, u, M_lin, B_lin, C_lin, Fr, Fi)
+
+        # residual-only polish: drive the converged iterate to the exact
+        # fixed point of T before the bwd linearizes there.  The primal
+        # outputs above are returned untouched (forward bits identical to
+        # the legacy solve); only the adjoint linearization state tightens.
+        eps = float(np.finfo(jnp.result_type(xr)).eps)
+        ptol = 1e3 * eps
+
+        def cond(state):
+            i, _, _, delta = state
+            return (i < cap) & (delta > ptol)
+
+        def body(state):
+            i, pr, pi, _ = state
+            tr, ti = T(pr, pi, *ops)
+            nr = w_old * pr + relax_f * tr
+            ni = w_old * pi + relax_f * ti
+            fin = jnp.all(jnp.isfinite(nr)) & jnp.all(jnp.isfinite(ni))
+            scale = jnp.maximum(
+                jnp.maximum(jnp.max(jnp.abs(pr)), jnp.max(jnp.abs(pi))),
+                1e-30)
+            delta = jnp.maximum(jnp.max(jnp.abs(nr - pr)),
+                                jnp.max(jnp.abs(ni - pi))) / scale
+            nr = jnp.where(fin, nr, pr)
+            ni = jnp.where(fin, ni, pi)
+            return (i + 1, nr, ni, jnp.where(fin, delta, 0.0))
+
+        _, xr_s, xi_s, _ = jax.lax.while_loop(
+            cond, body,
+            (jnp.array(0), xr, xi, jnp.asarray(jnp.inf, xr.dtype)),
+        )
+        return out, (ops, xr_s, xi_s, report.nonfinite)
+
+    def bwd(res, cts):
+        ops, xr_s, xi_s, nonfinite = res
+        ct_xr, ct_xi = cts[0], cts[1]   # report cotangents are symbolic
+        #                                 zeros (non-diff health record)
+        # adjoint quarantine: flagged zeros in, flagged zeros out
+        ct_xr, ct_xi = quarantine_cotangents((ct_xr, ct_xi), nonfinite)
+
+        # A quarantined solve's saved iterate/operands can hold NaN, and
+        # NaN * 0 = NaN would re-poison the zeroed cotangents through the
+        # vjp arithmetic below.  Finite placeholders are safe here: they
+        # only alter the linearization point of lanes whose cotangents
+        # are already exact zeros (healthy entries pass through
+        # bit-untouched by the where).
+        def _fin_leaf(x):
+            x = jnp.asarray(x)
+            if not jnp.issubdtype(x.dtype, jnp.inexact):
+                return x
+            return jnp.where(jnp.isfinite(x), x, jnp.zeros_like(x))
+
+        def _fin(tree):
+            return jax.tree_util.tree_map(_fin_leaf, tree)
+
+        xr_s, xi_s = _fin(xr_s), _fin(xi_s)
+        ops = tuple(_fin(o) for o in ops)
+
+        _, vjp_x = jax.vjp(lambda a, b: T(a, b, *ops), xr_s, xi_s)
+
+        # damped transposed Neumann solve of (I - A^T) q = v via
+        # p <- v + ((1-r) I + r A^T) p,  q = r p: same contraction factor
+        # as the forward under-relaxed loop, so it converges whenever the
+        # forward did.
+        eps = float(np.finfo(jnp.result_type(xr_s)).eps)
+        vmax = jnp.maximum(jnp.max(jnp.abs(ct_xr)), jnp.max(jnp.abs(ct_xi)))
+        atol = jnp.maximum(vmax, 1e-30) * (1e2 * eps)
+
+        def cond(state):
+            i, _, _, delta = state
+            return (i < cap) & (delta > atol)
+
+        def body(state):
+            i, pr, pi, _ = state
+            ar, ai = vjp_x((pr, pi))
+            # a frozen lane can sit at a non-differentiable point of T
+            # (e.g. the drag sigma sqrt at zero response), where even a
+            # zero cotangent turns NaN through the linearization — pin
+            # the quarantined lane's update so its state stays exact 0
+            ar, ai = quarantine_cotangents((ar, ai), nonfinite)
+            nr = ct_xr + w_old * pr + relax_f * ar
+            ni = ct_xi + w_old * pi + relax_f * ai
+            delta = jnp.maximum(jnp.max(jnp.abs(nr - pr)),
+                                jnp.max(jnp.abs(ni - pi)))
+            return (i + 1, nr, ni, delta)
+
+        _, pr, pi, _ = jax.lax.while_loop(
+            cond, body,
+            (jnp.array(0), ct_xr, ct_xi,
+             jnp.asarray(jnp.inf, ct_xr.dtype)),
+        )
+        qr, qi = relax_f * pr, relax_f * pi
+
+        _, vjp_th = jax.vjp(lambda o: T(xr_s, xi_s, *o), ops)
+        (ct_ops,) = vjp_th((qr, qi))
+        # pin the quarantined lane's operand cotangents to exact zeros —
+        # the flag, not the value, is the signal (same contract as fwd)
+        return quarantine_cotangents(ct_ops, nonfinite)
+
+    solve.defvjp(fwd, bwd)
+    return solve
+
+
+def implicit_solve_dynamics(nodes, u, w, dw, rho, M_lin, B_lin, C_lin,
+                            F_lin_r, F_lin_i, XiStart, nIter=15, tol=0.01,
+                            refine=1, relax=0.8):
+    """:func:`raft_tpu.dynamics.solve_dynamics` with the IFT adjoint
+    attached: identical signature, identical forward values (the primal
+    IS the legacy solve), plus reverse-mode differentiability w.r.t.
+    ``nodes, u, M_lin, B_lin, C_lin, F_lin_r, F_lin_i``.
+
+    ``w`` must be a concrete frequency grid (numpy array) — it is a
+    solver constant, not a design variable, and it keys the cached rule.
+    The health report output is non-differentiable (its cotangents are
+    discarded); quarantined lanes return flagged-zero adjoints.
+    """
+    w = np.asarray(w)
+    rule = _dynamics_rule(
+        w.tobytes(), w.shape[0], str(w.dtype), float(dw), float(rho),
+        float(XiStart), int(nIter), float(tol), int(refine), float(relax),
+        int(adjoint_iters()),
+    )
+    return rule(nodes, u, M_lin, B_lin, C_lin, F_lin_r, F_lin_i)
+
+
+# =====================================================================
+# mooring: the equilibrium Newton
+# =====================================================================
+
+@lru_cache(maxsize=16)
+def _equilibrium_rule(rho, g, iters, step_tol):
+    """custom_vjp rule for the mooring-equilibrium pose at one
+    (rho, g, solver-scalar) configuration."""
+
+    def F(r6, f6_ext, m, v, rCG, rM, AWP, anchors, rFair, L, EA, w, Wp,
+          cb):
+        f_lines, _, _ = line_forces(r6, anchors, rFair, L, EA, w, Wp, cb,
+                                    None)
+        f_body = body_hydrostatic_force(r6, m, v, rCG, rM, AWP, rho, g)
+        return f_lines + f_body + f6_ext
+
+    @jax.custom_vjp
+    def solve(f6_ext, m, v, rCG, rM, AWP, anchors, rFair, L, EA, w, Wp,
+              cb):
+        return solve_equilibrium(
+            f6_ext, (m, v, rCG, rM, AWP), anchors, rFair, L, EA, w, Wp,
+            cb, None, rho=rho, g=g, iters=iters, step_tol=step_tol,
+        )
+
+    def fwd(f6_ext, m, v, rCG, rM, AWP, anchors, rFair, L, EA, w, Wp,
+            cb):
+        r6 = solve_equilibrium(
+            f6_ext, (m, v, rCG, rM, AWP), anchors, rFair, L, EA, w, Wp,
+            cb, None, rho=rho, g=g, iters=iters, step_tol=step_tol,
+        )
+        return r6, (r6, f6_ext, m, v, rCG, rM, AWP, anchors, rFair, L,
+                    EA, w, Wp, cb)
+
+    def bwd(res, ct_r6):
+        r6, *ops = res
+        ops = tuple(ops)
+        # IFT at the root F(r6*, theta) = 0:
+        #   ct_theta = -(dF/dtheta)^T J^-T ct_r6,  J = dF/dr6
+        # with the forward Newton's tiny Tikhonov damping so the all-slack
+        # neutral-equilibrium case (exactly singular J) stays finite.
+        J = jax.jacfwd(lambda r: F(r, *ops))(r6)
+        lam = 1e-8 * jnp.max(jnp.abs(jnp.diag(J))) + 1e-30
+        Jd = J + lam * jnp.eye(6, dtype=J.dtype)
+        q = jnp.linalg.solve(Jd.T, ct_r6)
+        _, vjp_th = jax.vjp(lambda *o: F(r6, *o), *ops)
+        return vjp_th(-q)
+
+    solve.defvjp(fwd, bwd)
+    return solve
+
+
+def implicit_solve_equilibrium(f6_ext, body_props, anchors, rFair, L, EA,
+                               w, Wp=None, cb=None, bridles=None,
+                               rho=1025.0, g=9.81, iters=40, r6_init=None,
+                               step_tol=1e-8):
+    """:func:`raft_tpu.mooring.solve_equilibrium` with the IFT adjoint
+    attached: same signature, same forward pose (the primal IS the
+    legacy damped Newton), reverse-differentiable w.r.t. every array
+    operand.  Bridled systems are out of scope (the traced parametric
+    twin already refuses them); ``r6_init`` warm starts are likewise
+    unsupported here because the adjoint linearizes at the converged
+    pose only."""
+    if bridles is not None:
+        raise NotImplementedError(
+            "implicit mooring adjoints support simple (non-bridled) "
+            "moorings")
+    if r6_init is not None:
+        raise NotImplementedError(
+            "implicit mooring adjoints do not take r6_init warm starts")
+    m, v, rCG, rM, AWP = body_props
+    if Wp is None:
+        Wp = jnp.zeros_like(L)
+    rule = _equilibrium_rule(float(rho), float(g), int(iters),
+                             float(step_tol))
+    return rule(f6_ext, m, v, rCG, rM, AWP, anchors, rFair, L, EA, w, Wp,
+                cb)

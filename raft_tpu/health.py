@@ -156,6 +156,40 @@ def log_report(rep, label="case", log=None, limit=10):
     return int(len(bad))
 
 
+def quarantine_cotangents(cts, nonfinite):
+    """Adjoint mirror of the NaN-quarantine freeze contract.
+
+    Forward contract: a non-finite iterate freezes its lane at the last
+    finite state and raises ``SolveReport.nonfinite`` instead of
+    propagating NaN through the batched solve.  The reverse-mode analogue
+    (raft_tpu/grad/fixed_point.py) must uphold the same isolation: a
+    quarantined lane's adjoint is *flagged zeros* — every cotangent
+    flowing out of that lane's solve is scaled to exactly 0.0 where
+    ``nonfinite`` is set, so one bad lane cannot poison a batched
+    gradient.  Callers detect the quarantine the same way they do in the
+    forward pass: by checking the report's ``nonfinite`` flag.
+
+    cts : pytree of cotangent arrays (lane-shaped leading axes broadcast
+        against ``nonfinite``); ``nonfinite`` is the scalar-per-lane flag.
+    Returns the same pytree with quarantined lanes zeroed.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def zero_lane(c):
+        dt = getattr(c, "dtype", None)
+        if dt is None or not jnp.issubdtype(dt, jnp.inexact):
+            # integer-input cotangents arrive as float0 symbolic zeros —
+            # already zero, and no ufunc can scale them
+            return c
+        # where, not multiply: a quarantined lane's cotangent may be NaN
+        # (non-differentiable point of the frozen state), and NaN * 0 is
+        # NaN — select() drops it exactly
+        return jnp.where(nonfinite, jnp.zeros_like(c), c)
+
+    return jax.tree_util.tree_map(zero_lane, cts)
+
+
 # ---------------------------------------------------------------------------
 # Fault-injection surface: how the chaos harness (raft_tpu/chaos.py)
 # produces an in-graph non-finite lane.  Lives HERE, next to the

@@ -955,7 +955,7 @@ def tension_jacobian(r6, anchors, rFair, L, EA, w, Wp=None, cb=None,
 
 def case_mooring(f6_ext, m, v, rCG, rM, AWP, anchors, rFair, L, EA, w,
                  Wp=None, cb=None, bridles=None, rho=1025.0, g=9.81,
-                 yawstiff=0.0):
+                 yawstiff=0.0, equilibrium_fn=None):
     """One-shot per-case mooring analysis: equilibrium pose plus all the
     linearized quantities the dynamics solve consumes
     (reference raft/raft_model.py:332-392 calcMooringAndOffsets).
@@ -973,7 +973,12 @@ def case_mooring(f6_ext, m, v, rCG, rM, AWP, anchors, rFair, L, EA, w,
     """
     if Wp is None:
         Wp = jnp.zeros_like(L)
-    r6 = solve_equilibrium(
+    # equilibrium_fn: signature-compatible replacement for
+    # solve_equilibrium — the reverse-mode path injects the IFT-adjoint
+    # variant (raft_tpu/grad/fixed_point.py) here without touching the
+    # forward arithmetic (its primal IS this default).
+    solve = solve_equilibrium if equilibrium_fn is None else equilibrium_fn
+    r6 = solve(
         f6_ext, (m, v, rCG, rM, AWP), anchors, rFair, L, EA, w, Wp, cb,
         bridles, rho=rho, g=g
     )

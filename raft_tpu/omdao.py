@@ -944,11 +944,26 @@ class RAFT_OMDAO(_ComponentBase):
     # --------------------------------------------------------- derivatives
     def compute_partials(self, inputs, partials, discrete_inputs=None):
         """Exact partials of the aggregate response outputs w.r.t. the
-        design-scale inputs, by jax.jacfwd through the traced parametric
-        pipeline (raft_tpu/parametric.py) — no finite differencing
-        anywhere.  The reference component has no compute_partials at all
-        (reference raft/omdao_raft.py), so WEIS wraps it in FD; here an
-        optimizer can consume analytic design gradients.
+        design-scale inputs via the reverse-mode IFT adjoint
+        (raft_tpu/grad, docs/differentiation.md) — no finite
+        differencing anywhere.  One adjoint evaluation per output row
+        prices ALL four design-scale columns at once (vs one forward
+        pass per column under the old jacfwd route, or eight compute()
+        evaluations under WEIS's FD wrapper around the reference
+        component, which declares no partials at all).
+
+        Engine mode: when modeling option ``engine`` (a live
+        Engine/Router) or ``engine_endpoint`` (``host:port``) is set,
+        each row is a served grad request (``Engine.submit_grad`` /
+        ``POST /v1/grad``) — the driver shares the serve tier's warmed
+        adjoint executables and its exact-answer grad cache, and the
+        served bits are identical to the in-process adjoint (the wire
+        schema round-trips f64 exactly; tests/test_grad.py).
+
+        Fallback: if the adjoint path refuses the design (the implicit
+        equilibrium rule rejects bridled moorings), the in-process mode
+        falls back to the forward-mode jacfwd twin with a warning —
+        same values to reverse/forward round-off, one pass per column.
 
         Requires modeling option ``derivatives``; only the
         (_PARTIAL_OUTPUTS x _SCALE_INPUTS) block is exact — every other
@@ -966,34 +981,121 @@ class RAFT_OMDAO(_ComponentBase):
         at member-length multiples of dls_max — derivatives are exact
         within a topology cell).
         """
-        import pickle as _pickle
+        from raft_tpu.parametric import PARAM_NAMES
 
-        import jax
-
-        from raft_tpu.parametric import PARAM_NAMES, build_design_response
-
-        if not self.options["modeling_options"].get("derivatives"):
+        modeling_opt = self.options["modeling_options"]
+        if not modeling_opt.get("derivatives"):
             raise RuntimeError(
                 "compute_partials needs modeling option 'derivatives'")
         # guard again here: options dicts are mutable after setup()
-        _check_derivative_options(self.options["modeling_options"])
+        _check_derivative_options(modeling_opt)
         if discrete_inputs is None:
             discrete_inputs = self._discrete_inputs \
                 if hasattr(self, "_discrete_inputs") else {}
         design, _mask = self._rebuild_design(inputs, discrete_inputs)
-        key = hash(_pickle.dumps(
-            design, protocol=_pickle.HIGHEST_PROTOCOL))
+        theta = self._scale_theta(inputs)
+        engine = modeling_opt.get("engine")
+        endpoint = modeling_opt.get("engine_endpoint")
+        if engine is not None or endpoint:
+            rows = self._served_partials(engine, endpoint, design,
+                                         theta, modeling_opt)
+        else:
+            rows = self._adjoint_partials(design, theta)
+            if rows is None:
+                rows = self._jacfwd_partials(design, theta)
+        for out_name, metric in _PARTIAL_OUTPUTS.items():
+            row = np.asarray(rows[metric])
+            for in_name, pname in _SCALE_INPUTS.items():
+                partials[out_name, in_name] = row[
+                    PARAM_NAMES.index(pname)]
+
+    def _design_key(self, design, family):
+        import pickle as _pickle
+
+        return (family, hash(_pickle.dumps(
+            design, protocol=_pickle.HIGHEST_PROTOCOL)))
+
+    def _adjoint_partials(self, design, theta):
+        """{metric: grad row [4]} by one reverse-mode adjoint evaluation
+        per output metric, programs cached per design topology.  Returns
+        None when the adjoint pipeline refuses the design (jacfwd
+        fallback)."""
+        import jax
+
+        from raft_tpu.grad.response import build_value_and_grad
+        from raft_tpu.utils.profiling import logger
+
+        key = self._design_key(design, "adjoint")
+        fns = self._param_fn_cache.get(key)
+        if fns is None:
+            try:
+                fns = {metric: build_value_and_grad(design, metric)[0]
+                       for metric in _PARTIAL_OUTPUTS.values()}
+            except NotImplementedError as e:
+                logger.warning(
+                    "RAFT_OMDAO: adjoint partials unavailable for this "
+                    "design (%s); falling back to forward-mode jacfwd",
+                    e)
+                return None
+            self._param_fn_cache = {key: fns}  # one design topology live
+        th = jax.device_put(np.asarray(theta, np.float64),
+                            jax.devices("cpu")[0])
+        rows = {}
+        for metric, fn in fns.items():
+            _value, g = fn(th)
+            rows[metric] = np.asarray(g)
+        return rows
+
+    def _jacfwd_partials(self, design, theta):
+        """The pre-adjoint route: jax.jacfwd through the plain traced
+        twin, one forward pass per design-scale column."""
+        import jax
+
+        from raft_tpu.parametric import build_design_response
+
+        key = self._design_key(design, "jacfwd")
         hit = self._param_fn_cache.get(key)
         if hit is None:
             f, _theta0 = build_design_response(
                 design, metrics=tuple(_PARTIAL_OUTPUTS.values()))
             hit = jax.jit(jax.jacfwd(f))
             self._param_fn_cache = {key: hit}   # one design topology live
-        theta = jax.device_put(
-            self._scale_theta(inputs), jax.devices("cpu")[0])
-        J = hit(theta)
-        for out_name, metric in _PARTIAL_OUTPUTS.items():
-            row = np.asarray(J[metric])
-            for in_name, pname in _SCALE_INPUTS.items():
-                partials[out_name, in_name] = row[
-                    PARAM_NAMES.index(pname)]
+        th = jax.device_put(np.asarray(theta, np.float64),
+                            jax.devices("cpu")[0])
+        J = hit(th)
+        return {metric: np.asarray(J[metric])
+                for metric in _PARTIAL_OUTPUTS.values()}
+
+    def _served_partials(self, engine, endpoint, design, theta,
+                         modeling_opt):
+        """{metric: grad row [4]} through the served grad request type:
+        one ``POST /v1/grad``-shaped objective per output row, answered
+        by the serve tier's adjoint programs (and its exact-answer grad
+        cache on repeat visits to a scale point)."""
+        from raft_tpu.grad.response import GRAD_KNOBS
+        from raft_tpu.parametric import PARAM_NAMES
+
+        timeout = float(modeling_opt.get("engine_timeout_s", 600.0))
+        rows = {}
+        for metric in _PARTIAL_OUTPUTS.values():
+            objective = {"metric": metric, "knobs": list(GRAD_KNOBS),
+                         "theta": [float(t) for t in theta]}
+            if engine is not None:
+                res = engine.evaluate_grad(design, objective,
+                                           timeout=timeout)
+            else:
+                from raft_tpu.serve import wire
+                from raft_tpu.serve.transport import WireClient
+
+                host, _, port = str(endpoint).rpartition(":")
+                client = WireClient(host or "127.0.0.1", int(port))
+                doc = client.grad({"design": design,
+                                   "objective": objective})
+                res = wire.grad_result_from_doc(doc)
+            if res.status != "ok":
+                raise RuntimeError(
+                    f"RAFT_OMDAO served grad failed for {metric} "
+                    f"(status={res.status}): {res.error}")
+            rows[metric] = np.asarray(
+                [res.gradient[p] for p in PARAM_NAMES], np.float64)
+        return rows

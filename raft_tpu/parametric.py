@@ -848,13 +848,21 @@ def dirlik_del_t(S, w, m_wohler, f_ref=1.0):
 # =====================================================================
 
 def build_design_response(base_design, metrics=METRIC_NAMES,
-                          m_wohler=4.0):
+                          m_wohler=4.0, dynamics_factory=None,
+                          mooring_fn=None):
     """Build the differentiable design-response function.
 
     Returns (f, theta0) where ``f(theta) -> dict`` of scalar metrics is a
     pure traceable function of the 4-parameter vector (see PARAM_NAMES)
     and ``theta0 = ones(4)`` reproduces the base design.  ``jax.jit(f)``
     and ``jax.jacfwd(f)`` both work; all math is f64 (run on CPU).
+
+    ``dynamics_factory`` / ``mooring_fn`` are signature-compatible
+    replacements for :func:`raft_tpu.model.make_case_dynamics` and
+    :func:`raft_tpu.mooring.case_mooring`: the reverse-mode path
+    (raft_tpu/grad/response.py) injects implicit-adjoint variants here
+    so ``jax.grad(f)`` works end-to-end; the defaults keep this builder
+    forward-mode-only (``jacfwd``) with bit-identical values.
     """
     model0 = Model(base_design, precision="float64", device="cpu")
     templates = process_members(base_design)
@@ -909,7 +917,11 @@ def build_design_response(base_design, metrics=METRIC_NAMES,
         ])
         gains = rotor.case_gains(wind_all)                      # 4 x [nc]
 
-    one_case = make_case_dynamics(
+    if dynamics_factory is None:
+        dynamics_factory = make_case_dynamics
+    if mooring_fn is None:
+        mooring_fn = case_mooring
+    one_case = dynamics_factory(
         w, k, model0.depth, rho, g, model0.XiStart, model0.nIter,
         np.float64, np.complex128,
     )
@@ -1001,7 +1013,7 @@ def build_design_response(base_design, metrics=METRIC_NAMES,
         rM = jnp.stack([jnp.zeros(()), jnp.zeros(()), stat["zMeta"]])
 
         def moor_one(f6):
-            return case_mooring(
+            return mooring_fn(
                 f6, stat["mass"], stat["V"], stat["rCG"], rM,
                 stat["AWP"], *arrs, bridles=None, rho=rho, g=g,
                 yawstiff=model0.yawstiff,

@@ -178,6 +178,7 @@ _CODE_VERSION_MODULES = (
     "raft_tpu.geometry", "raft_tpu.model", "raft_tpu.serve.buckets",
     "raft_tpu.pallas_kernels", "raft_tpu.precision",
     "raft_tpu.waterfall", "raft_tpu.batched_prep",
+    "raft_tpu.grad.fixed_point", "raft_tpu.grad.response",
 )
 
 
@@ -212,6 +213,7 @@ def topology_flags(devices=None, block=None):
 
 def current_flags():
     """The executable-compatibility key of the running process."""
+    from raft_tpu.grad.fixed_point import grad_axis
     from raft_tpu.pallas_kernels import pallas_enabled
     from raft_tpu.precision import mixed_precision_enabled
     from raft_tpu.serve.buckets import serve_lane_devices
@@ -232,6 +234,11 @@ def current_flags():
         # programs vs fused Pallas blocks) — an executable family warmed
         # under one mode must be refused under another
         "fixed_point": fixed_point_mode(),
+        # the adjoint-rule revision + accuracy-bounding config
+        # (RAFT_TPU_GRAD_ADJOINT_ITERS): a grad program/result computed
+        # under one adjoint configuration must never alias a forward
+        # executable or a grad artifact from another configuration
+        "grad": grad_axis(),
     }
     flags.update(topology_flags(serve_lane_devices()))
     return flags
@@ -239,7 +246,7 @@ def current_flags():
 
 #: flag keys every executable-reuse decision compares
 _FLAG_KEYS = ("backend", "x64", "code_version", "jax",
-              "pallas", "mixed_precision", "fixed_point")
+              "pallas", "mixed_precision", "fixed_point", "grad")
 #: topology keys — compared for executables/manifests, NOT for host-prep
 #: artifacts (prep bits are topology-independent: PR 3 measured
 #: host-sharded prep bit-identical to single-device)
@@ -270,6 +277,10 @@ ENV_FLAG_SURFACE = {
     # prep lane-block padding is discarded after the batched solve;
     # outputs are block-size independent by the same parity tests
     "RAFT_TPU_PREP_BLOCK": None,
+    # the adjoint/polish iteration cap bounds gradient accuracy, so a
+    # grad program or served-grad result computed under one cap must be
+    # refused under another (it folds into the "grad" flag axis)
+    "RAFT_TPU_GRAD_ADJOINT_ITERS": "grad",
     # NOTE: serving-tier flags (RAFT_TPU_RESULT_CACHE — default ON
     # since PR 18 — RAFT_TPU_WARM_HANDOFF, RAFT_TPU_ROUTER_COALESCE,
     # ...) deliberately have no row here: they are read outside the

@@ -107,6 +107,7 @@ from raft_tpu.serve.cache import (
 )
 from raft_tpu.serve.result_cache import (
     ResultCache,
+    grad_key,
     load_manifest,
     result_cache_enabled,
     result_key,
@@ -347,6 +348,35 @@ class _Pending:
         return self._result
 
 
+@dataclasses.dataclass
+class GradResult:
+    """Terminal outcome of a ``submit_grad`` request: one objective
+    value and its exact adjoint gradient (raft_tpu/grad, the IFT
+    custom_vjp rules), restricted to the requested knobs.  ``status``:
+    'ok' — evaluated (``value`` + ``gradient`` are exact f64 bits);
+    'failed' — the objective build or the evaluation raised (``error``);
+    'shutdown' — the engine stopped before it could be served.
+    """
+
+    rid: int
+    status: str
+    metric: str = None               # objective metric (GRAD_METRICS)
+    knobs: tuple = None              # knobs the gradient covers
+    value: float = None              # objective at theta
+    gradient: dict = None            # {knob: d value / d scale}
+    theta: list = None               # evaluation point (4 scale factors)
+    error: str = None
+    latency_s: float = 0.0           # submit -> result
+    cache_hit: bool = False          # served from the result cache
+    backend: str = None
+    replica: str = None              # replica id when routed (router.py)
+    trace_id: str = None
+
+    @property
+    def ok(self):
+        return self.status == "ok"
+
+
 #: per-design health arrays in sweep chunk docs and SweepResult.report —
 #: the PR 2 checkpoint schema's report fields (sweep._REPORT_FILLS).
 SWEEP_REPORT_KEYS = ("converged", "iters", "nonfinite", "recovery_tier",
@@ -545,6 +575,7 @@ class Engine:
         "_prep_futs": "_lock",
         "_bp_families": "_bp_lock",
         "_inflight": "_watch_lock",
+        "_grad_programs": "_grad_lock",
     }
     # probe() is the liveness/readiness gauge: GIL-atomic len()/scalar
     # reads only, NEVER the lock — a wedged batcher holding _lock must
@@ -577,6 +608,16 @@ class Engine:
         self._sweep_jobs = []                  # [_SweepJob] FIFO
         self._sweep_prep_pool = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="raft-sweep-prep")
+        # served grad requests (raft_tpu/grad): one worker, off the
+        # batcher — an adjoint evaluation is its own jitted program
+        # (value_and_grad over the traced design→response path), so it
+        # never rides a bucket dispatch; programs memoized per
+        # (design prep key, metric) up to RAFT_TPU_GRAD_PROGRAMS
+        self._grad_lock = threading.Lock()
+        self._grad_programs = OrderedDict()    # (key, metric) -> (fn, θ0)
+        self._grad_programs_cap = _env_int("RAFT_TPU_GRAD_PROGRAMS", 8)
+        self._grad_pool = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="raft-serve-grad")
         self._prep_cache = (PrepCache(self.config.cache_dir)
                             if self.config.use_prep_cache else None)
         # the exact-answer result cache (serve/result_cache.py): ON by
@@ -647,6 +688,9 @@ class Engine:
             "shutdown_resolved": 0, "degraded_dispatches": 0,
             "sweeps": 0, "sweep_designs": 0, "sweep_chunks": 0,
             "sweep_preemptions": 0,
+            "grad_requests": 0, "grad_ok": 0, "grad_failed": 0,
+            "grad_cache_hits": 0, "grad_cache_misses": 0,
+            "grad_cache_stores": 0, "grad_program_compiles": 0,
             "latency_s": [], "occupancy": [],
             "batch_requests": [], "prep_cache_hits": 0,
             "prep_memo_hits": 0, "prep_batched_designs": 0,
@@ -864,6 +908,165 @@ class Engine:
         """Synchronous convenience: submit + wait."""
         return self.submit(design, cases).result(timeout)
 
+    def submit_grad(self, design, objective, trace=None):
+        """Enqueue one served grad request (docs/differentiation.md):
+        evaluate ``objective`` (a wire spec — ``{"metric", "knobs"?,
+        "theta"?}``) on ``design`` and return its exact adjoint gradient
+        via the raft_tpu/grad IFT rules.  Returns a handle whose
+        ``result(timeout)`` yields a :class:`GradResult`.
+
+        A malformed objective raises ValueError synchronously (the
+        transport maps it to a 400 before any work is queued).  Answers
+        are exact-answer cached under ``grad_key`` — the flag surface's
+        ``grad`` axis keeps gradients from one adjoint configuration
+        invisible to another."""
+        from raft_tpu.grad.response import GRAD_KNOBS, parse_objective
+
+        if not isinstance(design, dict):
+            raise ValueError("submit_grad needs a design dict (the "
+                             "transport resolves path strings)")
+        metric, knobs, theta = parse_objective(objective)
+        if theta is None:
+            theta = (1.0,) * len(GRAD_KNOBS)   # the base design
+        now = time.perf_counter()
+        t_wall = time.time()
+        if trace is None:
+            trace = TraceContext.new()
+        # canonical objective doc — the ONE form engine and router hash,
+        # so a wire doc with defaulted fields still shares the entry
+        canon = {"metric": metric, "knobs": sorted(knobs),
+                 "theta": [float(t) for t in theta]}
+        cached, cache_refused, cache_key = None, 0, None
+        if self._result_cache is not None:
+            cache_key = grad_key(design, canon, self.config.precision,
+                                 flags=self._result_cache.flags)
+            cached, cache_refused = \
+                self._result_cache.get_grad(cache_key)
+        with self._lock:
+            if self._stop:
+                raise RuntimeError("engine is shut down")
+            self._rid += 1
+            rid = self._rid
+            self.stats["grad_requests"] += 1
+            pend = _Pending(rid)
+            pend.trace_id = trace.trace_id
+            pend.grad = (metric, knobs, theta)
+            if cache_refused:
+                self.stats["result_cache_corrupt"] += cache_refused
+            if cached is not None:
+                self.stats["grad_cache_hits"] += 1
+                self.stats["grad_ok"] += 1
+                self.trace_ring.record(
+                    "admission", trace, t_wall,
+                    time.perf_counter() - now,
+                    status="grad_cache_hit", rid=rid)
+                pend._set(GradResult(
+                    rid=rid, status="ok", metric=metric,
+                    knobs=tuple(knobs),
+                    value=cached["value"],
+                    gradient={k: cached["gradient"][k] for k in knobs},
+                    theta=cached["theta"],
+                    latency_s=time.perf_counter() - now,
+                    cache_hit=True, backend=cached["backend"],
+                    trace_id=trace.trace_id))
+                return pend
+            if self._result_cache is not None:
+                self.stats["grad_cache_misses"] += 1
+            self._outstanding[rid] = pend
+            self.trace_ring.record(
+                "admission", trace, t_wall, time.perf_counter() - now,
+                status="grad_queued", rid=rid)
+        self._grad_pool.submit(
+            self._run_grad, rid, pend, design, metric, knobs, theta,
+            cache_key, trace, now, t_wall)
+        return pend
+
+    def evaluate_grad(self, design, objective, timeout=600.0):
+        """Synchronous convenience: submit_grad + wait."""
+        return self.submit_grad(design, objective).result(timeout)
+
+    def _grad_program(self, design, metric):
+        """The memoized jitted ``theta -> (value, grad)`` program of one
+        (design, metric) pair — compiled once per engine process (and
+        once per FLEET via the persistent XLA compilation cache the
+        engine installs at startup: a warmed replica reuses the adjoint
+        executable exactly like a forward bucket executable)."""
+        from raft_tpu.grad.response import build_value_and_grad
+
+        key = (design_prep_key(design, None, self.config.precision),
+               metric)
+        with self._grad_lock:
+            hit = self._grad_programs.get(key)
+            if hit is not None:
+                self._grad_programs.move_to_end(key)
+                return hit
+        # build OUTSIDE _grad_lock: tracing a design→response program
+        # takes seconds and probe()/stats readers must not queue behind
+        # it.  Two racing builders both build; last writer wins the memo
+        # (the programs are deterministic twins, so either is correct).
+        fn, theta0 = build_value_and_grad(design, metric)
+        with self._lock:
+            self.stats["grad_program_compiles"] += 1
+        with self._grad_lock:
+            self._grad_programs[key] = (fn, theta0)
+            self._grad_programs.move_to_end(key)
+            while len(self._grad_programs) > self._grad_programs_cap:
+                self._grad_programs.popitem(last=False)
+        return fn, theta0
+
+    def _run_grad(self, rid, pend, design, metric, knobs, theta,
+                  cache_key, trace, t0, t_wall):
+        """Grad worker body: build/reuse the program, evaluate, resolve
+        (exactly-once, like every other terminal path), populate the
+        exact-answer cache on finite ok."""
+        from raft_tpu.grad.response import GRAD_KNOBS
+
+        backend = self.config.device or jax.default_backend()
+        try:
+            with obs_span(self.trace_ring, "grad", trace, rid=rid,
+                          metric=metric):
+                fn, _theta0 = self._grad_program(design, metric)
+                th = jax.device_put(
+                    np.asarray(theta, np.float64),
+                    jax.devices("cpu")[0])
+                value, g = fn(th)
+                g = np.asarray(g)
+                value = float(value)
+            res = GradResult(
+                rid=rid, status="ok", metric=metric, knobs=tuple(knobs),
+                value=value,
+                gradient={p: float(g[i])
+                          for i, p in enumerate(GRAD_KNOBS)
+                          if p in knobs},
+                theta=[float(t) for t in theta],
+                latency_s=time.perf_counter() - t0, backend=backend,
+                trace_id=getattr(trace, "trace_id", None))
+        except Exception as e:  # noqa: BLE001 — becomes status="failed"
+            res = GradResult(
+                rid=rid, status="failed", metric=metric,
+                knobs=tuple(knobs),
+                theta=[float(t) for t in theta],
+                error=f"{type(e).__name__}: {e}",
+                latency_s=time.perf_counter() - t0, backend=backend,
+                trace_id=getattr(trace, "trace_id", None))
+        # store BEFORE resolving: a resolved grad handle implies the
+        # cache entry is durable, so an immediate identical submit hits
+        # deterministically (the payload is a handful of scalars — the
+        # atomic npz write costs microseconds, not a dispatch)
+        if (res.ok and cache_key is not None
+                and self._result_cache is not None
+                and np.isfinite(res.value)
+                and all(np.isfinite(v) for v in res.gradient.values())):
+            evicted = self._result_cache.put_grad(cache_key, res)
+            with self._lock:
+                if evicted >= 0:
+                    self.stats["grad_cache_stores"] += 1
+                if evicted > 0:
+                    self.stats["result_cache_evictions"] += evicted
+        if self._resolve(pend, res):
+            with self._lock:
+                self.stats["grad_ok" if res.ok else "grad_failed"] += 1
+
     def bucket_for(self, design, cases=None):
         """The bucket a request for this design will serve under (used by
         tests and by callers who want the matching direct
@@ -902,6 +1105,7 @@ class Engine:
         # without drain, queued-but-unstarted preps are pointless work
         self._prep_pool.shutdown(wait=False, cancel_futures=not drain)
         self._sweep_prep_pool.shutdown(wait=False, cancel_futures=True)
+        self._grad_pool.shutdown(wait=False, cancel_futures=not drain)
         if wait:
             self._thread.join(timeout)
             if self._thread.is_alive():
@@ -959,6 +1163,18 @@ class Engine:
                               "finished")):
                     resolved += 1
                 job.handle._close()
+                continue
+            spec = getattr(pend, "grad", None)
+            if spec is not None:
+                metric, knobs, theta = spec
+                if self._resolve(pend, GradResult(
+                        rid=pend.rid, status="shutdown", metric=metric,
+                        knobs=tuple(knobs),
+                        theta=[float(t) for t in theta],
+                        trace_id=getattr(pend, "trace_id", None),
+                        error="engine stopped before this grad request "
+                              "was served")):
+                    resolved += 1
                 continue
             if self._resolve(pend, RequestResult(
                     rid=pend.rid, status="shutdown",
@@ -2275,6 +2491,14 @@ class Engine:
             # endpoint
             "handoff_preloaded": self.stats["handoff_preloaded"],
             "handoff_missing": self.stats["handoff_missing"],
+            # served adjoint evaluations (docs/differentiation.md)
+            "grad_requests": self.stats["grad_requests"],
+            "grad_ok": self.stats["grad_ok"],
+            "grad_failed": self.stats["grad_failed"],
+            "grad_cache_hits": self.stats["grad_cache_hits"],
+            "grad_cache_misses": self.stats["grad_cache_misses"],
+            "grad_cache_stores": self.stats["grad_cache_stores"],
+            "grad_program_compiles": self.stats["grad_program_compiles"],
             "first_result_s": self.stats["first_result_s"],
             "bucket_compiles": self.stats["bucket_compiles"],
             "warmup": self.stats["warmup"],

@@ -210,6 +210,29 @@ def sweep_chunk_key(designs, cases, precision, flags=None):
     return h.hexdigest()[:32]
 
 
+def grad_key(design, objective, precision, flags=None):
+    """Content address of one served grad answer (value + adjoint
+    gradient of one objective at one evaluation point).
+
+    ``objective`` must be the CANONICAL parsed form — the dict
+    ``{"metric", "knobs", "theta"}`` built from
+    :func:`raft_tpu.grad.response.parse_objective`'s output — so the
+    engine and the router derive identical keys from one wire doc.
+    The flag surface (which carries the ``grad`` axis: adjoint rule
+    revision + iteration cap) pins the executable family, so a gradient
+    computed under one adjoint configuration is never served under
+    another."""
+    from raft_tpu.serve.router import routing_key
+
+    payload = json.dumps([design, objective, precision], sort_keys=True,
+                         default=float)
+    h = hashlib.sha256(b"grad|")
+    h.update(routing_key(design, None).encode())
+    h.update(payload.encode())
+    h.update(_flags_blob(flags or current_flags()))
+    return h.hexdigest()[:32]
+
+
 def coalesce_key(design, cases=None):
     """Single-flight identity for router-level in-flight coalescing:
     two requests with this key equal are guaranteed identical bits
@@ -360,6 +383,43 @@ class ResultCache:
                   if meta.get("bucket") else None)
         return {"Xi": Xi, "std": arrays["std"],
                 "solve_report": report or None, "bucket": bucket,
+                "backend": meta.get("backend")}, refused
+
+    # ------------------------------------------------------------- grad
+
+    def put_grad(self, key, res):
+        """Store an ``ok`` GradResult's value + adjoint gradient (all
+        f64 scalars — npz round-trips the exact bits).  Same return
+        contract as ``put_result``."""
+        knobs = sorted(res.gradient)
+        arrays = {
+            "value": np.asarray(res.value, np.float64),
+            "gradient": np.asarray([res.gradient[k] for k in knobs],
+                                   np.float64),
+            "theta": np.asarray(res.theta, np.float64),
+        }
+        meta = {
+            "kind": "grad",
+            "metric": res.metric,
+            "knobs": knobs,
+            "backend": res.backend,
+        }
+        return self._put(key, arrays, meta)
+
+    def get_grad(self, key):
+        """-> (payload dict | None, n_refused): value / gradient /
+        theta / metric / backend, bit-exact as stored."""
+        hit, refused = self._get(key, "grad")
+        if hit is None:
+            return None, refused
+        arrays, meta = hit
+        knobs = list(meta.get("knobs", []))
+        g = arrays["gradient"]
+        return {"value": float(arrays["value"]),
+                "gradient": {k: float(g[i])
+                             for i, k in enumerate(knobs)},
+                "theta": [float(t) for t in arrays["theta"]],
+                "metric": meta.get("metric"),
                 "backend": meta.get("backend")}, refused
 
     # ----------------------------------------------------------- sweeps

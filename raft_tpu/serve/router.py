@@ -112,10 +112,11 @@ from raft_tpu.obs.metrics import MetricsRegistry
 from raft_tpu.obs.tracing import SpanRing, TraceContext
 from raft_tpu.resilience import BreakerBoard, TransientError
 from raft_tpu.serve import wire
-from raft_tpu.serve.engine import RequestResult, _Pending
+from raft_tpu.serve.engine import GradResult, RequestResult, _Pending
 from raft_tpu.serve.result_cache import (
     ResultCache,
     coalesce_key,
+    grad_key,
     result_cache_enabled,
     result_key,
     sweep_chunk_key,
@@ -510,6 +511,8 @@ class Router:
             "sweep_cache_hits": 0, "sweep_coalesced_chunks": 0,
             "sweep_coalesce_leader_failures": 0,
             "handoff_entries_shipped": 0,
+            "grad_requests": 0, "grad_forwarded": 0,
+            "grad_cache_hits": 0, "grad_cache_misses": 0,
         })
         # spawn recipe kept for scale_out (None in attach mode: the
         # router does not own attached processes, so it cannot grow or
@@ -653,6 +656,73 @@ class Router:
     def evaluate(self, design, cases=None, deadline_s=None, timeout=None):
         return self.submit(design, cases=cases,
                            deadline_s=deadline_s).result(timeout)
+
+    def submit_grad(self, design, objective, trace=None):
+        """Forward one served grad request (docs/differentiation.md) to
+        the replica owning the design's physics family — the SAME ring
+        placement as a forward solve for that design, so the adjoint
+        program compiles next to the forward executables it shares prep
+        with.  A router-tier grad-cache hit resolves with zero forward
+        hop; a malformed objective raises ValueError synchronously,
+        mirroring ``Engine.submit_grad``."""
+        from raft_tpu.grad.response import GRAD_KNOBS, parse_objective
+
+        if not isinstance(design, dict):
+            raise ValueError("submit_grad needs a design dict (clients "
+                             "resolve path strings before routing)")
+        metric, knobs, theta = parse_objective(objective)
+        if theta is None:
+            theta = (1.0,) * len(GRAD_KNOBS)
+        t0 = time.perf_counter()
+        t_wall = time.time()
+        if trace is None:
+            trace = TraceContext.new()
+        # the canonical objective doc — identical to the engine's, so
+        # router-tier probes hit entries the replicas stored
+        canon = {"metric": metric, "knobs": sorted(knobs),
+                 "theta": [float(t) for t in theta]}
+        cached, cache_refused = None, 0
+        if self._result_cache is not None:
+            key = grad_key(design, canon, self._precision,
+                           flags=self._result_cache.flags)
+            cached, cache_refused = self._result_cache.get_grad(key)
+        with self._lock:
+            if self._stop:
+                raise RuntimeError("router is shut down")
+            self._rid += 1
+            rid = self._rid
+            self.stats["requests"] += 1
+            self.stats["grad_requests"] += 1
+            pend = _Pending(rid)
+            pend.trace_id = trace.trace_id
+            pend.grad = (metric, knobs, theta)
+            self._outstanding[rid] = pend
+            if cache_refused:
+                self.stats["cache_corrupt"] += cache_refused
+            if cached is not None:
+                self.stats["grad_cache_hits"] += 1
+                self.stats["ok"] += 1
+                self.trace_ring.record(
+                    "ingress", trace, t_wall,
+                    time.perf_counter() - t0, proc="router",
+                    status="grad_cache_hit")
+                self._resolve_locked(rid, pend, GradResult(
+                    rid=rid, status="ok", metric=metric,
+                    knobs=tuple(knobs), value=cached["value"],
+                    gradient={k: cached["gradient"][k] for k in knobs},
+                    theta=cached["theta"],
+                    latency_s=time.perf_counter() - t0,
+                    cache_hit=True, backend=cached["backend"],
+                    trace_id=trace.trace_id))
+                return pend
+            if self._result_cache is not None:
+                self.stats["grad_cache_misses"] += 1
+        self._pool.submit(self._forward_grad, rid, pend, design,
+                          objective, t0, trace, t_wall)
+        return pend
+
+    def evaluate_grad(self, design, objective, timeout=None):
+        return self.submit_grad(design, objective).result(timeout)
 
     def submit_sweep(self, designs, cases=None, chunk=None, trace=None):
         """Forward a sweep to the replica owning its design family.
@@ -1036,6 +1106,12 @@ class Router:
                     resolved += 1
                 handle._close()
                 continue
+            if getattr(pend, "grad", None) is not None:
+                if pend._set(wire.grad_result_from_doc({
+                        "rid": rid, "status": "shutdown",
+                        "error": "router stopped"})):
+                    resolved += 1
+                continue
             if pend._set(wire.result_from_doc({
                     "rid": rid, "status": "shutdown",
                     "error": "router stopped"})):
@@ -1280,6 +1356,97 @@ class Router:
             "rid": rid, "status": status,
             "trace_id": getattr(trace, "trace_id", None),
             "error": f"no replica served the request "
+                     f"(tried {len(order)}; last: {last_err})"}))
+
+    def _forward_grad(self, rid, pend, design, objective, t0,
+                      trace=None, t_wall=None):
+        """The ``_forward`` failover walk for a grad request: same ring
+        preference (``routing_key(design, None)``), same dead-replica /
+        breaker skips, same retirement-window retry — a replica
+        answering ``shutdown`` mid-drain never fails the request while
+        another replica can serve it."""
+        key = routing_key(design, None)
+        order = self._ring.preference(key)
+        last_err = None
+        attempted = breaker_skips = 0
+        if t_wall is None:
+            t_wall = time.time()
+        for replica_id in order:
+            rep = self.replicas.get(replica_id)
+            if rep is None:                # retired mid-flight
+                last_err = f"{replica_id} retired"
+                continue
+            if rep.dead():
+                with self._lock:
+                    self.stats["dead_replica_skips"] += 1
+                self._breakers.get(replica_id).record_failure(
+                    "replica process dead")
+                last_err = f"{replica_id} dead"
+                continue
+            breaker = self._breakers.get(replica_id)
+            if not breaker.allow():
+                breaker_skips += 1
+                last_err = f"{replica_id} breaker open"
+                continue
+            req = {"design": design, "objective": objective}
+            if trace is not None:
+                req["trace"] = trace.to_doc()
+            w_wall = time.time()
+            w0 = time.perf_counter()
+            try:
+                with self._lock:
+                    self.stats["grad_forwarded"] += 1
+                attempted += 1
+                doc = rep.client.grad(req)
+            except (ConnectionDropped, TransientError) as e:
+                breaker.record_failure(str(e))
+                with self._lock:
+                    self.stats["replica_retries"] += 1
+                self.trace_ring.record(
+                    "wire", trace, w_wall, time.perf_counter() - w0,
+                    proc="router", replica=replica_id,
+                    attempt=attempted, outcome="retry")
+                last_err = str(e)
+                logger.warning("grad forward rid=%d to %s failed (%s); "
+                               "retrying on next replica", rid,
+                               replica_id, e)
+                continue
+            self.trace_ring.record(
+                "wire", trace, w_wall, time.perf_counter() - w0,
+                proc="router", replica=replica_id, attempt=attempted,
+                outcome=doc.get("status"))
+            if doc.get("status") == "shutdown" and not self._stop:
+                breaker.record_failure("replica draining")
+                with self._lock:
+                    self.stats["replica_retries"] += 1
+                last_err = f"{replica_id} draining"
+                continue
+            breaker.record_success()
+            rep.served += 1
+            status = doc.get("status") or "failed"
+            with self._lock:
+                self.stats[status] = self.stats.get(status, 0) + 1
+            res = wire.grad_result_from_doc(doc, rid=rid)
+            res.replica = replica_id
+            res.latency_s = time.perf_counter() - t0
+            if res.trace_id is None and trace is not None:
+                res.trace_id = trace.trace_id
+            self._hist_latency.observe(res.latency_s)
+            self.trace_ring.record(
+                "ingress", trace, t_wall, res.latency_s, proc="router",
+                replica=replica_id, status=status)
+            return self._resolve(rid, pend, res)
+        status = ("rejected_circuit"
+                  if not attempted and breaker_skips else "failed")
+        with self._lock:
+            self.stats["failed"] += 1
+        self.trace_ring.record(
+            "ingress", trace, t_wall, time.perf_counter() - t0,
+            proc="router", status=status)
+        return self._resolve(rid, pend, wire.grad_result_from_doc({
+            "rid": rid, "status": status,
+            "trace_id": getattr(trace, "trace_id", None),
+            "error": f"no replica served the grad request "
                      f"(tried {len(order)}; last: {last_err})"}))
 
     def _forward_sweep_entry(self, rid, handle, designs, cases, chunk,

@@ -22,6 +22,12 @@
   sweep streams chunk lines with ``mode: "cached"`` — so clients never
   see where the bits came from, only that they are the exact bits a
   forwarded solve would have produced.
+* ``POST /v1/grad`` — body is a grad request document
+  (``wire.parse_grad_request``: design + objective spec,
+  docs/differentiation.md).  Always a single buffered JSON
+  ``grad_result`` document — the payload is a handful of f64 scalars,
+  so there is nothing to stream; the terminal status maps to an HTTP
+  code exactly like a ``?stream=0`` solve.
 * ``GET /healthz`` — liveness: 200 whenever the process can answer.
 * ``GET /readyz`` — readiness from ``backend.probe()`` (the cheap
   lock-free gauge): 503 while draining, stopped, or shedding
@@ -163,6 +169,8 @@ class _Handler(BaseHTTPRequestHandler):
         path, _, query = self.path.partition("?")
         if path == "/v1/sweep":
             return self._post_sweep()
+        if path == "/v1/grad":
+            return self._post_grad()
         if path == "/profilez":
             return self._post_profilez()
         if path != "/v1/solve":
@@ -243,6 +251,60 @@ class _Handler(BaseHTTPRequestHandler):
         doc = capture(log_dir=body.get("log_dir"))
         code = 200 if doc.get("armed", True) else 409
         return self._send_json(code, doc)
+
+    def _post_grad(self):
+        """``POST /v1/grad`` — evaluate one objective + exact adjoint
+        gradient (engine.submit_grad).  Buffered single JSON document:
+        the answer is a handful of f64 scalars whose json repr
+        round-trips bit-exactly, so the served bits equal the in-process
+        ``design_value_and_grad`` answer (pinned in tests/test_grad.py).
+        """
+        if self.transport.draining:
+            return self._send_json(503, {"error": "draining"})
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            if length > MAX_BODY_BYTES:
+                return self._send_json(413, {"error": "body too large"})
+            doc = json.loads(self.rfile.read(length))
+            design, objective = wire.parse_grad_request(doc)
+            if isinstance(design, str):
+                from raft_tpu.io.schema import load_design
+                design = load_design(design)
+        except wire.WireError as e:
+            return self._send_json(400, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 — bad body, keep serving
+            return self._send_json(
+                400, {"error": f"{type(e).__name__}: {e}"})
+        try:
+            handle = self.transport.backend.submit_grad(
+                design, objective, trace=wire.parse_trace(doc))
+        except RuntimeError as e:           # backend already stopped
+            return self._send_json(503, {"error": str(e)})
+        except ValueError as e:             # objective refused upstream
+            return self._send_json(400, {"error": str(e)})
+        self.transport.note_accept(handle.rid)
+        with self.transport._lock:
+            self.transport._active += 1
+        try:
+            wait = self.transport.result_wait_s
+            try:
+                res = handle.result(timeout=wait)
+                out = wire.grad_result_doc(res)
+            except TimeoutError:
+                out = {"event": "grad_result", "rid": handle.rid,
+                       "status": "failed",
+                       "error": f"transport result wait exceeded "
+                                f"{wait:.0f}s"}
+            self._send_json(wire.HTTP_STATUS.get(out["status"], 500),
+                            out)
+        except (BrokenPipeError, ConnectionResetError):
+            # client went away mid-wait; the engine still resolves the
+            # handle (terminal-status guarantee is server-side).
+            self.close_connection = True
+        finally:
+            with self.transport._idle:
+                self.transport._active -= 1
+                self.transport._idle.notify_all()
 
     def _post_sweep(self):
         """``POST /v1/sweep`` — always streamed NDJSON: an ``accepted``
@@ -520,6 +582,47 @@ class WireClient:
                         f"stream from {self.host}:{self.port} ended "
                         f"before a terminal result line")
                 return terminal
+            except (ConnectionError, http.client.HTTPException,
+                    TimeoutError, OSError) as e:
+                raise ConnectionDropped(
+                    f"{self.host}:{self.port}: "
+                    f"{type(e).__name__}: {e}") from e
+        finally:
+            conn.close()
+
+    def grad(self, doc, timeout=None):
+        """POST a grad request document to ``/v1/grad``; returns the
+        terminal ``grad_result`` document.  A 503 raises
+        ``ConnectionDropped`` — the drain gate / retirement-window rule
+        of ``solve()``: the request was refused before admission or the
+        replica resolved it with ``status="shutdown"`` while retiring,
+        and either way the evaluation is pure, so re-attempting on
+        another replica cannot double apply."""
+        body = wire.dumps(doc).encode()
+        conn = self._conn(timeout)
+        try:
+            try:
+                conn.request("POST", "/v1/grad", body=body, headers={
+                    "Content-Type": "application/json"})
+                resp = conn.getresponse()
+                raw = resp.read()
+                try:
+                    out = json.loads(raw)
+                except ValueError:
+                    out = {}
+                if resp.status == 503:
+                    raise ConnectionDropped(
+                        f"{self.host}:{self.port} is draining; grad "
+                        f"request not served "
+                        f"({out.get('error', 'unavailable')})")
+                if out.get("event") == "grad_result":
+                    return out
+                return {"event": "grad_result",
+                        "rid": out.get("rid", -1),
+                        "status": out.get("status", "failed"),
+                        "http_status": resp.status,
+                        "error": out.get("error",
+                                         f"HTTP {resp.status}")}
             except (ConnectionError, http.client.HTTPException,
                     TimeoutError, OSError) as e:
                 raise ConnectionDropped(

@@ -33,7 +33,7 @@ import json
 import numpy as np
 
 from raft_tpu.serve.buckets import BucketSpec
-from raft_tpu.serve.engine import RequestResult, SweepResult
+from raft_tpu.serve.engine import GradResult, RequestResult, SweepResult
 
 WIRE_VERSION = 1
 
@@ -320,6 +320,93 @@ def sweep_result_from_doc(doc, chunks=None, rid=None):
         mode=doc.get("mode"),
         latency_s=float(doc.get("latency_s", 0.0)),
         suspend_s=float(doc.get("suspend_s", 0.0)),
+        replica=doc.get("replica"),
+        trace_id=doc.get("trace_id"),
+    )
+
+
+# --------------------------------------------------------------- grad
+
+def parse_grad_request(doc):
+    """Validate a grad request document -> (design, objective dict).
+
+    Request (docs/differentiation.md)::
+
+        {"design": <design dict | path str>,       # required
+         "objective": {"metric": "rao_pitch_peak",  # required
+                       "knobs": ["draft", ...],     # optional subset
+                       "theta": [1.0, 1.0, 1.0, 1.0]},  # optional point
+         "trace": {...}}                            # optional
+
+    The objective spec itself is validated by
+    :func:`raft_tpu.grad.response.parse_objective`; any mismatch maps
+    to a :class:`WireError` (HTTP 400)."""
+    from raft_tpu.grad.response import parse_objective
+
+    if not isinstance(doc, dict):
+        raise WireError("grad request must be a JSON object")
+    if "design" not in doc:
+        raise WireError("grad request missing 'design'")
+    design = doc["design"]
+    if not isinstance(design, (dict, str)):
+        raise WireError("'design' must be a design dict or a path string")
+    objective = doc.get("objective")
+    try:
+        parse_objective(objective)
+    except ValueError as e:
+        raise WireError(str(e)) from None
+    return design, objective
+
+
+def grad_result_doc(res):
+    """GradResult -> terminal grad result document.  json float repr
+    round-trips f64 exactly, so the decoded value/gradient are
+    bit-identical to the engine's in-process answer (pinned in
+    tests/test_grad.py)."""
+    doc = {
+        "event": "grad_result", "rid": res.rid, "status": res.status,
+        "latency_s": round(res.latency_s, 4),
+        "cache_hit": bool(res.cache_hit),
+    }
+    if res.error:
+        doc["error"] = res.error
+    if res.backend:
+        doc["backend"] = res.backend
+    if res.replica is not None:
+        doc["replica"] = res.replica
+    if getattr(res, "trace_id", None):
+        doc["trace_id"] = res.trace_id
+    if res.metric:
+        doc["metric"] = res.metric
+    if res.theta is not None:
+        doc["theta"] = [float(t) for t in res.theta]
+    if res.status == "ok":
+        doc["value"] = float(res.value)
+        doc["knobs"] = list(res.knobs or ())
+        doc["gradient"] = {k: float(v)
+                           for k, v in (res.gradient or {}).items()}
+    return doc
+
+
+def grad_result_from_doc(doc, rid=None):
+    """Terminal grad result document -> GradResult (exact f64 bits)."""
+    gradient = doc.get("gradient")
+    if gradient is not None:
+        gradient = {str(k): float(v) for k, v in gradient.items()}
+    knobs = doc.get("knobs")
+    return GradResult(
+        rid=doc["rid"] if rid is None else rid,
+        status=doc["status"],
+        metric=doc.get("metric"),
+        knobs=tuple(knobs) if knobs is not None else None,
+        value=(float(doc["value"]) if "value" in doc else None),
+        gradient=gradient,
+        theta=([float(t) for t in doc["theta"]]
+               if doc.get("theta") is not None else None),
+        error=doc.get("error"),
+        latency_s=float(doc.get("latency_s", 0.0)),
+        cache_hit=bool(doc.get("cache_hit", False)),
+        backend=doc.get("backend"),
         replica=doc.get("replica"),
         trace_id=doc.get("trace_id"),
     )

@@ -244,6 +244,22 @@ def test_serve_obs_section_pinned_in_compact_schema():
         assert key in bench._COMPACT_KEYS, key
 
 
+def test_grad_section_pinned_in_compact_schema():
+    """The adjoint-gradient bench section (ISSUE 19) stays wired: both
+    entry points exist and the headline keys — adjoint-vs-FD relative
+    error (full section and smoke), the warm adjoint wall next to the
+    2-evals-per-knob FD wall, and the reported (not asserted) speedup
+    ratio — ride the compact driver line."""
+    assert callable(bench.bench_gradients)
+    assert callable(bench.bench_grad_smoke)
+    for key in ("grad_metrics", "grad_fd_rel_err",
+                "grad_adjoint_rel_err", "grad_adjoint_ms",
+                "grad_fd_ms", "grad_adjoint_speedup",
+                "smoke_grad_rel_err", "smoke_grad_adjoint_ms",
+                "smoke_grad_axes", "grad_error", "grad_smoke_error"):
+        assert key in bench._COMPACT_KEYS, key
+
+
 def test_analysis_section_pinned_in_compact_schema():
     """The static-analysis gate (docs/analysis.md) stays wired: the
     entry point exists and the rule/finding counts ride the compact

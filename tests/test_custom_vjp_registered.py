@@ -1,0 +1,19 @@
+"""Shim over the ``custom-vjp-registered`` framework rule.
+
+Every module that registers a ``jax.custom_vjp`` must be covered by a
+``test_*grad*`` / ``test_*adjoint*`` test importing it — a custom VJP
+replaces autodiff with hand-written math, so the only guard against a
+rotten adjoint is a parity test (tests/test_grad.py pins every axis
+against finite differences).  The rule lives in
+``raft_tpu/analysis/rules/legacy.py`` with the other registration
+lints; exceptions go in
+``raft_tpu/analysis/allowlists/custom-vjp-registered.txt`` with a
+reason.  See docs/analysis.md and docs/differentiation.md.
+"""
+
+from raft_tpu.analysis import analyze, rule_by_name
+
+
+def test_every_custom_vjp_has_a_registered_parity_test():
+    report = analyze(rules=[rule_by_name("custom-vjp-registered")])
+    assert report.ok, "\n".join(str(f) for f in report.findings)

@@ -1,0 +1,381 @@
+"""Differentiable solve stack (raft_tpu/grad): the adjoint contracts.
+
+Four acceptance criteria from the grad subsystem
+(docs/differentiation.md):
+
+ - **parity**: ``jax.grad`` of an RAO scalar w.r.t. the design knobs
+   matches finite differences on every axis at 5e-3 relative (the
+   draft axis sits exactly on a ``max()`` kink at theta=1, so its
+   check uses a one-sided second-order forward stencil);
+ - **forward bit-identity**: attaching the IFT ``custom_vjp`` rules
+   changes NO forward bit — the implicit twin's value equals the plain
+   traced twin's;
+ - **quarantine mirror**: a lane whose forward solve quarantined
+   (``SolveReport.nonfinite``) returns *flagged zeros* as its adjoint
+   (raft_tpu/health.py ``quarantine_cotangents``), never NaN;
+ - **serving**: ``Engine.submit_grad`` / ``POST /v1/grad`` answers are
+   bit-identical to the in-process ``design_value_and_grad``, repeats
+   hit the exact-answer grad cache deterministically, and a fresh
+   process reuses the warmed adjoint executable from the persistent
+   compilation cache (no recompile).
+
+The ``RAFT_TPU_GRAD_ADJOINT_ITERS`` / ``RAFT_TPU_GRAD_PROGRAMS`` env
+switches are pinned here for the flag-hygiene lint.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.designs import demo_semi
+from raft_tpu.geometry import HydroNodes
+from raft_tpu.grad.fixed_point import (
+    ADJOINT_ITERS_ENV,
+    adjoint_iters,
+    grad_axis,
+    implicit_solve_dynamics,
+)
+from raft_tpu.grad.response import (
+    GRAD_KNOBS,
+    build_value_and_grad,
+    parse_objective,
+)
+from raft_tpu.health import quarantine_cotangents
+from raft_tpu.parametric import PARAM_NAMES, build_design_response
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+METRIC = "rao_pitch_peak"
+FD_EPS = 1e-4
+REL_TOL = 5e-3
+
+
+@pytest.fixture(scope="module")
+def adjoint_case():
+    """One compiled reverse-mode program (design, metric) shared by the
+    module: theta -> (value, grad[4]) plus a cheap warm value probe for
+    the finite-difference stencils."""
+    design = demo_semi(n_cases=2)
+    fn, theta0 = build_value_and_grad(design, METRIC)
+    cpu = jax.devices("cpu")[0]
+    value, g = fn(jax.device_put(theta0, cpu))
+
+    def value_at(theta):
+        v, _ = fn(jax.device_put(jnp.asarray(theta, jnp.float64), cpu))
+        return float(v)
+
+    return {"design": design, "fn": fn, "value": float(value),
+            "grad": np.asarray(g), "value_at": value_at}
+
+
+def _central_fd(value_at, axis, eps=FD_EPS):
+    tp = np.ones(len(PARAM_NAMES))
+    tm = np.ones(len(PARAM_NAMES))
+    tp[axis] += eps
+    tm[axis] -= eps
+    return (value_at(tp) - value_at(tm)) / (2.0 * eps)
+
+
+def _forward_fd(value_at, f0, axis, eps=FD_EPS):
+    """One-sided second-order forward stencil
+    ``(-3 f0 + 4 f(t+e) - f(t+2e)) / (2e)`` for axes where theta=1 sits
+    on a kink (one-sided perturbations stay on one branch)."""
+    t1 = np.ones(len(PARAM_NAMES))
+    t2 = np.ones(len(PARAM_NAMES))
+    t1[axis] += eps
+    t2[axis] += 2.0 * eps
+    return (-3.0 * f0 + 4.0 * value_at(t1) - value_at(t2)) / (2.0 * eps)
+
+
+# ---------------------------------------------------------------- parity
+#
+# Everything touching the module-scope adjoint_case fixture traces and
+# compiles the full design->response pipeline (minutes of host work) —
+# slow-marked like the other compile-heavy parity tests; the fast lane
+# still FD-checks the IFT rule itself (the quarantine integration test
+# below and bench --smoke's grad_smoke).
+
+@pytest.mark.slow
+@pytest.mark.parametrize("knob", ["ballast", "col_diam"])
+def test_grad_adjoint_matches_central_fd(adjoint_case, knob):
+    axis = PARAM_NAMES.index(knob)
+    fd = _central_fd(adjoint_case["value_at"], axis)
+    ad = float(adjoint_case["grad"][axis])
+    assert abs(ad - fd) <= REL_TOL * max(abs(fd), 1e-12), \
+        (knob, ad, fd)
+
+
+@pytest.mark.slow
+def test_grad_adjoint_matches_forward_fd_draft(adjoint_case):
+    """The draft axis has a genuine kink exactly at theta_draft = 1 (a
+    ``max()`` branch switch), so central differencing straddles two
+    branches; the one-sided stencil and the adjoint both see the
+    right-hand branch."""
+    axis = PARAM_NAMES.index("draft")
+    fd = _forward_fd(adjoint_case["value_at"], adjoint_case["value"],
+                     axis)
+    ad = float(adjoint_case["grad"][axis])
+    assert abs(ad - fd) <= REL_TOL * max(abs(fd), 1e-12), (ad, fd)
+
+
+@pytest.mark.slow
+def test_grad_forward_value_bit_identical_to_plain_twin(adjoint_case):
+    """The IFT rules' primals ARE the legacy solves: the implicit
+    twin's forward value must equal the plain traced twin's to the
+    bit."""
+    f, theta0 = build_design_response(adjoint_case["design"],
+                                      metrics=(METRIC,))
+    plain = float(jax.jit(lambda t: f(t)[METRIC])(
+        jax.device_put(theta0, jax.devices("cpu")[0])))
+    assert plain == adjoint_case["value"]
+
+
+# ------------------------------------------------------ objective surface
+
+def test_grad_objective_spec_validation():
+    metric, knobs, theta = parse_objective({"metric": METRIC})
+    assert metric == METRIC
+    assert knobs == tuple(GRAD_KNOBS)
+    assert theta is None
+    m2, k2, t2 = parse_objective(
+        {"metric": METRIC, "knobs": ["draft"],
+         "theta": [1.0, 1.0, 1.0, 1.0]})
+    assert (m2, k2, t2) == (METRIC, ("draft",), (1.0, 1.0, 1.0, 1.0))
+    for bad in ("not-a-dict",
+                {"metric": "no_such_metric"},
+                {"metric": METRIC, "knobs": []},
+                {"metric": METRIC, "knobs": ["no_such_knob"]},
+                {"metric": METRIC, "theta": [1.0]}):
+        with pytest.raises(ValueError):
+            parse_objective(bad)
+
+
+def test_grad_axis_tracks_adjoint_iters_env(monkeypatch):
+    monkeypatch.delenv(ADJOINT_ITERS_ENV, raising=False)
+    assert adjoint_iters() == 200
+    assert grad_axis() == "ift1;adjoint_iters=200"
+    monkeypatch.setenv("RAFT_TPU_GRAD_ADJOINT_ITERS", "50")
+    assert adjoint_iters() == 50
+    assert grad_axis() == "ift1;adjoint_iters=50"
+
+
+# ------------------------------------------------------- quarantine mirror
+
+def test_quarantine_cotangents_adjoint_flags_zeros():
+    """Unit contract: the quarantined lane's cotangents become exactly
+    0.0 (flagged zeros, not NaN, not tiny); healthy lanes pass through
+    bit-identically."""
+    cts = (jnp.linspace(-2.0, 3.0, 12).reshape(6, 2),
+           jnp.full((6, 2), 7.5))
+    qr, qi = quarantine_cotangents(cts, jnp.asarray(True))
+    assert np.all(np.asarray(qr) == 0.0)
+    assert np.all(np.asarray(qi) == 0.0)
+    pr, pi = quarantine_cotangents(cts, jnp.asarray(False))
+    assert np.array_equal(np.asarray(pr), np.asarray(cts[0]))
+    assert np.array_equal(np.asarray(pi), np.asarray(cts[1]))
+    # per-lane flag zeroes only its own lane
+    flags = jnp.asarray([False, True])
+    zr, _ = quarantine_cotangents(cts, flags[None, :])
+    zr = np.asarray(zr)
+    assert np.array_equal(zr[:, 0], np.asarray(cts[0])[:, 0])
+    assert np.all(zr[:, 1] == 0.0)
+
+
+def _tiny_dynamics_operands(poison=False):
+    """Minimal drag-free implicit_solve_dynamics operand set (pattern of
+    tests/test_kernels.py): drag-free means the fixed point converges in
+    one application, keeping the test compile tiny."""
+    N, nw = 2, 6
+    w = np.arange(1, nw + 1) * 0.25
+    z1 = np.zeros(N)
+    o1 = np.ones(N)
+    eye3 = np.broadcast_to(np.eye(3), (N, 3, 3)).copy()
+    nodes = HydroNodes(
+        r=np.zeros((N, 3)), q=np.tile([0.0, 0.0, 1.0], (N, 1)),
+        qMat=eye3, p1Mat=eye3, p2Mat=eye3, v_side=o1, v_end=z1,
+        a_end=z1, a_q=o1, a_p1=o1, a_p2=o1, a_end_abs=z1,
+        Ca_p1=o1, Ca_p2=o1, Ca_End=z1,
+        Cd_q=z1, Cd_p1=z1, Cd_p2=z1, Cd_End=z1,
+        submerged=o1.astype(bool), strip_mask=o1.astype(bool))
+    nodes = type(nodes)(**{
+        f: jnp.asarray(getattr(nodes, f))
+        for f in nodes.__dataclass_fields__})
+    u = jnp.zeros((N, 3, nw), jnp.complex128)
+    M = jnp.broadcast_to(jnp.eye(6), (nw, 6, 6))
+    B = jnp.zeros((nw, 6, 6))
+    # stiffness safely above the band's max omega^2 (=2.25): an exact
+    # C - w^2 M = 0 resonance with B = 0 is a singular solve and would
+    # quarantine the healthy twin too
+    C = jnp.diag(jnp.asarray([3.0, 4.0, 5.0, 6.0, 7.0, 8.0]))
+    F_r = jnp.ones((nw, 6))
+    if poison:
+        F_r = F_r.at[0, 0].set(jnp.nan)
+    F_i = jnp.zeros((nw, 6))
+    return nodes, u, w, M, B, C, F_r, F_i
+
+
+def test_adjoint_of_quarantined_solve_is_flagged_zeros():
+    """End-to-end mirror of the forward freeze: poison the forcing so
+    the solve quarantines (``report.nonfinite`` raised), then take
+    ``jax.grad`` through the implicit rule — the adjoint must be
+    exactly zero (the flag is the signal), never NaN.  The healthy
+    twin's gradient flows nonzero-finite through the same rule."""
+    nodes, u, w, M, B, C, F_r, F_i = _tiny_dynamics_operands(poison=True)
+
+    def loss(fr):
+        xr, xi, report = implicit_solve_dynamics(
+            nodes, u, w, 0.25, 1025.0, M, B, C, fr, F_i,
+            XiStart=0.1, nIter=15)
+        return jnp.sum(xr) + jnp.sum(xi), report
+
+    (val, report), g = jax.value_and_grad(loss, has_aux=True)(F_r)
+    assert bool(np.any(np.asarray(report.nonfinite)))
+    assert np.all(np.asarray(g) == 0.0)
+
+    _, _, _, _, _, _, F_ok, _ = _tiny_dynamics_operands(poison=False)
+    (val2, report2), g2 = jax.value_and_grad(loss, has_aux=True)(F_ok)
+    assert not bool(np.any(np.asarray(report2.nonfinite)))
+    g2 = np.asarray(g2)
+    assert np.isfinite(g2).all()
+    assert np.any(g2 != 0.0)
+
+
+# ----------------------------------------------------------------- serving
+
+@pytest.mark.slow
+def test_served_grad_bit_identical_and_cached(adjoint_case, tmp_path):
+    """Engine.submit_grad == the in-process adjoint to the bit; an
+    identical repeat hits the exact-answer grad cache deterministically;
+    and POST /v1/grad carries the same bits over the wire (json f64 repr
+    round-trips exactly).  A malformed objective maps to a 400."""
+    from raft_tpu.serve import Engine, EngineConfig, WireClient, \
+        serve_http
+
+    design = adjoint_case["design"]
+    knobs = ["draft", "col_diam", "ballast"]
+    obj = {"metric": METRIC, "knobs": knobs}
+    eng = Engine(EngineConfig(precision="float64", window_ms=20.0,
+                              cache_dir=str(tmp_path)))
+    try:
+        res = eng.evaluate_grad(design, obj, timeout=600)
+        assert res.status == "ok", res.error
+        assert res.cache_hit is False
+        assert res.value == adjoint_case["value"]
+        for i, p in enumerate(PARAM_NAMES):
+            if p in knobs:
+                assert res.gradient[p] == float(adjoint_case["grad"][i])
+
+        # deterministic exact-answer cache hit on the identical repeat
+        res2 = eng.evaluate_grad(design, obj, timeout=600)
+        assert res2.status == "ok" and res2.cache_hit is True
+        assert res2.value == res.value
+        assert res2.gradient == res.gradient
+
+        snap = eng.snapshot()
+        assert snap["grad_requests"] == 2
+        assert snap["grad_cache_hits"] == 1
+        assert snap["grad_program_compiles"] == 1
+
+        # the wire answer is the same bits (served from the grad cache)
+        transport = serve_http(eng)
+        try:
+            client = WireClient("127.0.0.1", transport.port)
+            doc = client.grad({"design": design, "objective": obj})
+            assert doc["status"] == "ok"
+            assert doc["value"] == res.value
+            assert doc["gradient"] == res.gradient
+            assert doc["metric"] == METRIC
+            bad = client.grad({"design": design,
+                               "objective": {"metric": "no_such"}})
+            assert bad["status"] == "failed"
+            assert bad["http_status"] == 400
+        finally:
+            transport.close()
+    finally:
+        eng.shutdown()
+
+
+def test_grad_program_memo_cap_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_GRAD_PROGRAMS", "3")
+    from raft_tpu.serve import Engine, EngineConfig
+
+    eng = Engine(EngineConfig(precision="float64",
+                              cache_dir=str(tmp_path)))
+    try:
+        assert eng._grad_programs_cap == 3
+    finally:
+        eng.shutdown()
+
+
+# Runs in a fresh interpreter: phase "cold" compiles the adjoint program
+# and seeds the persistent compilation cache; phase "warm" must fetch
+# the warmed executable from disk (persistent_cache_hits > 0) and
+# reproduce the cold process's bits exactly.
+_RUNNER = """
+import sys, os, json
+sys.path.insert(0, __REPO_ROOT__)
+import jax
+jax.config.update("jax_platforms", "cpu")   # the axon plugin ignores env
+import raft_tpu  # wires the persistent compilation cache to the env dir
+from raft_tpu.designs import demo_semi
+from raft_tpu.serve import Engine, EngineConfig
+from raft_tpu.serve.cache import compile_counters
+
+design = demo_semi(n_cases=2)
+obj = {"metric": "rao_pitch_peak",
+       "knobs": ["draft", "col_diam", "ballast"]}
+# the exact-answer cache is disabled so the warm phase really executes
+# the adjoint program instead of replaying the cold phase's answer
+eng = Engine(EngineConfig(precision="float64",
+                          cache_dir=os.environ["RAFT_TPU_CACHE_DIR"],
+                          use_result_cache=False))
+res = eng.evaluate_grad(design, obj, timeout=600)
+assert res.status == "ok", res.error
+snap = compile_counters()
+eng.shutdown()
+print("RESULT " + json.dumps({
+    "value": res.value,
+    "gradient": res.gradient,
+    "persistent_cache_hits": snap["persistent_cache_hits"],
+}))
+"""
+
+
+def _run_grad_phase(tmp_path, phase):
+    script = os.path.join(str(tmp_path), "grad_phase.py")
+    with open(script, "w") as fh:
+        fh.write(_RUNNER.replace("__REPO_ROOT__", repr(ROOT)))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("XLA_FLAGS", None)          # 1 host device: fastest
+    env.pop("JAX_COMPILATION_CACHE_DIR", None)
+    env["RAFT_TPU_CACHE_DIR"] = os.path.join(str(tmp_path), "cache")
+    proc = subprocess.run(
+        [sys.executable, script, phase],
+        capture_output=True, text=True, timeout=560, env=env,
+        cwd=str(tmp_path),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = [ln for ln in proc.stdout.splitlines()
+            if ln.startswith("RESULT ")][-1]
+    return json.loads(line[len("RESULT "):])
+
+
+@pytest.mark.slow
+def test_grad_warm_restart_reuses_adjoint_executable(tmp_path):
+    """A fresh process pointed at the warmed cache dir serves its first
+    grad request from the persistent compilation cache (the adjoint
+    executable is fleet-warmable exactly like a forward bucket), and
+    the answer is bit-identical across processes."""
+    cold = _run_grad_phase(tmp_path, "cold")
+    warm = _run_grad_phase(tmp_path, "warm")
+    assert warm["persistent_cache_hits"] > 0
+    assert warm["value"] == cold["value"]
+    assert warm["gradient"] == cold["gradient"]
