@@ -123,6 +123,12 @@ _COMPACT_KEYS = (
     "smoke_cache_ratio", "smoke_cache_bits",
     "smoke_cache_router_hit_ms",
     "smoke_load_goodput", "smoke_load_bits",
+    "serve_multihost_handshake_refusals",
+    "serve_multihost_preload_wall_s", "serve_multihost_preload_entries",
+    "serve_multihost_first100_hit_delta",
+    "serve_multihost_partition_goodput", "serve_multihost_lost",
+    "serve_multihost_bits",
+    "multihost_smoke_goodput", "multihost_smoke_bits",
     "sweep_cold_start_s", "sweep_warm_start_s", "sweep_warm_vs_cold",
     "sweep_prep_wall_s", "sweep_prep_solo_wall_s", "sweep_prep_batched",
     "sweep_prep_speedup", "sweep_prep_bits_identical",
@@ -140,6 +146,7 @@ _COMPACT_KEYS = (
     "sweep_waterfall_error",
     "perf_docs_error", "sweep_scaling_error", "sweep1024_error",
     "sweep4096_error", "serve_multichip_error", "multichip_smoke_error",
+    "serve_multihost_error", "multihost_smoke_error",
     "prep_error", "prep_smoke_error",
     "analysis_rules", "analysis_findings", "analysis_allowlisted",
     "analysis_error",
@@ -421,6 +428,7 @@ def main(argv=None):
                     ("chaos_smoke", bench_chaos_smoke),
                     ("grad_smoke", bench_grad_smoke),
                     ("prep_smoke", bench_batched_prep_smoke),
+                    ("multihost_smoke", bench_multihost_smoke),
                     ("multichip_smoke", bench_multichip_smoke),
                     ("analysis", bench_analysis),
                     ("kernel", lambda: bench_kernels(
@@ -485,6 +493,7 @@ def main(argv=None):
             ("serve_cache", bench_serve_cache, 3.0),
             ("serve_obs", bench_serve_obs_overhead, 2.0),
             ("serve_multichip", bench_serve_multichip, 0.5),
+            ("serve_multihost", bench_serve_multihost, 6.0),
             ("kernel", bench_kernels, 0.5),
             ("sweep_warm", bench_sweep_warm, 4.0),
             ("prep", bench_batched_prep, 3.0),
@@ -1673,6 +1682,228 @@ def bench_serve_load():
                                 if d["action"] == "heal"),
         "serve_load_decisions": decisions,
         "serve_load_s": round(time.perf_counter() - t0, 3),
+    }
+
+
+# --------------------------------------------- multi-host attach fleet
+
+def _replica_statz(rep):
+    """Scrape a subprocess replica's /statz over the wire."""
+    from raft_tpu.serve import WireClient
+
+    code, doc = WireClient("127.0.0.1", rep.port).get("/statz",
+                                                      timeout=10.0)
+    assert code == 200, code
+    return doc
+
+
+def _spawn_hosts(dir_a, dir_b):
+    """Two subprocess replicas with DISJOINT cache dirs — two 'hosts'
+    sharing nothing but the wire — spawned in parallel."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    from raft_tpu.serve.router import spawn_replica
+
+    with ThreadPoolExecutor(max_workers=2) as ex:
+        fut_a = ex.submit(spawn_replica, "hostA", cache_dir=dir_a,
+                          precision="float64", window_ms=10.0)
+        fut_b = ex.submit(spawn_replica, "hostB", cache_dir=dir_b,
+                          precision="float64", window_ms=10.0)
+        return fut_a.result(), fut_b.result()
+
+
+def _seed_router_popularity(router, rep_a, pool):
+    """Warm the pool on host A, wait for its stores to land, then
+    repeat the pool THROUGH the router: the repeats are router-tier
+    cache hits, which is what fills the popularity ledger the
+    shared-nothing warm transfer ships from."""
+    for h in [router.submit(b) for b in pool]:
+        r = h.result(timeout=560)
+        assert r.status == "ok", r.error
+    deadline = time.monotonic() + 60
+    while _replica_statz(rep_a)["result_cache_stores"] < len(pool):
+        assert time.monotonic() < deadline, "stores never landed"
+        time.sleep(0.1)
+    for b in pool:
+        r = router.evaluate(b, timeout=560)
+        assert r.status == "ok", r.error
+        assert r.replica is None          # router-tier hit
+
+
+def _refused_then_attach(router, port):
+    """One handshake_skew refusal, then the clean attach, timed.
+    Returns (refusals, preload_wall_s, entries_sent)."""
+    from raft_tpu.serve.router import HandshakeRefused
+
+    old_chaos = os.environ.get("RAFT_TPU_CHAOS")
+    os.environ["RAFT_TPU_CHAOS"] = "handshake_skew*1:5"
+    try:
+        try:
+            router.attach_remote("127.0.0.1", port)
+            raise AssertionError("skewed peer was not refused")
+        except HandshakeRefused:
+            pass
+    finally:
+        if old_chaos is None:
+            os.environ.pop("RAFT_TPU_CHAOS", None)
+        else:
+            os.environ["RAFT_TPU_CHAOS"] = old_chaos
+    refusals = router.stats["handshake_refusals"]
+    assert refusals >= 1, router.stats
+    t_pre = time.perf_counter()
+    router.attach_remote("127.0.0.1", port)
+    preload_wall = time.perf_counter() - t_pre
+    sent = router.stats["wire_preload_entries_sent"]
+    assert sent >= 1, router.stats
+    return refusals, preload_wall, sent
+
+
+def bench_serve_multihost(first_n=100):
+    """Partition-tolerant multi-host fleet (docs/robustness.md): two
+    subprocess 'hosts' with disjoint cache dirs joined via
+    ``Router.attach_remote``.  Records the handshake-refusal count (a
+    flag-skewed peer is refused before anything ships), the
+    shared-nothing warm-transfer wall + entry count over
+    ``POST /v1/cache/preload``, the first-100-request hit-rate delta
+    between the shared-dir handoff equivalent (host A shares the
+    router's dir, so it sees every store) and the wire-preloaded
+    remote (host B got only the shipped top-K), and the partition SLO:
+    a loadgen phase with ``net_partition`` injected mid-run on host
+    B's port and healed before the end must keep goodput >= 0.8, lose
+    nothing, and answer canaries bit-identically through failover and
+    heal."""
+    import tempfile
+
+    from raft_tpu.designs import deep_spar
+    from raft_tpu.loadgen import LoadgenConfig, run_phase, warm_pool
+    from raft_tpu.serve import Router, WireClient
+
+    t0 = time.perf_counter()
+    design = deep_spar(n_cases=2, nw_settings=(0.05, 0.5))
+    with tempfile.TemporaryDirectory() as dir_a, \
+            tempfile.TemporaryDirectory() as dir_b:
+        rep_a, rep_b = _spawn_hosts(dir_a, dir_b)
+        router = Router(endpoints=[("127.0.0.1", rep_a.port)],
+                        cache_dir=dir_a, precision="float64")
+        try:
+            cfg = LoadgenConfig(rate_hz=3.0, duration_s=6.0, seed=13,
+                                sweep_n=2, p_sweep=0.1, p_tight=0.0,
+                                canary_every=2, distinct=4)
+            pool = warm_pool(cfg, design)
+            _seed_router_popularity(router, rep_a, pool)
+            refusals, preload_wall, sent = _refused_then_attach(
+                router, rep_b.port)
+            snap_b = _replica_statz(rep_b)
+            assert snap_b["wire_preload_loaded"] >= 1, snap_b
+            assert snap_b["wire_preload_refused"] == 0, snap_b
+
+            # first-N hit rate, same request stream to both hosts:
+            # shared-dir handoff equivalent (A) vs wire preload (B)
+            stream = [pool[i % len(pool)] for i in range(first_n)]
+            rates = {}
+            for label, rep in (("shared", rep_a), ("wire", rep_b)):
+                before = _replica_statz(rep)
+                client = WireClient("127.0.0.1", rep.port)
+                for body in stream:
+                    doc = client.solve({"design": body, "cases": None,
+                                        "xi": True})
+                    assert doc["status"] == "ok", doc.get("error")
+                after = _replica_statz(rep)
+                hits = (after["result_cache_hits"]
+                        - before["result_cache_hits"])
+                rates[label] = hits / float(len(stream))
+            hit_delta = rates["shared"] - rates["wire"]
+
+            # partition SLO — router cache detached so every request
+            # actually crosses the wire (the failover, not the cache,
+            # is the figure); partition at 0.3, healed at 0.7
+            saved, router._result_cache = router._result_cache, None
+            try:
+                phase = run_phase(
+                    router, cfg, design, name="partition",
+                    chaos=(f"net_partition@{rep_b.port}:7", 0.3, 0.7))
+            finally:
+                router._result_cache = saved
+        finally:
+            router.shutdown(wait=False)
+            for rep in (rep_a, rep_b):
+                if rep.proc is not None:
+                    rep.proc.kill()
+                    rep.proc.wait(10)
+    assert phase["lost"] == 0, phase
+    assert phase["goodput"] >= 0.8, phase
+    assert phase["bits_identical"] is True, phase
+    return {
+        "serve_multihost_handshake_refusals": refusals,
+        "serve_multihost_preload_wall_s": round(preload_wall, 3),
+        "serve_multihost_preload_entries": sent,
+        "serve_multihost_first100_shared_rate": round(
+            rates["shared"], 3),
+        "serve_multihost_first100_wire_rate": round(rates["wire"], 3),
+        "serve_multihost_first100_hit_delta": round(hit_delta, 3),
+        "serve_multihost_partition_goodput": phase["goodput"],
+        "serve_multihost_lost": phase["lost"],
+        "serve_multihost_bits": "identical",
+        "serve_multihost_s": round(time.perf_counter() - t0, 3),
+    }
+
+
+def bench_multihost_smoke():
+    """Tier-1-safe multi-host smoke: the smallest end-to-end proof of
+    the attach fleet — a skewed peer refused, a clean attach shipping
+    the warm cache over the wire, then a short loadgen phase with
+    ``net_partition`` injected on host B mid-run and healed before the
+    end.  Goodput holds >= 0.8 through the gray failure, nothing is
+    lost, and the canary answers stay bit-identical across failover
+    and heal."""
+    import tempfile
+
+    from raft_tpu.designs import deep_spar
+    from raft_tpu.loadgen import LoadgenConfig, run_phase, warm_pool
+    from raft_tpu.serve import Router
+
+    t0 = time.perf_counter()
+    design = deep_spar(n_cases=2, nw_settings=(0.05, 0.5))
+    with tempfile.TemporaryDirectory() as dir_a, \
+            tempfile.TemporaryDirectory() as dir_b:
+        rep_a, rep_b = _spawn_hosts(dir_a, dir_b)
+        router = Router(endpoints=[("127.0.0.1", rep_a.port)],
+                        cache_dir=dir_a, precision="float64")
+        try:
+            # distinct=1 keeps the warm pool at 3 bodies (1 + 2*distinct
+            # cold preps) — the smoke proves the attach/partition path,
+            # not the working-set envelope (the full section's figure)
+            cfg = LoadgenConfig(rate_hz=3.0, duration_s=3.0, seed=5,
+                                sweep_n=2, p_sweep=0.2, p_tight=0.0,
+                                canary_every=2, distinct=1)
+            pool = warm_pool(cfg, design)
+            _seed_router_popularity(router, rep_a, pool)
+            refusals, _wall, sent = _refused_then_attach(
+                router, rep_b.port)
+            assert _replica_statz(rep_b)["wire_preload_loaded"] >= 1
+            saved, router._result_cache = router._result_cache, None
+            try:
+                phase = run_phase(
+                    router, cfg, design, name="multihost_smoke",
+                    chaos=(f"net_partition@{rep_b.port}:7", 0.3, 0.7))
+            finally:
+                router._result_cache = saved
+        finally:
+            router.shutdown(wait=False)
+            for rep in (rep_a, rep_b):
+                if rep.proc is not None:
+                    rep.proc.kill()
+                    rep.proc.wait(10)
+    assert phase["lost"] == 0, phase
+    assert phase["goodput"] >= 0.8, phase
+    assert phase["bits_identical"] is True, phase
+    return {
+        "multihost_smoke_refusals": refusals,
+        "multihost_smoke_preload_entries": sent,
+        "multihost_smoke_goodput": phase["goodput"],
+        "multihost_smoke_lost": phase["lost"],
+        "multihost_smoke_bits": "identical",
+        "multihost_smoke_s": round(time.perf_counter() - t0, 3),
     }
 
 

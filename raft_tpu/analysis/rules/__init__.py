@@ -11,6 +11,7 @@ from raft_tpu.analysis.rules.locks import LockDiscipline
 from raft_tpu.analysis.rules.flags import FlagHygiene
 from raft_tpu.analysis.rules.metrics import MetricsHygiene
 from raft_tpu.analysis.rules.hygiene import AllowlistHygiene
+from raft_tpu.analysis.rules.net import SocketTimeoutDiscipline
 from raft_tpu.analysis.rules.legacy import (
     BareExcept, FixedPorts, PallasParityRegistered,
     BatchedPrepRegistered, ChaosRegistered, CustomVjpRegistered)
@@ -26,6 +27,7 @@ ALL_RULES = [
     BatchedPrepRegistered(),
     ChaosRegistered(),
     CustomVjpRegistered(),
+    SocketTimeoutDiscipline(),
     AllowlistHygiene(),
 ]
 

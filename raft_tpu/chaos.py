@@ -83,6 +83,27 @@ Fault classes and their hook points:
                     that no longer exist on disk (evicted / bogus keys)
                     — the replica's preload must count them as plain
                     misses and keep going
+``net_partition``   the router's wire client drops /v1/* POST traffic
+                    (serve/transport.py, ``WireClient``) while GET
+                    probes (/healthz, /statz, /versionz) still answer —
+                    the gray failure a partitioned host produces.  The
+                    rid slot targets a replica PORT
+                    (``net_partition@PORT``); without ``@`` every
+                    endpoint is partitioned.  Forwards surface
+                    ConnectionDropped and the router must fail over to
+                    surviving replicas, bit-identically
+``wire_corrupt``    a decoded response payload (serve/transport.py,
+                    ``WireClient``) has one value flipped in flight
+                    before checksum verification — the embedded payload
+                    checksum (serve/wire.py) must refuse it as
+                    ConnectionDropped so the router retries; corrupt Xi
+                    bits are never decoded into a result.  ``@PORT``
+                    targets one endpoint
+``handshake_skew``  the /versionz flag surface a peer reports during
+                    ``Router.attach_remote`` (serve/router.py) is
+                    mutated to a bogus code_version — the handshake must
+                    REFUSE the peer with a logged reason and never add
+                    it to the ring
 ==================  ======================================================
 
 Per-rid targeting caveat: the engine deduplicates prep per design key,
@@ -111,7 +132,8 @@ CHAOS_ENV = "RAFT_TPU_CHAOS"
 FAULTS = ("prep_raise", "prep_slow", "nan_lane", "dispatch_stall",
           "backend_error", "corrupt_cache", "conn_drop", "replica_kill",
           "replica_slow", "corrupt_result_cache", "dup_inflight",
-          "corrupt_manifest", "stale_handoff")
+          "corrupt_manifest", "stale_handoff", "net_partition",
+          "wire_corrupt", "handshake_skew")
 
 _DEFAULT_VALUES = {"prep_slow": 1.0, "dispatch_stall": 5.0,
                    "replica_slow": 0.5, "dup_inflight": 0.25,

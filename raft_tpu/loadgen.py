@@ -223,6 +223,10 @@ def run_phase(backend, config, design, name="load", chaos=None,
     ``chaos``: optional ``(spec_text, at_frac)`` — arm RAFT_TPU_CHAOS
     with ``spec_text`` at ``at_frac`` of the phase duration so the
     fault fires mid-run, restoring the previous env value afterwards.
+    A 3-tuple ``(spec_text, at_frac, heal_frac)`` additionally HEALS
+    the fault at ``heal_frac`` of the duration (restores the previous
+    env mid-run), so one phase spans inject + heal — e.g. a network
+    partition that opens and closes while traffic flows.
     Returns the phase report dict (see module docstring)."""
     arrivals = poisson_arrivals(config.rate_hz, config.duration_s,
                                 config.seed)
@@ -238,16 +242,36 @@ def run_phase(backend, config, design, name="load", chaos=None,
     chaos_prev = os.environ.get("RAFT_TPU_CHAOS")
     chaos_fires = None
 
+    heal_timer = None
+    healed = {}          # snapshot of fires taken at heal time
+
     def _arm_chaos(spec):
         os.environ["RAFT_TPU_CHAOS"] = spec
         logger.warning("loadgen %s: chaos armed mid-run: %s", name, spec)
 
+    def _heal_chaos():
+        from raft_tpu.chaos import get_injector
+
+        inj = get_injector()
+        if inj is not None:
+            healed["fires"] = inj.snapshot()
+        if chaos_prev is None:
+            os.environ.pop("RAFT_TPU_CHAOS", None)
+        else:
+            os.environ["RAFT_TPU_CHAOS"] = chaos_prev
+        logger.warning("loadgen %s: chaos healed mid-run", name)
+
     if chaos is not None:
-        spec, at_frac = chaos
+        spec, at_frac = chaos[0], chaos[1]
         chaos_timer = threading.Timer(
             float(at_frac) * config.duration_s, _arm_chaos, (spec,))
         chaos_timer.daemon = True
         chaos_timer.start()
+        if len(chaos) > 2 and chaos[2] is not None:
+            heal_timer = threading.Timer(
+                float(chaos[2]) * config.duration_s, _heal_chaos)
+            heal_timer.daemon = True
+            heal_timer.start()
     solo_pick = sweep_pick = None
     if config.zipf > 0.0:
         solo_pick = zipf_indices(len(arrivals), config, 0x21BF)
@@ -297,6 +321,9 @@ def run_phase(backend, config, design, name="load", chaos=None,
         if chaos_timer is not None:
             chaos_timer.cancel()
             chaos_timer.join(timeout=1.0)
+        if heal_timer is not None:
+            heal_timer.join(timeout=max(
+                1.0, float(config.collect_timeout_s)))
     # ---- collect: every accepted request must reach a terminal status
     statuses = {}
     lost = 0
@@ -331,6 +358,9 @@ def run_phase(backend, config, design, name="load", chaos=None,
 
         inj = get_injector()
         chaos_fires = inj.snapshot() if inj is not None else None
+        if chaos_fires is None:
+            # healed mid-run: the fire accounting was captured then
+            chaos_fires = healed.get("fires")
         if chaos_prev is None:
             os.environ.pop("RAFT_TPU_CHAOS", None)
         else:

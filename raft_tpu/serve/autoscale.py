@@ -30,7 +30,22 @@ Policy (``Autoscaler.step``, one evaluation per tick):
   their arcs move to survivors so retries stop burning hops on dead
   processes) and spawn a replacement IMMEDIATELY — the floor is an
   availability invariant, so healing bypasses both the hysteresis
-  window and the cooldown (one spawn per tick still bounds the rate);
+  window and the cooldown (one spawn per tick still bounds the rate).
+  A fleet that CANNOT grow (``fleet.can_scale_out()`` is False — an
+  attach-mode router does not own its replicas' processes) degrades
+  gracefully instead: corpses are still reaped and the ring re-weights
+  onto the survivors (``fleet.reweigh``, when offered), and the
+  unserviceable floor breach is recorded once per episode as a
+  ``heal_unavailable`` decision
+  (``raft_tpu_autoscaler_heal_unavailable_total``) — an operator
+  signal, never a crash loop;
+* **stale-view gate**: the fleet view a tick acts on (gauges + health
+  states) is versioned by ``fleet.health_epoch()``; the epoch is
+  captured right after the scrape and re-checked immediately before
+  any action, and a mismatch (a replica died, healed, attached or got
+  reaped mid-tick) skips the tick
+  (``raft_tpu_autoscaler_stale_view_skips_total``) rather than scaling
+  on a fleet that no longer exists;
 * **scale-out** when pressure has been at/above ``high_water``
   continuously for ``sustain_s`` (the hysteresis window: a single
   burst tick never spawns a process) and the fleet is below
@@ -53,8 +68,10 @@ logs.  The live thread (``start()``) merely calls ``step()`` every
 The fleet object must provide ``replica_gauges() -> {rid: doc|None}``,
 ``scale_out() -> rid``, ``retire_replica(rid) -> bool`` and
 ``retire_candidate() -> rid|None`` — the Router implements exactly
-this surface (plus the optional ``reap_dead() -> [rid]`` the heal
-rule uses when present).
+this surface (plus the optional ``reap_dead() -> [rid]``,
+``can_scale_out() -> bool``, ``reweigh(gauges)`` and
+``health_epoch() -> int`` hooks the heal rule and the stale-view gate
+use when present).
 
 Env knobs (read by ``AutoscaleConfig.from_env``; ``RAFT_TPU_AUTOSCALE``
 itself enables the loop inside Router):
@@ -133,6 +150,7 @@ class Autoscaler:
         "_high_since": "_step_lock",
         "_low_since": "_step_lock",
         "_last_action_t": "_step_lock",
+        "_heal_unavailable_noted": "_step_lock",
     }
 
     def __init__(self, fleet, config=None, clock=time.monotonic,
@@ -156,7 +174,16 @@ class Autoscaler:
         self._ctr_heals = self.metrics.counter(
             "raft_tpu_autoscaler_heals_total",
             "replicas spawned to repair the min-replica floor")
+        self._ctr_heal_unavail = self.metrics.counter(
+            "raft_tpu_autoscaler_heal_unavailable_total",
+            "floor breaches the policy could not heal by spawning "
+            "(attach-mode fleet): reap-and-reweigh degradation instead")
+        self._ctr_stale_skips = self.metrics.counter(
+            "raft_tpu_autoscaler_stale_view_skips_total",
+            "policy ticks skipped because the fleet's health epoch "
+            "moved between the scrape and the action")
         self.decisions = []        # [{t, action, replica, pressure, ...}]
+        self._heal_unavailable_noted = False
         self.steps = 0
         self._t0 = clock()
         self._high_since = None    # clock() when pressure crossed high
@@ -208,12 +235,53 @@ class Autoscaler:
             self._low_since = None
         elif self._low_since is None:
             self._low_since = now
+        # stale-view gate (module docstring): the view this tick acts
+        # on is versioned by the fleet's health epoch, captured right
+        # after the scrape.  Re-checked immediately before each action
+        # — a mid-tick transition (death, heal, attach, reap on another
+        # thread) means the gauges describe a fleet that no longer
+        # exists, so the tick declines to act on them.
+        epoch_fn = getattr(self.fleet, "health_epoch", None)
+        view_epoch = epoch_fn() if epoch_fn is not None else None
+
+        def view_stale():
+            if view_epoch is None or epoch_fn() == view_epoch:
+                return False
+            self._ctr_stale_skips.inc()
+            logger.warning(
+                "autoscale: fleet view went stale mid-tick (health "
+                "epoch %d -> %d); skipping this tick", view_epoch,
+                epoch_fn())
+            return True
+
         # heal: alive count below the floor means a replica DIED (chaos
         # kill, crash) rather than a policy choice — the floor is an
         # availability invariant, so repair skips hysteresis/cooldown
         if alive < self.config.min_replicas:
+            if view_stale():
+                return None
             reap = getattr(self.fleet, "reap_dead", None)
             reaped = reap() if reap is not None else []
+            can = getattr(self.fleet, "can_scale_out", None)
+            if can is not None and not can():
+                # attach mode: nothing to spawn.  Degrade gracefully —
+                # the reap above already moved dead arcs to survivors;
+                # re-weight the ring onto them and note the breach ONCE
+                # per episode (the floor stays breached every tick
+                # until an operator attaches capacity)
+                reweigh = getattr(self.fleet, "reweigh", None)
+                if reaped and reweigh is not None:
+                    reweigh(gauges)
+                if reaped or not self._heal_unavailable_noted:
+                    self._heal_unavailable_noted = True
+                    self._last_action_t = now
+                    rec = self._record_locked(
+                        now, "heal_unavailable", None, per, shedding,
+                        alive)
+                    if reaped:
+                        rec["reaped"] = list(reaped)
+                    return rec
+                return None
             # ceiling still binds: an unreachable-but-alive replica
             # (slow /statz) reads as dead, and unbounded healing on
             # that misread would blow past max_replicas
@@ -227,6 +295,7 @@ class Autoscaler:
                     rec["reaped"] = list(reaped)
                 return rec
             return None
+        self._heal_unavailable_noted = False
         in_cooldown = (self._last_action_t is not None
                        and now - self._last_action_t
                        < self.config.cooldown_s)
@@ -235,6 +304,8 @@ class Autoscaler:
         if (high and self._high_since is not None
                 and now - self._high_since >= self.config.sustain_s
                 and n < self.config.max_replicas):
+            if view_stale():
+                return None
             replica = self.fleet.scale_out()
             self._last_action_t = now
             self._high_since = None
@@ -243,6 +314,8 @@ class Autoscaler:
         if (low and self._low_since is not None
                 and now - self._low_since >= self.config.sustain_s
                 and alive > self.config.min_replicas):
+            if view_stale():
+                return None
             replica = self.fleet.retire_candidate()
             if replica is None:
                 return None
@@ -266,7 +339,8 @@ class Autoscaler:
         self.decisions.append(rec)
         {"scale_out": self._ctr_scale_outs,
          "scale_in": self._ctr_scale_ins,
-         "heal": self._ctr_heals}[action].inc()
+         "heal": self._ctr_heals,
+         "heal_unavailable": self._ctr_heal_unavail}[action].inc()
         logger.warning("autoscale %s: %s (pressure %.2f%s, fleet -> %d)",
                        action, replica, per,
                        ", shedding" if shedding else "", n_after)
@@ -281,6 +355,8 @@ class Autoscaler:
             "scale_outs": self._ctr_scale_outs.get(),
             "scale_ins": self._ctr_scale_ins.get(),
             "heals": self._ctr_heals.get(),
+            "heal_unavailable": self._ctr_heal_unavail.get(),
+            "stale_view_skips": self._ctr_stale_skips.get(),
             "config": dataclasses.asdict(self.config),
         }
 

@@ -396,6 +396,39 @@ class WarmupManifest:
             os.replace(tmp, self.path)
         return changed
 
+    def merge(self, entries):
+        """Merge wire-shipped raw entries (the shared-nothing warm
+        transfer, ``POST /v1/cache/preload``) into this manifest:
+        schema-validated with the same shape gates as ``load`` and
+        deduplicated on the entry key; flags still decide reuse at
+        warm-up time, so a foreign-flag entry merges harmlessly and is
+        skipped later.  Returns the number of entries added."""
+        incoming = [
+            e for e in (entries or [])
+            if (isinstance(e, dict)
+                and isinstance(e.get("spec"), dict)
+                and isinstance(e.get("physics"), dict)
+                and isinstance(e.get("flags"), dict))]
+        if not incoming:
+            return 0
+        with self._lock:
+            have = self.load()
+            keys = {self._entry_key(e) for e in have}
+            added = 0
+            for entry in incoming:
+                key = self._entry_key(entry)
+                if key in keys:
+                    continue
+                keys.add(key)
+                have.append(entry)
+                added += 1
+            if added:
+                tmp = f"{self.path}.tmp.{os.getpid()}"
+                with open(tmp, "w") as fh:
+                    json.dump({"entries": have}, fh, indent=1)
+                os.replace(tmp, self.path)
+        return added
+
 
 def warmup(manifest=None, designs=None, cases=None, precision=None,
            cache_dir=None, execute=True):

@@ -55,6 +55,7 @@ Fault isolation, per request:
    docs/serving.md).
 """
 
+import base64
 import dataclasses
 import os
 import queue
@@ -699,6 +700,7 @@ class Engine:
             "result_cache_stores": 0, "result_cache_evictions": 0,
             "result_cache_corrupt": 0,
             "handoff_preloaded": 0, "handoff_missing": 0,
+            "wire_preload_loaded": 0, "wire_preload_refused": 0,
             "first_result_s": None, "warmup": None,
         })
         self._gauge_result_bytes = self.metrics.gauge(
@@ -734,6 +736,58 @@ class Engine:
         self._watchdog.start()
 
     # ------------------------------------------------------------- client
+
+    def preload_wire(self, doc):
+        """One chunk of a shared-nothing warm transfer (``POST
+        /v1/cache/preload`` — docs/serving.md).  ``doc["kind"]``:
+
+        * ``"entry"`` — one result-cache entry's raw npz bytes
+          (base64) plus its transfer sha256, committed via
+          ``ResultCache.receive_entry``: a torn or corrupt chunk is
+          refused (and deleted when it got as far as disk), never
+          served.
+        * ``"manifest"`` — warm-handoff ``[key, kind]`` rows; a
+          fully-verified read warms each named entry (missing rows are
+          plain misses, the stale_handoff contract).
+        * ``"warmup"`` — warm-up bucket manifest entries, merged into
+          this replica's serve manifest for its next ``warmup()`` pass.
+
+        Raises ValueError on an unknown kind (the transport maps it to
+        HTTP 400).  Prep npz is deliberately NOT transferable: it is
+        topology-independent and cheap to rebuild locally."""
+        if self._result_cache is None:
+            return {"error": "result cache disabled on this replica"}
+        kind = (doc or {}).get("kind")
+        if kind == "entry":
+            try:
+                data = base64.b64decode(doc.get("data_b64", ""),
+                                        validate=True)
+            except (ValueError, TypeError):
+                data = None
+            verdict = "refused" if data is None else \
+                self._result_cache.receive_entry(
+                    str(doc.get("key", "")),
+                    str(doc.get("cache_kind", "result")),
+                    data, str(doc.get("sha256", "")))
+            if verdict == "loaded":
+                with self._lock:
+                    self.stats["wire_preload_loaded"] += 1
+                return {"loaded": 1, "refused": 0}
+            with self._lock:
+                self.stats["wire_preload_refused"] += 1
+            return {"loaded": 0, "refused": 1}
+        if kind == "manifest":
+            loaded, missing = self._result_cache.preload(
+                doc.get("entries") or [])
+            with self._lock:
+                self.stats["handoff_preloaded"] += loaded
+                self.stats["handoff_missing"] += missing
+            return {"loaded": loaded, "missing": missing}
+        if kind == "warmup":
+            if self._manifest is None:
+                return {"error": "no warm-up manifest on this replica"}
+            return {"merged": self._manifest.merge(doc.get("entries"))}
+        raise ValueError(f"unknown preload kind {kind!r}")
 
     def submit(self, design, cases=None, deadline_s=None, trace=None):
         """Enqueue one request; returns a handle with ``result(timeout)``.
@@ -2491,6 +2545,10 @@ class Engine:
             # endpoint
             "handoff_preloaded": self.stats["handoff_preloaded"],
             "handoff_missing": self.stats["handoff_missing"],
+            # shared-nothing wire preload outcome (PR 20): same idea,
+            # for entries shipped over POST /v1/cache/preload
+            "wire_preload_loaded": self.stats["wire_preload_loaded"],
+            "wire_preload_refused": self.stats["wire_preload_refused"],
             # served adjoint evaluations (docs/differentiation.md)
             "grad_requests": self.stats["grad_requests"],
             "grad_ok": self.stats["grad_ok"],

@@ -42,6 +42,27 @@ replica, which pre-loads those entries before its ready line — a
 freshly scaled replica starts with the Zipf head hot instead of
 cold-missing it (pinned in tests/test_elastic.py).
 
+Partition-tolerant multi-host attach (PR 20): ``attach_remote(host,
+port)`` joins an already-running remote replica after a ``GET
+/versionz`` compatibility handshake that REFUSES (with a logged
+reason) any peer whose wire version, env flag surface or flag values
+disagree with ours — a mixed-flag fleet would serve non-bit-identical
+answers for one routing key, so it is never formed.  The handshake is
+re-run on the circuit breaker's half-open probe: an attached peer that
+comes back from an outage may be a restarted process with different
+flags, and a refusal there EJECTS it from the fleet instead of
+trusting it.  Attached fleets share nothing on disk, so the warm
+handoff ships the popularity head's actual cache entries over ``POST
+/v1/cache/preload`` as sha256-checksummed chunks (a torn or corrupted
+transfer is refused before any bytes land; a chunk that survives
+transit but fails the standard verified read is refused-and-deleted)
+plus the handoff manifest and the warm-up bucket manifest.  A
+per-replica health state machine (alive -> suspect -> dead, driven by
+consecutive /statz scrape failures) DEPRIORITIZES suspect replicas for
+new work without touching their in-flight requests; every health or
+fleet transition bumps a health epoch, and the autoscaler re-checks
+that epoch before acting so it never scales on a stale fleet view.
+
 Resilience at the router tier (resilience.py, reused as designed in
 PR 5): a per-replica ``CircuitBreaker`` via ``BreakerBoard``; forwards
 that fail with a ``TransientError`` (dropped connection, dead replica,
@@ -94,6 +115,7 @@ accepted request with a terminal status, and forwards answered with
 ``/statz`` gauges.
 """
 
+import base64
 import dataclasses
 import hashlib
 import json
@@ -110,10 +132,12 @@ from concurrent.futures import ThreadPoolExecutor
 from raft_tpu.chaos import get_injector
 from raft_tpu.obs.metrics import MetricsRegistry
 from raft_tpu.obs.tracing import SpanRing, TraceContext
-from raft_tpu.resilience import BreakerBoard, TransientError
+from raft_tpu.resilience import (STATE_HALF_OPEN, BreakerBoard,
+                                 TransientError)
 from raft_tpu.serve import wire
 from raft_tpu.serve.engine import GradResult, RequestResult, _Pending
 from raft_tpu.serve.result_cache import (
+    HANDOFF_TOP_K,
     ResultCache,
     coalesce_key,
     grad_key,
@@ -122,11 +146,15 @@ from raft_tpu.serve.result_cache import (
     sweep_chunk_key,
     sweep_coalesce_key,
 )
-from raft_tpu.serve.transport import ConnectionDropped, WireClient
+from raft_tpu.serve.transport import (ConnectionDropped, WireChecksumError,
+                                      WireClient)
 from raft_tpu.utils.profiling import logger
 
 DEFAULT_READY_TIMEOUT_S = 300.0
 _VNODES = 64
+# health state machine thresholds (consecutive failed /statz scrapes)
+HEALTH_SUSPECT_AFTER = 2
+HEALTH_DEAD_AFTER = 4
 
 
 def _hash_point(text):
@@ -191,11 +219,23 @@ class HashRing:
 
     def __init__(self, ids, vnodes=_VNODES):
         self.ids = list(ids)
+        # vnodes: one uniform count, or {rid: count} for load-aware
+        # weighting (Router.reweigh).  Vnode point v of a replica is
+        # the SAME hash at any count, so changing a replica's weight
+        # only moves the keys on its added/removed arcs — every other
+        # assignment is untouched (pinned in tests/test_multihost.py).
+        if isinstance(vnodes, dict):
+            counts = {rid: max(1, int(vnodes.get(rid, _VNODES)))
+                      for rid in self.ids}
+        else:
+            counts = {rid: max(1, int(vnodes)) for rid in self.ids}
         self._points = sorted(
             (_hash_point(f"{rid}#{v}"), rid)
-            for rid in self.ids for v in range(vnodes))
+            for rid in self.ids for v in range(counts.get(rid, 0)))
 
     def lookup(self, key):
+        if not self._points:
+            return None
         h = _hash_point(key)
         idx = bisect_right(self._points, (h, "")) % len(self._points)
         return self._points[idx][1]
@@ -203,6 +243,8 @@ class HashRing:
     def preference(self, key):
         """All replica ids in ring-walk order from the key's point —
         element 0 is the primary, the rest are the failover order."""
+        if not self._points:
+            return []
         h = _hash_point(key)
         start = bisect_right(self._points, (h, ""))
         order, seen = [], set()
@@ -237,6 +279,13 @@ class Replica:
         return {"id": self.id, "host": self.host, "port": self.port,
             "alive": self.alive, "served": self.served,
             "pid": self.proc.pid if self.proc is not None else None}
+
+
+class HandshakeRefused(RuntimeError):
+    """A remote peer failed the ``/versionz`` compatibility handshake
+    (wire version, env flag surface or flag values disagree) and was
+    refused — attaching it would let one routing key resolve to
+    non-bit-identical answers depending on placement."""
 
 
 def _repo_root():
@@ -442,6 +491,12 @@ class Router:
         # fulfill (_fulfill_chunk) and abandon (_abandon_chunks) all
         # serialize on the lock
         "_inflight_chunks": "_lock",
+        # health state machine + fleet-view epoch + ring vnode weights:
+        # scrapes (replica_gauges), placement (_placement_order) and
+        # fleet changes (_rebuild_ring_locked) serialize on the lock
+        "_health": "_lock",
+        "_health_epoch": "_lock",
+        "_ring_weights": "_lock",
     }
     # probe() is the readiness gauge: GIL-atomic len()/dict reads only,
     # so a wedged batcher holding _lock can never wedge the health check
@@ -513,6 +568,10 @@ class Router:
             "handoff_entries_shipped": 0,
             "grad_requests": 0, "grad_forwarded": 0,
             "grad_cache_hits": 0, "grad_cache_misses": 0,
+            "handshake_refusals": 0, "peer_ejections": 0,
+            "suspect_deprioritized": 0, "reweighs": 0,
+            "wire_preload_entries_sent": 0, "wire_preload_failures": 0,
+            "wire_checksum_refusals": 0,
         })
         # spawn recipe kept for scale_out (None in attach mode: the
         # router does not own attached processes, so it cannot grow or
@@ -522,6 +581,12 @@ class Router:
             window_ms=window_ms, warmup=warmup, extra_argv=replica_argv,
             env_overrides=env_overrides, ready_timeout_s=ready_timeout_s)
         self._next_replica = n_replicas
+        # per-replica health state machine (module docstring): alive ->
+        # suspect -> dead on consecutive scrape failures; the epoch
+        # versions the fleet view for staleness detection
+        self._health = {}
+        self._health_epoch = 0
+        self._ring_weights = None    # {rid: vnodes} after reweigh()
         if endpoints is not None:          # attach mode
             self.replicas = {
                 f"r{i}": Replica(f"r{i}", host, port)
@@ -547,7 +612,7 @@ class Router:
                         if f.done() and f.exception() is None:
                             f.result().proc.kill()
                     raise
-        self._ring = HashRing(sorted(self.replicas))
+        self._rebuild_ring_locked()    # __init__: no other thread yet
         self._breakers = BreakerBoard(
             failure_threshold=breaker_failures,
             cooldown_s=breaker_cooldown_s)
@@ -559,7 +624,7 @@ class Router:
             autoscale = os.environ.get(
                 "RAFT_TPU_AUTOSCALE", "").strip().lower() in (
                 "1", "true", "yes", "on")
-        if autoscale and self._spawn_kw is not None:
+        if autoscale:
             from raft_tpu.serve.autoscale import (AutoscaleConfig,
                                                   Autoscaler)
 
@@ -844,6 +909,10 @@ class Router:
         out["breakers"] = self._breakers.snapshot()
         out["scrape_errors"] = self._scrape_errors.get()
         out["scrape_ages_s"] = self.scrape_ages()
+        out["health"] = self.health_view()
+        out["health_epoch"] = self._health_epoch
+        with self._lock:
+            out["ring_weights"] = dict(self._ring_weights or {})
         out["trace_spans"] = self.trace_ring.snapshot()
         if self.autoscaler is not None:
             out["autoscale"] = self.autoscaler.snapshot()
@@ -947,10 +1016,13 @@ class Router:
                 gauges[rid] = doc
                 with self._lock:
                     self._last_scrape_ok[rid] = now
+                    self._health_note_locked(rid, True)
             except Exception as exc:  # noqa: BLE001 — unreachable
                 gauges[rid] = None    # reads as dead; debug level since
                 # a corpse fires this every tick until heal reaps it
                 self._scrape_errors.inc()
+                with self._lock:
+                    self._health_note_locked(rid, False)
                 logger.debug("statz scrape of %s failed: %s", rid, exc)
         with self._lock:
             # staleness over ALIVE replicas only: a replica that never
@@ -974,6 +1046,109 @@ class Router:
                 rid: round(now - self._last_scrape_ok.get(
                     rid, self._t_start), 3)
                 for rid, rep in self.replicas.items() if not rep.dead()}
+
+    # -- fleet health + ring maintenance ----------------------------
+
+    def _rebuild_ring_locked(self):
+        """Rebuild the ring from the current replica set (honoring
+        per-replica vnode weights when ``reweigh`` set them), prune
+        health state for departed replicas, and bump the health epoch
+        — any fleet change invalidates views captured before it."""
+        ids = sorted(self.replicas)
+        self._ring = HashRing(ids, vnodes=(self._ring_weights
+                                           if self._ring_weights
+                                           else _VNODES))
+        self._health = {
+            rid: self._health.get(rid, {"state": "alive", "fails": 0})
+            for rid in ids}
+        self._health_epoch += 1
+
+    def _health_note_locked(self, rid, ok):
+        """Advance one replica's health state machine on a scrape
+        outcome: ``alive -> suspect`` after HEALTH_SUSPECT_AFTER
+        consecutive failures, ``-> dead`` after HEALTH_DEAD_AFTER (a
+        dead verdict marks the replica for ``reap_dead``); any success
+        snaps straight back to alive.  Every TRANSITION bumps the
+        health epoch, so fleet views captured before it are detectably
+        stale — suspect replicas stop receiving new work
+        (``_placement_order``) but keep their in-flight requests."""
+        st = self._health.get(rid)
+        if st is None:
+            st = self._health[rid] = {"state": "alive", "fails": 0}
+        if ok:
+            if st["state"] != "alive":
+                self._health_epoch += 1
+                logger.info("replica %s health: %s -> alive", rid,
+                            st["state"])
+            st["state"], st["fails"] = "alive", 0
+            return
+        st["fails"] += 1
+        prev = st["state"]
+        if st["fails"] >= HEALTH_DEAD_AFTER:
+            st["state"] = "dead"
+        elif st["fails"] >= HEALTH_SUSPECT_AFTER:
+            st["state"] = "suspect"
+        if st["state"] != prev:
+            self._health_epoch += 1
+            if st["state"] == "dead":
+                rep = self.replicas.get(rid)
+                if rep is not None:
+                    rep.alive = False    # reap_dead collects it
+            logger.warning(
+                "replica %s health: %s -> %s after %d consecutive "
+                "failed scrape(s)", rid, prev, st["state"], st["fails"])
+
+    def health_epoch(self):
+        """Monotonic fleet-view version (lock-free int read): bumped
+        on every health-state transition and every replica-set change.
+        A policy decision captures it with its gauges and re-checks
+        before acting — a mismatch means the view is stale."""
+        return self._health_epoch
+
+    def health_view(self):
+        """{replica_id: {"state", "fails"}} snapshot (tests/statz)."""
+        with self._lock:
+            return {rid: dict(st) for rid, st in self._health.items()}
+
+    def reweigh(self, gauges=None):
+        """Load-aware ring weights: set each replica's vnode count
+        proportional to its observed throughput (``ok / uptime_s``
+        from ``/statz``), clamped to [_VNODES//4, 4*_VNODES] so one
+        hot or cold outlier can never starve or own the ring.
+        Deterministic — the same gauges always produce the same ring
+        (sha256 points, no process seed), and because a vnode's hash
+        is independent of the count, re-weighting only moves the keys
+        on added/removed arcs (pinned in tests/test_multihost.py).
+        Replicas with no usable gauge keep the uniform default.
+        Returns {replica_id: vnode_count}."""
+        if gauges is None:
+            gauges = self.replica_gauges()
+        rates = {}
+        for rid, doc in (gauges or {}).items():
+            if not isinstance(doc, dict):
+                continue
+            try:
+                up = float(doc.get("uptime_s") or 0.0)
+                ok = float(doc.get("ok") or 0.0)
+            except (TypeError, ValueError):
+                continue
+            if up > 0:
+                rates[rid] = ok / up
+        mean = (sum(rates.values()) / len(rates)) if rates else 0.0
+        weights = {}
+        if mean > 0:
+            for rid in sorted(rates):
+                weights[rid] = int(min(4 * _VNODES, max(
+                    _VNODES // 4, round(_VNODES * rates[rid] / mean))))
+        with self._lock:
+            self._ring_weights = weights or None
+            self._rebuild_ring_locked()
+            self.stats["reweighs"] += 1
+            out = {rid: weights.get(rid, _VNODES)
+                   for rid in sorted(self.replicas)}
+        logger.info("reweigh: ring vnode weights %s",
+                    weights or "uniform")
+        return out
 
     def scale_out(self):
         """Spawn one more replica and claim only its vnode arcs on the
@@ -1013,11 +1188,186 @@ class Router:
                 rep.proc.send_signal(signal.SIGTERM)
                 raise RuntimeError("router is shut down")
             self.replicas[replica_id] = rep
-            self._ring = HashRing(sorted(self.replicas))
+            self._rebuild_ring_locked()
             self.stats["scale_outs"] += 1
         logger.info("scale-out: %s up on port %d (%d replicas)",
                     replica_id, rep.port, len(self.replicas))
         return replica_id
+
+    def can_scale_out(self):
+        """Whether this fleet can GROW (spawn a replacement/extra
+        replica).  False in attach mode — the router does not own
+        attached processes, so the autoscaler's heal rule must degrade
+        to reap-and-reweigh instead of spawning."""
+        return self._spawn_kw is not None
+
+    # -- multi-host attach (shared-nothing peers) --------------------
+
+    def _my_flags(self):
+        if self._result_cache is not None:
+            return self._result_cache.flags
+        from raft_tpu.serve.cache import current_flags
+        return current_flags()
+
+    def _handshake(self, host, port, timeout=10.0):
+        """``GET /versionz`` compatibility handshake with a remote
+        peer.  Returns the peer's version doc, or raises
+        ``HandshakeRefused`` carrying the FIRST mismatch as its reason:
+        wire version, then the env flag surface (a peer gating on
+        different env vars runs different code — its flag values are
+        not even comparable), then the flag values themselves via the
+        same ``flags_mismatch`` gate the result cache refuses entries
+        with.  The ``handshake_skew`` chaos fault mutates the reported
+        flags to force the refusal path."""
+        from raft_tpu.serve.cache import ENV_FLAG_SURFACE, flags_mismatch
+
+        client = WireClient(host, port)
+        try:
+            code, doc = client.get("/versionz", timeout=timeout)
+        except Exception as exc:  # noqa: BLE001 — any transport error
+            err = HandshakeRefused(
+                f"{host}:{port} unreachable for /versionz: {exc}")
+            err.transport = True    # unreachable, not incompatible
+            raise err
+        if code != 200 or not isinstance(doc, dict):
+            raise HandshakeRefused(
+                f"{host}:{port} answered /versionz with HTTP {code} "
+                f"(pre-/versionz peer or not a raft_tpu replica)")
+        peer_flags = dict(doc.get("flags") or {})
+        inj = get_injector()
+        if inj is not None and inj.should("handshake_skew",
+                                          port) is not None:
+            peer_flags["code_version"] = (
+                f"skew-{peer_flags.get('code_version')}")
+        if doc.get("wire_version") != wire.WIRE_VERSION:
+            reason = (f"wire_version {doc.get('wire_version')!r} != "
+                      f"ours {wire.WIRE_VERSION!r}")
+        elif dict(doc.get("env_flag_surface") or {}) != dict(
+                ENV_FLAG_SURFACE):
+            reason = ("env flag surface disagrees — the peer gates "
+                      "numerics on a different set of env vars")
+        else:
+            reason = flags_mismatch(peer_flags, flags=self._my_flags())
+        if reason is not None:
+            raise HandshakeRefused(f"{host}:{port}: {reason}")
+        return doc
+
+    def attach_remote(self, host, port, warm=True):
+        """Join one already-running remote replica to the fleet after
+        the ``/versionz`` handshake (module docstring).  Refused peers
+        raise ``HandshakeRefused`` and leave the fleet untouched.  On
+        success the peer's ring arcs are claimed like a scale-out's,
+        and ``warm=True`` first ships the shared-nothing warm transfer
+        (cache entries + manifests over ``POST /v1/cache/preload``) so
+        the newcomer joins hot.  Returns the new replica id."""
+        try:
+            doc = self._handshake(host, port)
+        except HandshakeRefused as exc:
+            with self._lock:
+                self.stats["handshake_refusals"] += 1
+            logger.warning("attach_remote refused %s:%d: %s", host,
+                           port, exc)
+            raise
+        with self._lock:
+            if self._stop:
+                raise RuntimeError("router is shut down")
+            replica_id = f"r{self._next_replica}"
+            self._next_replica += 1
+        rep = Replica(replica_id, host, port)
+        if warm:
+            self._ship_warm_cache(rep)
+        with self._lock:
+            if self._stop:
+                raise RuntimeError("router is shut down")
+            self.replicas[replica_id] = rep
+            self._rebuild_ring_locked()
+        logger.info(
+            "attached remote replica %s at %s:%d (code_version %s)",
+            replica_id, host, port,
+            (doc.get("flags") or {}).get("code_version"))
+        return replica_id
+
+    def _reverify_half_open(self, replica_id, rep):
+        """Re-run the handshake on a breaker half-open probe of an
+        ATTACHED peer: a remote that comes back from an outage may be
+        a restarted process with different flags.  A refusal EJECTS the
+        peer from the fleet (returns False); a spawned replica inherits
+        our env and is never re-checked.  Plain unreachability is
+        False-without-eject — still the breaker's business, not an
+        incompatibility."""
+        try:
+            self._handshake(rep.host, rep.port, timeout=5.0)
+            return True
+        except HandshakeRefused as exc:
+            if getattr(exc, "transport", False):
+                self._breakers.get(replica_id).record_failure(str(exc))
+                return False
+            with self._lock:
+                self.stats["handshake_refusals"] += 1
+                self.stats["peer_ejections"] += 1
+                if self.replicas.get(replica_id) is rep:
+                    del self.replicas[replica_id]
+                    self._rebuild_ring_locked()
+            self._breakers.get(replica_id).record_failure(str(exc))
+            logger.warning(
+                "half-open re-verify EJECTED %s (%s:%d): %s",
+                replica_id, rep.host, rep.port, exc)
+            return False
+
+    def _ship_warm_cache(self, rep, top_k=HANDOFF_TOP_K):
+        """Shared-nothing warm transfer to one attached peer: the
+        popularity head's ACTUAL cache entry bytes (sha256-checksummed
+        chunks the receiver refuses when torn or corrupt), then the
+        handoff manifest naming them, then the warm-up bucket manifest
+        — all over ``POST /v1/cache/preload``.  Best-effort: a failed
+        chunk is counted and skipped, never fatal (the peer just joins
+        colder).  Returns the number of entries the peer loaded."""
+        cache = self._result_cache
+        if cache is None:
+            return 0
+        from raft_tpu.serve.cache import WarmupManifest
+
+        sent = failed = 0
+        shipped = []
+        for key, kind in cache.top_entries(top_k):
+            data = cache.read_entry_bytes(key)
+            if data is None:
+                continue                 # evicted since top_entries
+            doc = {"kind": "entry", "key": key, "cache_kind": kind,
+                   "sha256": hashlib.sha256(data).hexdigest(),
+                   "data_b64": base64.b64encode(data).decode("ascii")}
+            try:
+                out = rep.client.post_json("/v1/cache/preload", doc)
+            except Exception as exc:  # noqa: BLE001 — best effort
+                failed += 1
+                logger.warning("wire preload entry %s -> %s failed: %s",
+                               key[:8], rep.id, exc)
+                continue
+            if out.get("loaded"):
+                sent += 1
+                shipped.append([key, kind])
+            else:
+                failed += 1
+        for kind, entries in (
+                ("manifest", shipped),
+                ("warmup", WarmupManifest(
+                    cache_dir=self.cache_dir).load())):
+            if not entries:
+                continue
+            try:
+                rep.client.post_json("/v1/cache/preload",
+                                     {"kind": kind, "entries": entries})
+            except Exception as exc:  # noqa: BLE001 — best effort
+                failed += 1
+                logger.warning("wire preload %s -> %s failed: %s",
+                               kind, rep.id, exc)
+        with self._lock:
+            self.stats["wire_preload_entries_sent"] += sent
+            self.stats["wire_preload_failures"] += failed
+        logger.info("wire warm transfer to %s: %d entr%s loaded, %d "
+                    "failed", rep.id, sent,
+                    "y" if sent == 1 else "ies", failed)
+        return sent
 
     def reap_dead(self):
         """Drop replicas whose PROCESS has died (chaos kill, crash —
@@ -1032,7 +1382,7 @@ class Router:
                     del self.replicas[rid]
                     reaped.append(rid)
             if reaped:
-                self._ring = HashRing(sorted(self.replicas))
+                self._rebuild_ring_locked()
                 self.stats["reaps"] += len(reaped)
         for rid in reaped:
             logger.warning("reaped dead replica %s (process exited)",
@@ -1065,7 +1415,7 @@ class Router:
             if rep is None or len(self.replicas) <= 1:
                 return False
             del self.replicas[replica_id]
-            self._ring = HashRing(sorted(self.replicas))
+            self._rebuild_ring_locked()
             self.stats["scale_ins"] += 1
         if rep.proc is not None and rep.proc.poll() is None:
             rep.proc.send_signal(signal.SIGTERM)
@@ -1146,6 +1496,26 @@ class Router:
     def route(self, design, cases=None):
         """The replica id a request WOULD land on (tests/bench)."""
         return self._ring.lookup(routing_key(design, cases))
+
+    def _placement_order(self, key):
+        """Ring preference reordered by health: alive replicas keep
+        their ring-walk order at the front; suspect and health-dead
+        replicas sink to the back IN ORDER — they stop receiving new
+        work while any healthy replica can serve, but an all-suspect
+        fleet still serves (deprioritized, never skipped), and their
+        in-flight requests are untouched."""
+        order = self._ring.preference(key)
+        with self._lock:
+            demoted = {
+                rid for rid in order
+                if self._health.get(rid, {}).get("state",
+                                                 "alive") != "alive"}
+            if demoted and len(demoted) < len(order):
+                self.stats["suspect_deprioritized"] += 1
+        if not demoted:
+            return order
+        return ([rid for rid in order if rid not in demoted]
+                + [rid for rid in order if rid in demoted])
 
     def _resolve_locked(self, rid, pend, res):
         self._outstanding.pop(rid, None)
@@ -1233,7 +1603,7 @@ class Router:
     def _forward(self, rid, pend, design, cases, deadline_s, t0,
                  trace=None, t_wall=None):
         key = routing_key(design, cases)
-        order = self._ring.preference(key)
+        order = self._placement_order(key)
         inj = get_injector()
         last_err = None
         attempted = breaker_skips = 0
@@ -1267,6 +1637,12 @@ class Router:
             if not breaker.allow():
                 breaker_skips += 1
                 last_err = f"{replica_id} breaker open"
+                continue
+            if (rep.proc is None and breaker.state == STATE_HALF_OPEN
+                    and not self._reverify_half_open(replica_id, rep)):
+                # attached peer failed the half-open re-handshake
+                breaker_skips += 1
+                last_err = f"{replica_id} failed half-open re-verify"
                 continue
             on_sent = None
             if inj is not None and inj.should("replica_kill",
@@ -1307,6 +1683,10 @@ class Router:
                 breaker.record_failure(str(e))
                 with self._lock:
                     self.stats["replica_retries"] += 1
+                    if isinstance(e, WireChecksumError):
+                        # corrupt payload caught at the wire: refused
+                        # and retried, never surfaced as a result
+                        self.stats["wire_checksum_refusals"] += 1
                 self.trace_ring.record(
                     "wire", trace, w_wall, time.perf_counter() - w0,
                     proc="router", replica=replica_id,
@@ -1366,7 +1746,7 @@ class Router:
         answering ``shutdown`` mid-drain never fails the request while
         another replica can serve it."""
         key = routing_key(design, None)
-        order = self._ring.preference(key)
+        order = self._placement_order(key)
         last_err = None
         attempted = breaker_skips = 0
         if t_wall is None:
@@ -1388,6 +1768,12 @@ class Router:
                 breaker_skips += 1
                 last_err = f"{replica_id} breaker open"
                 continue
+            if (rep.proc is None and breaker.state == STATE_HALF_OPEN
+                    and not self._reverify_half_open(replica_id, rep)):
+                # attached peer failed the half-open re-handshake
+                breaker_skips += 1
+                last_err = f"{replica_id} failed half-open re-verify"
+                continue
             req = {"design": design, "objective": objective}
             if trace is not None:
                 req["trace"] = trace.to_doc()
@@ -1402,6 +1788,10 @@ class Router:
                 breaker.record_failure(str(e))
                 with self._lock:
                     self.stats["replica_retries"] += 1
+                    if isinstance(e, WireChecksumError):
+                        # corrupt payload caught at the wire: refused
+                        # and retried, never surfaced as a result
+                        self.stats["wire_checksum_refusals"] += 1
                 self.trace_ring.record(
                     "wire", trace, w_wall, time.perf_counter() - w0,
                     proc="router", replica=replica_id,
@@ -1684,7 +2074,7 @@ class Router:
         after its leader died): they count as completed chunks, so only
         the uncovered designs are forwarded."""
         key = routing_key(designs[0], cases)
-        order = self._ring.preference(key)
+        order = self._placement_order(key)
         inj = get_injector()
         last_err = None
         attempted = breaker_skips = 0
@@ -1698,6 +2088,22 @@ class Router:
         for ch in streamed:
             done.update(int(i) for i in ch.get("designs", []))
         for replica_id in order:
+            if streamed and len(done) == len(designs):
+                # a dropped stream's checkpoints already cover every
+                # design: nothing is left to resubmit, so synthesize
+                # the terminal line from the checkpoints instead of
+                # forwarding an empty sub-sweep (a live replica fails
+                # an empty sweep, turning a fully-recovered request
+                # into a terminal failure)
+                if len(streamed) > n_pre:
+                    with self._lock:
+                        self.stats["sweep_chunk_failovers"] += 1
+                return self._resolve_sweep(
+                    rid, handle, designs, streamed,
+                    {"event": "sweep_result", "rid": rid,
+                     "status": "ok", "n_designs": len(designs)},
+                    streamed[-1].get("replica"), True, t0, trace,
+                    t_wall)
             rep = self.replicas.get(replica_id)
             if rep is None:                # retired mid-flight
                 last_err = f"{replica_id} retired"
@@ -1713,6 +2119,12 @@ class Router:
             if not breaker.allow():
                 breaker_skips += 1
                 last_err = f"{replica_id} breaker open"
+                continue
+            if (rep.proc is None and breaker.state == STATE_HALF_OPEN
+                    and not self._reverify_half_open(replica_id, rep)):
+                # attached peer failed the half-open re-handshake
+                breaker_skips += 1
+                last_err = f"{replica_id} failed half-open re-verify"
                 continue
             # checkpoint restart: only the uncovered designs cross the
             # wire; idx_map carries sub-sweep index -> original index
@@ -1788,6 +2200,10 @@ class Router:
                 breaker.record_failure(str(e))
                 with self._lock:
                     self.stats["replica_retries"] += 1
+                    if isinstance(e, WireChecksumError):
+                        # corrupt payload caught at the wire: refused
+                        # and retried, never surfaced as a result
+                        self.stats["wire_checksum_refusals"] += 1
                 self.trace_ring.record(
                     "sweep_wire", trace, w_wall,
                     time.perf_counter() - w0, proc="router",

@@ -82,6 +82,38 @@ class ConnectionDropped(TransientError):
     apply)."""
 
 
+class WireChecksumError(ConnectionDropped):
+    """A response payload failed its embedded checksum (serve/wire.py)
+    — in-flight corruption.  Subclasses ConnectionDropped so the router
+    retries the request instead of ever decoding the wrong bits."""
+
+
+def _flip_first_leaf(value):
+    """First numeric leaf of a nested list/dict flipped to a different
+    value; everything else untouched (copy-on-write along the path)."""
+    if isinstance(value, list) and value:
+        return [_flip_first_leaf(value[0])] + value[1:]
+    if isinstance(value, dict) and value:
+        key = next(iter(value))
+        return {**value, key: _flip_first_leaf(value[key])}
+    if isinstance(value, (int, float)):
+        return -float(value) - 1.0
+    return value
+
+
+def _corrupt_payload(doc):
+    """The wire_corrupt chaos mutation: one payload value of a decoded
+    response flipped.  Deliberately a STILL-VALID-JSON corruption — the
+    only kind a payload checksum is needed for; garbage that breaks the
+    JSON parse already fails loudly as ConnectionDropped."""
+    out = dict(doc)
+    for key in ("Xi_re", "Xi_r", "std", "gradient", "value", "theta"):
+        if key in out:
+            out[key] = _flip_first_leaf(out[key])
+            return out
+    return out
+
+
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     server_version = "raft-tpu-serve"
@@ -163,6 +195,19 @@ class _Handler(BaseHTTPRequestHandler):
             doc = {"spans": spans, "n_spans": len(spans)}
             doc.update(ring.snapshot())
             return self._send_json(200, doc)
+        if path == "/versionz":
+            # the attach handshake surface (Router.attach_remote): the
+            # FULL flag surface of serve/cache.py — code_version sha,
+            # jax version, x64, env knobs, device topology — so a peer
+            # can apply the stale-flag discipline to a live replica
+            # before routing any work to it (docs/serving.md)
+            from raft_tpu.serve.cache import (ENV_FLAG_SURFACE,
+                                              current_flags)
+            return self._send_json(200, {
+                "wire_version": wire.WIRE_VERSION,
+                "flags": current_flags(),
+                "env_flag_surface": dict(ENV_FLAG_SURFACE),
+                "uptime_s": round(self.transport.uptime_s, 3)})
         return self._send_json(404, {"error": f"no route {path}"})
 
     def do_POST(self):
@@ -173,6 +218,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._post_grad()
         if path == "/profilez":
             return self._post_profilez()
+        if path == "/v1/cache/preload":
+            return self._post_cache_preload()
         if path != "/v1/solve":
             return self._send_json(404, {"error": f"no route {path}"})
         if self.transport.draining:
@@ -250,6 +297,34 @@ class _Handler(BaseHTTPRequestHandler):
                 400, {"error": f"{type(e).__name__}: {e}"})
         doc = capture(log_dir=body.get("log_dir"))
         code = 200 if doc.get("armed", True) else 409
+        return self._send_json(code, doc)
+
+    def _post_cache_preload(self):
+        """``POST /v1/cache/preload`` — one chunk of a shared-nothing
+        warm transfer (docs/serving.md): a checksummed result-cache
+        entry's raw npz bytes, the warm-handoff manifest, or the
+        warm-up bucket manifest.  Delegates to ``backend.preload_wire``
+        (the Engine); a torn or corrupt chunk is refused-and-deleted
+        per the result_cache convention, never served."""
+        if self.transport.draining:
+            return self._send_json(503, {"error": "draining"})
+        preload = getattr(self.transport.backend, "preload_wire", None)
+        if preload is None:
+            return self._send_json(
+                404, {"error": "backend has no wire-preload surface"})
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            if length > MAX_BODY_BYTES:
+                return self._send_json(413, {"error": "body too large"})
+            body = json.loads(self.rfile.read(length)) if length else {}
+        except Exception as e:  # noqa: BLE001 — bad body, keep serving
+            return self._send_json(
+                400, {"error": f"{type(e).__name__}: {e}"})
+        try:
+            doc = preload(body)
+        except ValueError as e:
+            return self._send_json(400, {"error": str(e)})
+        code = 200 if not doc.get("error") else 409
         return self._send_json(code, doc)
 
     def _post_grad(self):
@@ -483,6 +558,39 @@ class WireClient:
             self.host, self.port,
             timeout=self.timeout if timeout is None else timeout)
 
+    def _chaos_partition(self):
+        """net_partition chaos hook: drop this endpoint's /v1/* POST
+        traffic (GET health probes still answer) — the gray failure a
+        partitioned host produces.  Injected at the wire client because
+        the chaos env is deliberately stripped from replica processes
+        (spawn_replica); ``@PORT`` in the spec targets one endpoint."""
+        inj = get_injector()
+        if inj is not None and inj.should("net_partition",
+                                          self.port) is not None:
+            raise ConnectionDropped(
+                f"chaos net_partition: {self.host}:{self.port} dropped "
+                f"the /v1/* request (health probes still answer)")
+
+    def _verify(self, doc):
+        """Refuse a response document whose embedded payload checksum
+        does not match its payload (wire.checksum_mismatch): raises
+        WireChecksumError — a ConnectionDropped — so the caller retries
+        elsewhere instead of decoding corrupted Xi bits.  The
+        wire_corrupt chaos mutation lands here, BEFORE verification,
+        so the test proves detection rather than assuming it."""
+        inj = get_injector()
+        if inj is not None and inj.should("wire_corrupt",
+                                          self.port) is not None:
+            logger.warning(
+                "chaos wire_corrupt: flipping payload bits of %s "
+                "rid=%s from %s:%d", doc.get("event"), doc.get("rid"),
+                self.host, self.port)
+            doc = _corrupt_payload(doc)
+        reason = wire.checksum_mismatch(doc)
+        if reason:
+            raise WireChecksumError(f"{self.host}:{self.port}: {reason}")
+        return doc
+
     def get(self, path, timeout=10.0):
         """GET a JSON endpoint -> (status_code, doc)."""
         conn = self._conn(timeout)
@@ -504,7 +612,9 @@ class WireClient:
             conn.close()
 
     def post_json(self, path, doc, timeout=30.0):
-        """POST a small JSON document (``/profilez``) -> response doc."""
+        """POST a small JSON document (``/profilez``,
+        ``/v1/cache/preload``) -> response doc."""
+        self._chaos_partition()
         body = wire.dumps(doc or {}).encode()
         conn = self._conn(timeout)
         try:
@@ -531,6 +641,7 @@ class WireClient:
         ``ConnectionDropped`` sends the router to the next ring replica
         (the solve is pure, so the abandoned replica's late answer is
         simply discarded)."""
+        self._chaos_partition()
         body = wire.dumps(doc).encode()
         conn = self._conn()
         try:
@@ -581,7 +692,7 @@ class WireClient:
                     raise ConnectionDropped(
                         f"stream from {self.host}:{self.port} ended "
                         f"before a terminal result line")
-                return terminal
+                return self._verify(terminal)
             except (ConnectionError, http.client.HTTPException,
                     TimeoutError, OSError) as e:
                 raise ConnectionDropped(
@@ -598,6 +709,7 @@ class WireClient:
         replica resolved it with ``status="shutdown"`` while retiring,
         and either way the evaluation is pure, so re-attempting on
         another replica cannot double apply."""
+        self._chaos_partition()
         body = wire.dumps(doc).encode()
         conn = self._conn(timeout)
         try:
@@ -616,7 +728,7 @@ class WireClient:
                         f"request not served "
                         f"({out.get('error', 'unavailable')})")
                 if out.get("event") == "grad_result":
-                    return out
+                    return self._verify(out)
                 return {"event": "grad_result",
                         "rid": out.get("rid", -1),
                         "status": out.get("status", "failed"),
@@ -640,6 +752,7 @@ class WireClient:
         ``on_chunk`` fires per decoded chunk (streaming consumers /
         router progress forwarding); transport-level failures raise
         ``ConnectionDropped``."""
+        self._chaos_partition()
         body = wire.dumps(doc).encode()
         conn = self._conn()
         try:
@@ -679,7 +792,8 @@ class WireClient:
                     event = json.loads(line)
                     kind = event.get("event")
                     if kind == "sweep_chunk":
-                        ch = wire.sweep_chunk_from_doc(event)
+                        ch = wire.sweep_chunk_from_doc(
+                            self._verify(event))
                         chunks.append(ch)
                         if on_chunk is not None:
                             on_chunk(ch)

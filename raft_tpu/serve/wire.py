@@ -28,6 +28,7 @@ to arrays ``np.array_equal`` to the originals in both precisions
 so the decoder rebuilds the exact array dtype the engine produced.
 """
 
+import hashlib
 import json
 
 import numpy as np
@@ -36,6 +37,54 @@ from raft_tpu.serve.buckets import BucketSpec
 from raft_tpu.serve.engine import GradResult, RequestResult, SweepResult
 
 WIRE_VERSION = 1
+
+#: payload keys folded into the per-document checksum, by event.  The
+#: checksum covers exactly the numeric payload a consumer decodes into
+#: arrays — in-flight corruption of those bytes must surface as a
+#: refused response (ConnectionDropped at the wire client), never as a
+#: decoded wrong Xi.  Metadata (rid, status, latency) stays outside:
+#: it is diagnostic, not answer bits.
+_CHECKSUM_KEYS = {
+    "result": ("std", "Xi_re", "Xi_im", "converged", "nonfinite",
+               "iters", "recovery_tier", "residual", "cond"),
+    "sweep_chunk": ("Xi_r", "Xi_i", "designs", "converged", "iters",
+                    "nonfinite", "recovery_tier", "residual", "cond"),
+    "grad_result": ("value", "gradient", "theta"),
+}
+
+
+def payload_checksum(doc):
+    """Checksum (16 hex chars) of a result document's numeric payload,
+    or None when the document carries none (errors, rejections).
+
+    Computed over ``json.dumps(..., sort_keys=True)`` of the payload
+    keys: Python's float repr round-trips f64 exactly, so encoding the
+    payload, decoding it with ``json.loads`` and re-checksumming yields
+    the same digest — which is what lets the RECEIVER verify without a
+    canonical binary form."""
+    keys = _CHECKSUM_KEYS.get(doc.get("event"))
+    if not keys:
+        return None
+    body = {k: doc[k] for k in keys if k in doc}
+    if not body:
+        return None
+    blob = json.dumps(body, sort_keys=True).encode("utf-8")
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def checksum_mismatch(doc):
+    """Reason string when ``doc`` embeds a payload checksum that does
+    not match its payload; None when it matches or when the document
+    carries no checksum (error results, pre-checksum peers — absence is
+    not corruption)."""
+    want = doc.get("checksum")
+    if not want:
+        return None
+    got = payload_checksum(doc)
+    if got != want:
+        return (f"payload checksum mismatch on {doc.get('event')} "
+                f"rid={doc.get('rid')} (want {want}, got {got})")
+    return None
 
 # HTTP status a terminal result maps to when a response is NOT streamed
 # (streamed responses commit 200 at the accepted chunk; the terminal
@@ -137,6 +186,9 @@ def result_doc(res, include_xi=False):
             doc["Xi_re"] = res.Xi.real.tolist()
             doc["Xi_im"] = res.Xi.imag.tolist()
             doc["Xi_dtype"] = str(res.Xi.dtype)
+    cs = payload_checksum(doc)
+    if cs:
+        doc["checksum"] = cs
     return doc
 
 
@@ -235,6 +287,9 @@ def sweep_chunk_doc(chunk):
         doc["xi_dtype"] = str(Xi_r.dtype)
         for key, _dt in _SWEEP_ARRAY_DTYPES:
             doc[key] = np.asarray(chunk[key]).tolist()
+    cs = payload_checksum(doc)
+    if cs:
+        doc["checksum"] = cs
     return doc
 
 
@@ -385,6 +440,9 @@ def grad_result_doc(res):
         doc["knobs"] = list(res.knobs or ())
         doc["gradient"] = {k: float(v)
                            for k, v in (res.gradient or {}).items()}
+    cs = payload_checksum(doc)
+    if cs:
+        doc["checksum"] = cs
     return doc
 
 

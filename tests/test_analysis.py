@@ -503,6 +503,51 @@ def test_chaos_registration_fixture(tmp_path):
     assert not _run(str(root2), "chaos-registered", tmp_path).findings
 
 
+def test_socket_timeout_fixture_flags_unbounded_calls(tmp_path):
+    root = _tree(tmp_path, {"raft_tpu/net.py": """\
+        import socket
+        from http.client import HTTPConnection
+        from urllib.request import urlopen
+
+        def fetch(url):
+            return urlopen(url).read()
+
+        def connect(host, port):
+            return HTTPConnection(host, port)
+
+        def raw(addr):
+            return socket.create_connection(addr)
+        """})
+    idents = _idents(_run(root, "socket-timeout-discipline", tmp_path))
+    assert idents == {"fetch:urlopen", "connect:HTTPConnection",
+                      "raw:create_connection"}
+
+
+def test_socket_timeout_fixture_quiet_on_bounded_calls(tmp_path):
+    root = _tree(tmp_path, {"raft_tpu/net.py": """\
+        import socket
+        from http.client import HTTPConnection, HTTPSConnection
+        from urllib.request import urlopen
+
+        def fetch(url, timeout):
+            return urlopen(url, timeout=timeout).read()
+
+        def fetch_positional(url):
+            return urlopen(url, None, 5.0).read()
+
+        def connect(host, port, t):
+            return HTTPConnection(host, port, timeout=t)
+
+        def connect_tls(host, port):
+            return HTTPSConnection(host, port, 5.0)
+
+        def raw(addr, **kw):
+            return socket.create_connection(addr, **kw)
+        """})
+    assert not _run(root, "socket-timeout-discipline",
+                    tmp_path).findings
+
+
 # ------------------------------------------------------ allowlist policy
 
 def test_reasonless_allowlist_entry_does_not_suppress(tmp_path):

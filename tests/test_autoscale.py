@@ -15,7 +15,11 @@ contracts, driven against a fake fleet with a hand-advanced clock.
   an unreachable-yet-alive misread never spawns past the ceiling;
 * ring stability: growing the consistent-hash ring 2 -> 3 moves ONLY
   keys the new replica claims (the property that makes scale-out
-  cheap — every other replica keeps its warmed buckets).
+  cheap — every other replica keeps its warmed buckets);
+* attach mode (PR 20): a fleet that cannot spawn degrades the heal to
+  reap + reweigh with a once-per-episode ``heal_unavailable`` record,
+  and any action is skipped when the fleet's health epoch moved
+  mid-tick (never scale on a stale view).
 """
 
 import threading
@@ -281,3 +285,104 @@ def test_concurrent_steps_never_double_scale():
     # exactly ONE racer wins; the rest land in the cooldown hold
     assert len(decisions) == 1 and decisions[0]["action"] == "scale_out"
     assert len(fleet.replicas) == 3
+
+
+# --------------------------- attach mode: degrade + stale-view gate
+
+
+class AttachFleet(FakeFleet):
+    """A fleet of ATTACHED remote peers: the router owns no processes,
+    so it cannot spawn — the heal rule must degrade.  Also carries the
+    health-epoch hook; ``epoch_bump_per_call`` simulates another
+    thread mutating the fleet mid-tick (every epoch read returns a new
+    version, so any captured view is stale by action time)."""
+
+    def __init__(self, n=2, can_spawn=False):
+        super().__init__(n=n)
+        self.can_spawn = can_spawn
+        self.epoch = 0
+        self.epoch_bump_per_call = False
+        self.reweighs = []
+
+    def can_scale_out(self):
+        return self.can_spawn
+
+    def reweigh(self, gauges):
+        self.reweighs.append({rid: g for rid, g in gauges.items()})
+        return {rid: 64 for rid in self.replicas}
+
+    def health_epoch(self):
+        e = self.epoch
+        if self.epoch_bump_per_call:
+            self.epoch += 1
+        return e
+
+
+def test_attach_mode_heal_degrades_to_reap_reweigh_once_per_episode():
+    """Below the floor with nothing to spawn: the corpse is reaped,
+    the survivors re-weighted, and the breach recorded as ONE
+    ``heal_unavailable`` decision — not one per tick — until capacity
+    returns and a fresh breach opens a new episode."""
+    clock, fleet = FakeClock(), AttachFleet(n=2)
+    a = _scaler(fleet, clock, min_replicas=2, max_replicas=3)
+    assert a.step() is None                  # healthy: no action
+    fleet.dead.add("r1")
+    clock.tick(0.1)                          # inside cooldown: heals
+    d = a.step()                             # bypass it anyway
+    assert d is not None and d["action"] == "heal_unavailable"
+    assert d["reaped"] == ["r1"]
+    assert "r1" not in fleet.replicas        # reaped off the ring
+    assert len(fleet.replicas) == 1          # nothing spawned
+    assert len(fleet.reweighs) == 1          # survivors re-weighted
+    # the breach persists every tick, but is noted only once
+    for _ in range(3):
+        clock.tick(1.0)
+        assert a.step() is None
+    snap = a.snapshot()
+    assert snap["heal_unavailable"] == 1 and snap["heals"] == 0
+    # operator attaches capacity: healthy resets the episode...
+    fleet.replicas["r9"] = []
+    clock.tick(10.0)
+    assert a.step() is None
+    # ...so a NEW breach records again
+    fleet.dead.add("r9")
+    clock.tick(1.0)
+    assert a.step()["action"] == "heal_unavailable"
+    assert a.snapshot()["heal_unavailable"] == 2
+
+
+def test_spawnable_fleet_still_heals_with_hook_present():
+    """can_scale_out() True keeps the classic heal: reap + respawn."""
+    clock, fleet = FakeClock(), AttachFleet(n=2, can_spawn=True)
+    a = _scaler(fleet, clock, min_replicas=2, max_replicas=3)
+    fleet.dead.add("r0")
+    d = a.step()
+    assert d is not None and d["action"] == "heal"
+    assert len(fleet.replicas) == 2 and fleet.reweighs == []
+
+
+def test_stale_view_gates_heal_and_scale_out():
+    """The health epoch moving between gauge capture and the action
+    means the gauges describe a fleet that no longer exists: the tick
+    declines to act (counted), whatever the action would have been."""
+    clock, fleet = FakeClock(), AttachFleet(n=2, can_spawn=True)
+    a = _scaler(fleet, clock, min_replicas=2, max_replicas=4,
+                high_water=4.0)
+    fleet.epoch_bump_per_call = True
+    # heal path: below the floor, but the view is stale -> no reap
+    fleet.dead.add("r1")
+    assert a.step() is None
+    assert "r1" in fleet.replicas            # reap never ran
+    assert a.snapshot()["stale_view_skips"] == 1
+    # scale-out path: sustained high pressure, stale view -> no spawn
+    fleet.dead.clear()
+    fleet.pressure = 8.0
+    a.step()
+    clock.tick(2.0)
+    assert a.step() is None
+    assert len(fleet.replicas) == 2 and a.decisions == []
+    assert a.snapshot()["stale_view_skips"] >= 2
+    # epoch stable again: the very same condition now acts
+    fleet.epoch_bump_per_call = False
+    clock.tick(1.0)
+    assert a.step()["action"] == "scale_out"

@@ -66,6 +66,7 @@ def test_bench_bem_converge_unpack_arity(cpu_as_tpu, tmp_path):
     assert isinstance(res["bem_conv_A_within_5pct"], bool)
 
 
+@pytest.mark.slow
 def test_blocked_gj_branch_forced_on_cpu(cpu_as_tpu):
     """The real-block/blocked-GJ branch (padded N > 1024, 2N % 512 == 0)
     solves cleanly on CPU and matches the plain complex-LU path — the

@@ -229,6 +229,27 @@ def test_serve_cache_section_pinned_in_compact_schema():
         assert key in bench._COMPACT_KEYS, key
 
 
+def test_serve_multihost_section_pinned_in_compact_schema():
+    """The multi-host attach-fleet bench section (PR 20) stays wired:
+    both entry points exist and the headline keys — the
+    handshake-refusal count, the shared-nothing wire-preload wall and
+    entry count, the first-100 hit-rate delta vs the shared-dir
+    handoff equivalent, and the partition SLO triple (goodput >= 0.8,
+    zero lost, bit-identical canaries through inject + heal) — ride
+    the compact driver line."""
+    assert callable(bench.bench_serve_multihost)
+    assert callable(bench.bench_multihost_smoke)
+    for key in ("serve_multihost_handshake_refusals",
+                "serve_multihost_preload_wall_s",
+                "serve_multihost_preload_entries",
+                "serve_multihost_first100_hit_delta",
+                "serve_multihost_partition_goodput",
+                "serve_multihost_lost", "serve_multihost_bits",
+                "multihost_smoke_goodput", "multihost_smoke_bits",
+                "serve_multihost_error", "multihost_smoke_error"):
+        assert key in bench._COMPACT_KEYS, key
+
+
 def test_serve_obs_section_pinned_in_compact_schema():
     """The observability bench keys (ISSUE 15) stay wired: the load
     section reports the engine-side (replica-merged) histogram

@@ -38,6 +38,7 @@ def _lanes(n, seed=0):
             rng.uniform(-0.15, 0.15, n))
 
 
+@pytest.mark.slow
 @multi_device
 def test_host_sharded_batch_bit_identical(rotor):
     """Sharded (all host devices) vs forced single-device: vals and J
@@ -76,6 +77,7 @@ def test_host_sharded_guided_bit_identical(rotor):
     assert float(np.max(out1[3])) <= 1e-8
 
 
+@pytest.mark.slow
 def test_host_devices_env_wiring_subprocess():
     """RAFT_TPU_HOST_DEVICES=2 set before `import raft_tpu` must split
     the host platform into 2 XLA:CPU devices (the

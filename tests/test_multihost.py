@@ -1,0 +1,646 @@
+"""Partition-tolerant multi-host attach (PR 20): handshake gating,
+fleet health, shared-nothing warm transfer, and the network chaos
+faults that ride them.
+
+Acceptance criteria, unit tier + end to end over real subprocess
+replicas:
+
+* ``Router.attach_remote`` REFUSES a peer whose ``/versionz`` surface
+  disagrees — wire version, env flag surface, or flag values — with a
+  logged reason, and the ``handshake_skew`` chaos fault forces that
+  refusal path deterministically;
+* a breaker half-open probe of an ATTACHED peer re-runs the handshake:
+  a restarted peer with different flags is EJECTED from the fleet,
+  while a merely-unreachable peer stays (that is the breaker's
+  business, not an incompatibility);
+* the per-replica health machine walks alive -> suspect -> dead on
+  consecutive failed ``/statz`` scrapes, bumping the health epoch on
+  every transition; suspect replicas sink to the back of the placement
+  order (new work avoids them while any healthy replica can serve);
+* ring weights are a dict of per-replica vnode counts whose point
+  hashes are count-independent, so re-weighting only moves the keys on
+  added/removed arcs (pinned max movement), and ``reweigh`` is a
+  deterministic function of the gauges;
+* the shared-nothing warm transfer ships checksummed cache entries
+  over ``POST /v1/cache/preload``; a torn or corrupt chunk is
+  refused-and-deleted, and a loaded one serves bit-identically on the
+  receiving host;
+* ``net_partition`` (drops /v1/* while health GETs still answer — the
+  gray failure) fails over to the surviving host bit-identically, and
+  ``wire_corrupt`` (a flipped payload value) is refused by the wire
+  checksum and retried, never surfaced as a result.
+
+All servers bind port 0 (tests/test_no_fixed_ports.py keeps it that
+way); chaos specs target replicas by their OS-assigned port.
+"""
+
+import hashlib
+import json
+import socket
+import threading
+import time
+import types
+from concurrent.futures import ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from raft_tpu.designs import deep_spar
+from raft_tpu.serve import Router, WireClient, routing_key, wire
+from raft_tpu.serve.cache import ENV_FLAG_SURFACE, current_flags
+from raft_tpu.serve.result_cache import (
+    ResultCache,
+    grad_key,
+    sweep_chunk_key,
+)
+from raft_tpu.serve.router import (
+    _VNODES,
+    HEALTH_DEAD_AFTER,
+    HEALTH_SUSPECT_AFTER,
+    HandshakeRefused,
+    HashRing,
+    _RouterSweepHandle,
+    spawn_replica,
+)
+
+NW = (0.05, 0.5)
+
+
+def _spar(rho_fill=1800.0):
+    d = deep_spar(n_cases=2, nw_settings=NW)
+    d["platform"]["members"][0]["rho_fill"] = [float(rho_fill), 0.0, 0.0]
+    return d
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _dead_router(n=1, **kw):
+    """Attach-mode router over just-freed ports: nothing listens,
+    nothing is spawned — pure router-state surface."""
+    return Router(endpoints=[("127.0.0.1", _free_port())
+                             for _ in range(n)], **kw)
+
+
+# ------------------------------------------------- unit: weighted ring
+
+def test_ring_dict_vnodes_and_empty_ring():
+    uniform = HashRing(["r0", "r1"])
+    weighted = HashRing(["r0", "r1"], vnodes={"r0": _VNODES,
+                                              "r1": _VNODES // 2})
+    assert len(weighted._points) == _VNODES + _VNODES // 2
+    # a rid missing from the dict keeps the uniform default, floor 1
+    defaulted = HashRing(["r0", "r1"], vnodes={"r0": 0})
+    assert len(defaulted._points) == 1 + _VNODES
+    empty = HashRing([])
+    assert empty.lookup("anything") is None
+    assert empty.preference("anything") == []
+    assert uniform.lookup("anything") in ("r0", "r1")
+
+
+def test_reweight_only_moves_removed_arc_keys_pinned_max_movement():
+    """Vnode point hashes are independent of the count, so halving one
+    replica's weight only moves the keys that sat on its REMOVED arcs
+    — every moved key lands on the other replica, and the moved
+    fraction stays far below a rebuild-the-world reshuffle."""
+    uniform = HashRing(["r0", "r1"])
+    weighted = HashRing(["r0", "r1"], vnodes={"r0": _VNODES,
+                                              "r1": _VNODES // 2})
+    moved = 0
+    for i in range(1000):
+        key = f"design-family-{i}"
+        before, after = uniform.lookup(key), weighted.lookup(key)
+        if before != after:
+            # arcs were only REMOVED from r1: keys move r1 -> r0 only
+            assert (before, after) == ("r1", "r0"), (key, before, after)
+            moved += 1
+    assert 0 < moved < 350        # pinned: ~17% expected, never 35%
+
+
+def test_reweigh_is_deterministic_and_throughput_proportional():
+    router = _dead_router(n=2)
+    try:
+        gauges = {"r0": {"ok": 100, "uptime_s": 10.0},
+                  "r1": {"ok": 25, "uptime_s": 10.0}}
+        w1 = router.reweigh(gauges)
+        # rate ratio 4:1 around the mean, clamped to [16, 256]
+        assert w1 == {"r0": 102, "r1": 26}
+        lookups = {f"k{i}": router._ring.lookup(f"k{i}")
+                   for i in range(64)}
+        w2 = router.reweigh(gauges)
+        assert w2 == w1
+        assert all(router._ring.lookup(k) == rid
+                   for k, rid in lookups.items())
+        assert router.stats["reweighs"] == 2
+        assert router.snapshot()["ring_weights"] == w1
+        # unusable gauges keep the uniform default for that replica
+        w3 = router.reweigh({"r0": {"ok": 100, "uptime_s": 10.0},
+                             "r1": None})
+        assert w3 == {"r0": _VNODES, "r1": _VNODES}
+    finally:
+        router.shutdown(wait=False)
+
+
+# ------------------------------------------ unit: health state machine
+
+def test_health_walks_alive_suspect_dead_and_epoch_versions_view():
+    router = _dead_router(n=1)
+    try:
+        assert router.health_view()["r0"]["state"] == "alive"
+        epoch0 = router.health_epoch()
+        for _ in range(HEALTH_SUSPECT_AFTER):
+            router.replica_gauges()     # dead port: scrape fails
+        assert router.health_view()["r0"]["state"] == "suspect"
+        epoch1 = router.health_epoch()
+        assert epoch1 > epoch0          # transition bumped the epoch
+        for _ in range(HEALTH_DEAD_AFTER - HEALTH_SUSPECT_AFTER):
+            router.replica_gauges()
+        assert router.health_view()["r0"]["state"] == "dead"
+        assert router.health_epoch() > epoch1
+        # dead verdict marks the replica for reap; the ring empties
+        assert router.reap_dead() == ["r0"]
+        assert router.replicas == {}
+        assert router.health_view() == {}
+        assert router._placement_order("any-key") == []
+        snap = router.snapshot()
+        assert snap["health"] == {}
+        assert snap["health_epoch"] == router.health_epoch()
+    finally:
+        router.shutdown(wait=False)
+
+
+def test_suspect_replica_sinks_in_placement_but_still_listed():
+    router = _dead_router(n=2)
+    try:
+        with router._lock:
+            for _ in range(HEALTH_SUSPECT_AFTER):
+                router._health_note_locked("r0", False)
+        assert router.health_view()["r0"]["state"] == "suspect"
+        before = router.stats["suspect_deprioritized"]
+        for i in range(64):
+            order = router._placement_order(f"key-{i}")
+            # deprioritized, never skipped: both replicas still listed
+            assert sorted(order) == ["r0", "r1"]
+            assert order[0] == "r1"
+        assert router.stats["suspect_deprioritized"] - before == 64
+        # one good scrape snaps straight back to alive
+        with router._lock:
+            router._health_note_locked("r0", True)
+        assert router.health_view()["r0"] == {"state": "alive",
+                                              "fails": 0}
+    finally:
+        router.shutdown(wait=False)
+
+
+# ----------------------------- unit: zero-alive-replica cache serving
+
+def test_grad_cache_hit_serves_with_zero_alive_replicas(tmp_path):
+    """A router-tier grad-cache hit needs NO fleet at all: with every
+    replica health-reaped (empty ring), ``submit_grad`` still resolves
+    the exact stored bits with zero forward hop."""
+    from raft_tpu.grad.response import GRAD_KNOBS, parse_objective
+
+    design = _spar(2400.0)
+    obj = {"metric": "rao_pitch_peak",
+           "knobs": ["draft", "col_diam", "ballast"]}
+    cache = ResultCache(str(tmp_path))
+    metric, knobs, theta = parse_objective(obj)
+    if theta is None:
+        theta = (1.0,) * len(GRAD_KNOBS)
+    canon = {"metric": metric, "knobs": sorted(knobs),
+             "theta": [float(t) for t in theta]}
+    stored = types.SimpleNamespace(
+        value=3.25, metric=metric, theta=list(canon["theta"]),
+        gradient={"draft": -0.5, "col_diam": 0.125, "ballast": 2.0},
+        backend="cpu")
+    key = grad_key(design, canon, "float64", flags=cache.flags)
+    assert cache.put_grad(key, stored) >= 0
+    router = _dead_router(n=1, cache_dir=str(tmp_path),
+                          precision="float64")
+    try:
+        for _ in range(HEALTH_DEAD_AFTER):
+            router.replica_gauges()
+        assert router.reap_dead() == ["r0"]
+        assert router.replicas == {}
+        res = router.evaluate_grad(design, obj, timeout=30)
+        assert res.status == "ok", res.error
+        assert res.cache_hit is True
+        assert res.value == 3.25
+        assert res.gradient == stored.gradient
+        assert router.stats["grad_cache_hits"] == 1
+        assert router.stats["grad_forwarded"] == 0
+    finally:
+        router.shutdown(wait=False)
+
+
+def test_sweep_all_chunks_cached_serves_with_zero_alive_replicas(
+        tmp_path):
+    """All-or-nothing sweep serving holds on an EMPTY fleet: every
+    predicted chunk verified -> the whole sweep resolves cached with
+    zero forward hop and the stored bits."""
+    designs = [_spar(2500.0), _spar(2510.0), _spar(2520.0)]
+    cache = ResultCache(str(tmp_path))
+    router = _dead_router(n=1, cache_dir=str(tmp_path),
+                          precision="float64")
+    try:
+        parts = router._sweep_partition(designs, None, 2)
+        rng = np.random.default_rng(11)
+        stored = []
+        for part in parts:
+            n = len(part)
+            arrays = {
+                "Xi_r": rng.standard_normal((n, 2, 6, 3)),
+                "Xi_i": rng.standard_normal((n, 2, 6, 3)),
+                "converged": np.ones((n, 2), bool),
+                "iters": np.full((n, 2), 4, np.int64),
+                "nonfinite": np.zeros((n, 2), bool),
+                "recovery_tier": np.zeros((n, 2), np.int64),
+                "residual": rng.standard_normal((n, 2)),
+                "cond": np.ones((n, 2), np.float64),
+            }
+            key = sweep_chunk_key([designs[i] for i in part], None,
+                                  "float64", flags=cache.flags)
+            assert cache.put_chunk(key, arrays) >= 0
+            stored.append((part, arrays))
+        for _ in range(HEALTH_DEAD_AFTER):
+            router.replica_gauges()
+        assert router.reap_dead() == ["r0"]
+        res = router.submit_sweep(designs, chunk=2).result(timeout=60)
+        assert res.status == "ok", res.error
+        assert router.stats["sweep_cache_hits"] == 1
+        assert router.stats["forwarded"] == 0
+        for part, arrays in stored:
+            got = res.Xi_r[np.asarray(part)]
+            assert np.array_equal(got, arrays["Xi_r"])
+    finally:
+        router.shutdown(wait=False)
+
+
+def test_sweep_resume_with_full_checkpoints_never_reforwards():
+    """A dropped stream whose checkpointed chunks already cover every
+    design resolves FROM the checkpoints: the router must not forward
+    an empty sub-sweep to the next replica — a live replica fails an
+    empty sweep, which turned a fully-recovered request into a
+    terminal failure (the mid-stream ``replica_kill`` flake)."""
+    router = _dead_router(n=1)
+    try:
+        rep = router.replicas["r0"]
+        calls = []
+
+        def fake_sweep(req, on_chunk=None):
+            calls.append(req)
+            return ({"event": "sweep_result", "rid": -1,
+                     "status": "failed",
+                     "n_designs": len(req["designs"]),
+                     "error": "empty sweep"}, [])
+
+        rep.client = types.SimpleNamespace(sweep=fake_sweep)
+        designs = [_spar(2700.0), _spar(2710.0)]
+        rng = np.random.default_rng(3)
+        chunk_doc = {
+            "event": "sweep_chunk", "chunk": 0,
+            "designs": [0, 1], "replica": "r_gone",
+            "Xi_r": rng.standard_normal((2, 2, 6, 3)),
+            "Xi_i": rng.standard_normal((2, 2, 6, 3)),
+            "converged": np.ones((2, 2), bool),
+            "iters": np.full((2, 2), 4, np.int64),
+            "nonfinite": np.zeros((2, 2), bool),
+            "recovery_tier": np.zeros((2, 2), np.int64),
+            "residual": rng.standard_normal((2, 2)),
+            "cond": np.ones((2, 2), np.float64),
+        }
+        with router._lock:
+            router._rid += 1
+            rid = router._rid
+            handle = _RouterSweepHandle(rid, len(designs))
+            router._outstanding[rid] = handle._pend
+        router._forward_sweep(rid, handle, designs, None, 2,
+                              time.perf_counter(),
+                              pre_chunks=[chunk_doc])
+        res = handle.result(timeout=30)
+        assert res.status == "ok", res.error
+        assert calls == []                       # zero forwards
+        assert np.array_equal(res.Xi_r, chunk_doc["Xi_r"])
+        assert np.array_equal(res.Xi_i, chunk_doc["Xi_i"])
+    finally:
+        router.shutdown(wait=False)
+
+
+# -------------------------------------- unit: wire preload entry gates
+
+def test_receive_entry_roundtrip_and_corrupt_transfer_refused(tmp_path):
+    src = ResultCache(str(tmp_path / "src"))
+    dst = ResultCache(str(tmp_path / "dst"))
+    stored = types.SimpleNamespace(
+        value=1.5, metric="rao_pitch_peak", theta=[1.0],
+        gradient={"draft": 0.25}, backend="cpu")
+    key = grad_key(_spar(2600.0), {"metric": "rao_pitch_peak",
+                                   "knobs": ["draft"], "theta": [1.0]},
+                   "float64", flags=src.flags)
+    assert src.put_grad(key, stored) >= 0
+    data = src.read_entry_bytes(key)
+    assert data is not None
+    sha = hashlib.sha256(data).hexdigest()
+    # torn transfer: sha over different bytes -> refused, nothing kept
+    assert dst.receive_entry(key, "grad", data[:-7], sha) == "refused"
+    assert dst.read_entry_bytes(key) is None
+    # corrupt-but-consistent transfer: checksummed garbage fails the
+    # verified read -> refused-and-deleted
+    junk = b"not-an-npz" * 16
+    assert dst.receive_entry(
+        key, "grad", junk,
+        hashlib.sha256(junk).hexdigest()) == "refused"
+    assert dst.read_entry_bytes(key) is None
+    # hostile key never touches the filesystem
+    assert dst.receive_entry("../escape", "grad", data, sha) == "refused"
+    # the clean transfer loads and serves the exact stored bits
+    assert dst.receive_entry(key, "grad", data, sha) == "loaded"
+    hit, refused = dst.get_grad(key)
+    assert refused == 0 and hit is not None
+    assert hit["value"] == 1.5
+    assert hit["gradient"] == {"draft": 0.25}
+
+
+# ------------------------------------------- unit: handshake refusals
+
+class _FakePeerHandler(BaseHTTPRequestHandler):
+    def do_GET(self):
+        if self.path != "/versionz":
+            return self._reply(404, {"error": f"no route {self.path}"})
+        return self._reply(200, self.server.version_doc)
+
+    def do_POST(self):
+        return self._reply(503, {"error": "fake peer serves nothing"})
+
+    def _reply(self, code, doc):
+        body = json.dumps(doc).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):
+        pass
+
+
+@pytest.fixture()
+def fake_peer():
+    """An HTTP server that answers only /versionz — enough surface for
+    the attach handshake.  ``server.version_doc`` is mutable, so a test
+    can 'restart the peer with different flags'."""
+    server = ThreadingHTTPServer(("127.0.0.1", 0), _FakePeerHandler)
+    server.version_doc = {
+        "wire_version": wire.WIRE_VERSION,
+        "flags": current_flags(),
+        "env_flag_surface": dict(ENV_FLAG_SURFACE),
+        "uptime_s": 1.0,
+    }
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    yield server
+    server.shutdown()
+    server.server_close()
+    thread.join(timeout=5)
+
+
+def test_attach_refuses_mismatched_peers_with_logged_reason(fake_peer):
+    port = fake_peer.server_address[1]
+    router = _dead_router(n=1)
+    try:
+        # wire-version skew
+        fake_peer.version_doc = dict(fake_peer.version_doc,
+                                     wire_version=999)
+        with pytest.raises(HandshakeRefused, match="wire_version"):
+            router.attach_remote("127.0.0.1", port)
+        # env flag SURFACE skew: peer gates numerics on different vars
+        fake_peer.version_doc = dict(
+            fake_peer.version_doc, wire_version=wire.WIRE_VERSION,
+            env_flag_surface={"RAFT_TPU_BOGUS": "made-up"})
+        with pytest.raises(HandshakeRefused, match="flag surface"):
+            router.attach_remote("127.0.0.1", port)
+        # flag VALUE skew: different code version
+        skew_flags = dict(current_flags(), code_version="deadbeef")
+        fake_peer.version_doc = dict(
+            fake_peer.version_doc, flags=skew_flags,
+            env_flag_surface=dict(ENV_FLAG_SURFACE))
+        with pytest.raises(HandshakeRefused, match="code_version"):
+            router.attach_remote("127.0.0.1", port)
+        assert router.stats["handshake_refusals"] == 3
+        assert sorted(router.replicas) == ["r0"]   # fleet untouched
+        # unreachable peer: refused and tagged transport, not flags
+        with pytest.raises(HandshakeRefused) as refusal:
+            router.attach_remote("127.0.0.1", _free_port())
+        assert getattr(refusal.value, "transport", False) is True
+        # a compatible peer attaches and claims ring arcs
+        fake_peer.version_doc = dict(fake_peer.version_doc,
+                                     flags=current_flags())
+        new_id = router.attach_remote("127.0.0.1", port)
+        assert new_id in router.replicas
+        assert {router._ring.lookup(f"k{i}") for i in range(128)} \
+            == {"r0", new_id}
+    finally:
+        router.shutdown(wait=False)
+
+
+def test_handshake_skew_chaos_forces_refusal_then_clean_attach(
+        fake_peer, monkeypatch):
+    """The ``handshake_skew`` chaos fault mutates the flag surface a
+    compatible peer reports, forcing the refusal path: attach_remote
+    raises with the mutated code_version in the reason and adds
+    nothing; with the fault exhausted the same peer attaches clean."""
+    port = fake_peer.server_address[1]
+    router = _dead_router(n=1)
+    try:
+        monkeypatch.setenv("RAFT_TPU_CHAOS", "handshake_skew*1:5")
+        with pytest.raises(HandshakeRefused, match="code_version"):
+            router.attach_remote("127.0.0.1", port)
+        assert router.stats["handshake_refusals"] == 1
+        assert sorted(router.replicas) == ["r0"]
+        new_id = router.attach_remote("127.0.0.1", port)   # *1: spent
+        assert new_id in router.replicas
+    finally:
+        monkeypatch.delenv("RAFT_TPU_CHAOS")
+        router.shutdown(wait=False)
+
+
+def test_half_open_reverify_ejects_restarted_incompatible_peer(
+        fake_peer):
+    port = fake_peer.server_address[1]
+    router = _dead_router(n=1)
+    try:
+        new_id = router.attach_remote("127.0.0.1", port)
+        rep = router.replicas[new_id]
+        # the peer 'restarts' with a different build
+        fake_peer.version_doc = dict(
+            fake_peer.version_doc,
+            flags=dict(current_flags(), code_version="rebuilt"))
+        assert router._reverify_half_open(new_id, rep) is False
+        assert new_id not in router.replicas       # EJECTED
+        assert router.stats["peer_ejections"] == 1
+        assert {router._ring.lookup(f"k{i}") for i in range(64)} \
+            == {"r0"}
+    finally:
+        router.shutdown(wait=False)
+
+
+def test_half_open_reverify_keeps_unreachable_peer(fake_peer):
+    port = fake_peer.server_address[1]
+    router = _dead_router(n=1)
+    try:
+        new_id = router.attach_remote("127.0.0.1", port)
+        rep = router.replicas[new_id]
+        fake_peer.shutdown()
+        fake_peer.server_close()
+        assert router._reverify_half_open(new_id, rep) is False
+        # unreachable is the breaker's business — still in the fleet
+        assert new_id in router.replicas
+        assert router.stats["peer_ejections"] == 0
+    finally:
+        router.shutdown(wait=False)
+
+
+# ------------------------------- e2e: two-host shared-nothing fleet
+
+@pytest.fixture(scope="module")
+def hosts(tmp_path_factory):
+    """Two subprocess replicas with DISJOINT cache dirs — two 'hosts'
+    sharing nothing but the wire.  The router lives on host A (shares
+    its cache dir); host B starts cold and joins via attach_remote."""
+    dir_a = str(tmp_path_factory.mktemp("host_a"))
+    dir_b = str(tmp_path_factory.mktemp("host_b"))
+    with ThreadPoolExecutor(max_workers=2) as ex:
+        fut_a = ex.submit(spawn_replica, "hostA", cache_dir=dir_a,
+                          precision="float64", window_ms=20.0)
+        fut_b = ex.submit(spawn_replica, "hostB", cache_dir=dir_b,
+                          precision="float64", window_ms=20.0)
+        rep_a, rep_b = fut_a.result(), fut_b.result()
+    router = Router(endpoints=[("127.0.0.1", rep_a.port)],
+                    cache_dir=dir_a, precision="float64")
+    try:
+        warm = router.evaluate(_spar(), timeout=560)
+        assert warm.status == "ok", warm.error
+        deadline = time.monotonic() + 30
+        while _statz(rep_a)["result_cache_stores"] < 1:
+            assert time.monotonic() < deadline, "store never landed"
+            time.sleep(0.1)
+        # the repeat is a router-tier cache hit: it seeds the router's
+        # popularity ledger, which is what the warm transfer ships
+        again = router.evaluate(_spar(), timeout=560)
+        assert again.status == "ok" and again.replica is None
+        b_id = router.attach_remote("127.0.0.1", rep_b.port)
+        yield {"router": router, "rep_a": rep_a, "rep_b": rep_b,
+               "b_id": b_id, "warm_design": _spar(), "ref": warm}
+    finally:
+        router.shutdown(wait=False)
+        for rep in (rep_a, rep_b):
+            if rep.proc is not None:
+                rep.proc.kill()
+                rep.proc.wait(10)
+
+
+def _statz(rep):
+    code, doc = WireClient("127.0.0.1", rep.port).get("/statz",
+                                                      timeout=10.0)
+    assert code == 200
+    return doc
+
+
+@pytest.mark.slow
+def test_attach_ships_warm_cache_shared_nothing(hosts):
+    """The warm transfer crossed the wire: host B (disjoint cache dir)
+    loaded checksummed entries via /v1/cache/preload and its FIRST
+    request for the warmed design is a result-cache hit with the exact
+    bits host A computed."""
+    router, rep_b = hosts["router"], hosts["rep_b"]
+    assert router.stats["wire_preload_entries_sent"] >= 1
+    snap_b = _statz(rep_b)
+    assert snap_b["wire_preload_loaded"] >= 1
+    assert snap_b["wire_preload_refused"] == 0
+    # host B serves the warmed design from ITS OWN cache, same bits
+    client = WireClient("127.0.0.1", rep_b.port)
+    doc = client.solve({"design": hosts["warm_design"], "cases": None,
+                        "xi": True})
+    assert doc["status"] == "ok", doc.get("error")
+    res = wire.result_from_doc(doc)
+    ref = hosts["ref"]
+    assert np.array_equal(res.Xi, np.asarray(ref.Xi))
+    assert np.array_equal(res.std, np.asarray(ref.std))
+    after = _statz(rep_b)
+    assert after["result_cache_hits"] >= 1
+
+
+@pytest.mark.slow
+def test_net_partition_gray_failure_fails_over_bit_identical(
+        hosts, monkeypatch):
+    """``net_partition`` on the primary replica's port: /v1/* forwards
+    surface ConnectionDropped while /healthz STILL answers (the gray
+    failure), and the router fails over to the surviving host with
+    byte-identical answers."""
+    router = hosts["router"]
+    design = hosts["warm_design"]
+    key = routing_key(design, None)
+    primary = router._ring.lookup(key)
+    victim = router.replicas[primary]
+    saved, router._result_cache = router._result_cache, None
+    try:
+        ref = router.evaluate(design, timeout=560)
+        assert ref.status == "ok", ref.error
+        before = dict(router.stats)
+        monkeypatch.setenv("RAFT_TPU_CHAOS",
+                           f"net_partition@{victim.port}:7")
+        # gray failure: the partitioned host still answers health GETs
+        code, health = WireClient("127.0.0.1",
+                                  victim.port).get("/healthz")
+        assert code == 200 and health["status"] == "alive"
+        res = router.evaluate(design, timeout=560)
+        assert res.status == "ok", res.error
+        assert res.replica != primary          # failed over
+        assert np.array_equal(res.Xi, np.asarray(ref.Xi))
+        assert np.array_equal(res.std, np.asarray(ref.std))
+        assert router.stats["replica_retries"] > before[
+            "replica_retries"]
+        monkeypatch.delenv("RAFT_TPU_CHAOS")   # heal
+        healed = router.evaluate(design, timeout=560)
+        assert healed.status == "ok", healed.error
+        assert np.array_equal(healed.Xi, np.asarray(ref.Xi))
+    finally:
+        monkeypatch.delenv("RAFT_TPU_CHAOS", raising=False)
+        router._result_cache = saved
+
+
+@pytest.mark.slow
+def test_wire_corrupt_payload_refused_and_retried_bit_identical(
+        hosts, monkeypatch):
+    """``wire_corrupt`` flips one value of the primary's response
+    payload in flight: the embedded wire checksum refuses it as a
+    ConnectionDropped, the router retries on the other host, and the
+    served bits are identical — corrupt Xi never reaches a caller."""
+    router = hosts["router"]
+    design = hosts["warm_design"]
+    primary = router._ring.lookup(routing_key(design, None))
+    victim = router.replicas[primary]
+    saved, router._result_cache = router._result_cache, None
+    try:
+        ref = router.evaluate(design, timeout=560)
+        assert ref.status == "ok", ref.error
+        before = dict(router.stats)
+        monkeypatch.setenv("RAFT_TPU_CHAOS",
+                           f"wire_corrupt@{victim.port}*1:3")
+        res = router.evaluate(design, timeout=560)
+        assert res.status == "ok", res.error
+        assert np.array_equal(res.Xi, np.asarray(ref.Xi))
+        assert np.array_equal(res.std, np.asarray(ref.std))
+        assert router.stats["wire_checksum_refusals"] \
+            - before["wire_checksum_refusals"] >= 1
+        assert router.stats["replica_retries"] \
+            - before["replica_retries"] >= 1
+    finally:
+        monkeypatch.delenv("RAFT_TPU_CHAOS", raising=False)
+        router._result_cache = saved

@@ -194,6 +194,7 @@ def router2(shared_cache):
     router.shutdown()
 
 
+@pytest.mark.slow
 def test_http_to_2replica_router_matches_direct_dispatch(router2):
     transport = serve_http(router2)
     try:
@@ -426,6 +427,7 @@ def test_coalesced_identical_requests_bit_identical(router2):
     assert router2.probe()["inflight_followers"] == 0
 
 
+@pytest.mark.slow
 def test_dup_inflight_leader_failure_isolated_bit_identical(
         router2, monkeypatch):
     """The ``dup_inflight`` chaos fault: the coalescing leader stalls

@@ -86,6 +86,7 @@ def _run_phase(tmp_path, phase):
     return json.loads(line[len("RESULT "):])
 
 
+@pytest.mark.slow
 def test_warm_restart_serves_first_request_without_recompiling(tmp_path):
     cold = _run_phase(tmp_path, "cold")
     assert cold["warmed"] == 1
